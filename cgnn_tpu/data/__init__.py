@@ -1,0 +1,38 @@
+"""Data layer: structures, featurization, neighbor lists, graph batching.
+
+TPU-native replacement for the reference's ``data.py`` + ``atom_init.json``
+pipeline (SURVEY.md §1 "Data layer", §2 components 3-5, 12). pymatgen / ase /
+spglib are not available in this environment, so CIF parsing, the periodic
+neighbor list, and the batched-graph container are implemented in-tree.
+"""
+
+from cgnn_tpu.data.structure import Structure, lattice_from_parameters
+from cgnn_tpu.data.elements import atom_features, ATOM_FEA_DIM
+from cgnn_tpu.data.featurize import GaussianDistance
+from cgnn_tpu.data.cif import parse_cif, parse_cif_file
+from cgnn_tpu.data.neighbors import (
+    neighbor_list_brute,
+    neighbor_list,
+    knn_neighbor_list,
+)
+from cgnn_tpu.data.graph import CrystalGraph, GraphBatch, pack_graphs, pad_batch
+from cgnn_tpu.data.synthetic import random_structure, synthetic_dataset
+
+__all__ = [
+    "Structure",
+    "lattice_from_parameters",
+    "atom_features",
+    "ATOM_FEA_DIM",
+    "GaussianDistance",
+    "parse_cif",
+    "parse_cif_file",
+    "neighbor_list_brute",
+    "neighbor_list",
+    "knn_neighbor_list",
+    "CrystalGraph",
+    "GraphBatch",
+    "pack_graphs",
+    "pad_batch",
+    "random_structure",
+    "synthetic_dataset",
+]

@@ -1,0 +1,305 @@
+"""In-tree CIF parser (pymatgen unavailable — SURVEY.md §7 phase 0).
+
+Supports the subset the pipeline needs: cell parameters, atom-site loops
+(type symbol or label), fractional coordinates, and symmetry expansion via
+``_symmetry_equiv_pos_as_xyz`` / ``_space_group_symop_operation_xyz`` loops
+(affine x,y,z expression strings applied and deduplicated). There is no
+space-group-symbol engine: files carrying only a Hermann-Mauguin symbol and no
+explicit operator loop are treated as P1.
+
+Out of scope (errors loudly, per SURVEY.md §7 "hard parts" #6): partial
+occupancies < 1, disordered sites.
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+
+import numpy as np
+
+from cgnn_tpu.data.structure import Structure, lattice_from_parameters
+from cgnn_tpu.data.elements import SYMBOL_TO_Z
+
+
+class CIFError(ValueError):
+    pass
+
+
+def _strip_comment(line: str) -> str:
+    # '#' starts a comment unless inside quotes; cheap scan.
+    out, in_q = [], None
+    for ch in line:
+        if in_q:
+            out.append(ch)
+            if ch == in_q:
+                in_q = None
+        elif ch in "'\"":
+            in_q = ch
+            out.append(ch)
+        elif ch == "#":
+            break
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _tokenize(text: str) -> list[str]:
+    """CIF token stream: handles quotes, semicolon text fields, comments."""
+    tokens: list[str] = []
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith(";"):  # multi-line text field
+            field = [line[1:]]
+            i += 1
+            while i < len(lines) and not lines[i].startswith(";"):
+                field.append(lines[i])
+                i += 1
+            tokens.append("\n".join(field))
+            i += 1
+            continue
+        line = _strip_comment(line).strip()
+        if line:
+            try:
+                lexer = shlex.shlex(line, posix=True)
+                lexer.whitespace_split = True
+                lexer.quotes = "'\""
+                lexer.commenters = ""
+                tokens.extend(list(lexer))
+            except ValueError as e:
+                raise CIFError(f"unparseable CIF line {i + 1}: {line!r}") from e
+        i += 1
+    return tokens
+
+
+_NUM_RE = re.compile(
+    r"^([+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)(?:\(\d+\))?$"
+)
+
+
+def _parse_number(tok: str) -> float:
+    """CIF numeric value, stripping the '(esd)' suffix, e.g. '4.0521(3)'."""
+    m = _NUM_RE.match(tok)
+    if not m:
+        raise CIFError(f"expected a number, got {tok!r}")
+    return float(m.group(1))
+
+
+_SYMBOL_RE = re.compile(r"^([A-Za-z]{1,2})")
+
+
+def _symbol_from_label(label: str) -> str:
+    """'Fe2+', 'O1', 'FE1', 'Ca_a' -> element symbol.
+
+    Case-insensitive: all-caps labels ('FE1', 'CA2') are common in legacy
+    CIFs. The two-letter reading is preferred when it is a valid element
+    ('FE'->Fe, not F), matching pymatgen's resolution of the ambiguity.
+    """
+    m = _SYMBOL_RE.match(label.strip())
+    if not m:
+        raise CIFError(f"cannot extract element symbol from {label!r}")
+    raw = m.group(1)
+    two = raw.capitalize() if len(raw) == 2 else None
+    one = raw[0].upper()
+    if two and two in SYMBOL_TO_Z:
+        return two
+    if one in SYMBOL_TO_Z:
+        return one
+    raise CIFError(f"unknown element in site label {label!r}")
+
+
+def _parse_blocks(tokens: list[str]) -> dict:
+    """First data_ block -> {tag: value} plus loops as (headers, rows)."""
+    items: dict[str, str] = {}
+    loops: list[tuple[list[str], list[list[str]]]] = []
+    i = 0
+    n = len(tokens)
+    seen_data = False
+    while i < n:
+        tok = tokens[i]
+        low = tok.lower()
+        if low.startswith("data_"):
+            if seen_data:
+                break  # only the first data block
+            seen_data = True
+            i += 1
+        elif low == "loop_":
+            i += 1
+            headers = []
+            while i < n and tokens[i].startswith("_"):
+                headers.append(tokens[i].lower())
+                i += 1
+            values = []
+            while i < n and not tokens[i].startswith("_") and \
+                    not tokens[i].lower().startswith(("loop_", "data_")):
+                values.append(tokens[i])
+                i += 1
+            if headers and len(values) % len(headers) == 0:
+                rows = [
+                    values[j : j + len(headers)]
+                    for j in range(0, len(values), len(headers))
+                ]
+                loops.append((headers, rows))
+            elif headers:
+                raise CIFError(
+                    f"loop with {len(headers)} columns has {len(values)} values"
+                )
+        elif tok.startswith("_"):
+            if i + 1 < n and not tokens[i + 1].startswith("_") and \
+                    not tokens[i + 1].lower().startswith(("loop_", "data_")):
+                items[low] = tokens[i + 1]
+                i += 2
+            else:
+                items[low] = ""
+                i += 1
+        else:
+            i += 1
+    return {"items": items, "loops": loops}
+
+
+_FRAC_RE = re.compile(r"(\d+)\s*/\s*(\d+)")
+
+
+def parse_symmetry_op(op: str) -> tuple[np.ndarray, np.ndarray]:
+    """'x,y,z'-style affine operator string -> (rotation [3,3], translation [3]).
+
+    Handles terms like '-x', '1/2+y', 'x-y', '0.25+z'. Implemented as a hand
+    parser (no eval) over '+'/'-'-separated terms.
+    """
+    rot = np.zeros((3, 3), dtype=np.float64)
+    trans = np.zeros(3, dtype=np.float64)
+    parts = op.lower().replace(" ", "").split(",")
+    if len(parts) != 3:
+        raise CIFError(f"bad symmetry op {op!r}")
+    axis = {"x": 0, "y": 1, "z": 2}
+    for row, expr in enumerate(parts):
+        # split into signed terms
+        terms = re.findall(r"[+-]?[^+-]+", expr)
+        if not terms:
+            raise CIFError(f"bad symmetry expression {expr!r} in {op!r}")
+        for term in terms:
+            sign = -1.0 if term.startswith("-") else 1.0
+            body = term.lstrip("+-")
+            if body in axis:
+                rot[row, axis[body]] += sign
+            else:
+                m = _FRAC_RE.fullmatch(body)
+                if m:
+                    trans[row] += sign * int(m.group(1)) / int(m.group(2))
+                else:
+                    try:
+                        trans[row] += sign * float(body)
+                    except ValueError as e:
+                        raise CIFError(
+                            f"bad symmetry term {term!r} in {op!r}"
+                        ) from e
+    return rot, trans
+
+
+_SYMOP_TAGS = (
+    "_symmetry_equiv_pos_as_xyz",
+    "_space_group_symop_operation_xyz",
+)
+
+
+def parse_cif(text: str, occupancy_tol: float = 0.999) -> Structure:
+    """CIF text -> Structure (symmetry-expanded to the full cell, P1)."""
+    parsed = _parse_blocks(_tokenize(text))
+    items, loops = parsed["items"], parsed["loops"]
+
+    try:
+        cell = [
+            _parse_number(items[k])
+            for k in (
+                "_cell_length_a",
+                "_cell_length_b",
+                "_cell_length_c",
+                "_cell_angle_alpha",
+                "_cell_angle_beta",
+                "_cell_angle_gamma",
+            )
+        ]
+    except KeyError as e:
+        raise CIFError(f"missing cell parameter {e}") from e
+    lattice = lattice_from_parameters(*cell)
+
+    # Atom-site loop.
+    site_loop = None
+    for headers, rows in loops:
+        if any(h.startswith("_atom_site_fract") for h in headers):
+            site_loop = (headers, rows)
+            break
+    if site_loop is None:
+        raise CIFError("no _atom_site_ loop with fractional coordinates")
+    headers, rows = site_loop
+
+    def col(name: str) -> int | None:
+        return headers.index(name) if name in headers else None
+
+    ix = col("_atom_site_fract_x")
+    iy = col("_atom_site_fract_y")
+    iz = col("_atom_site_fract_z")
+    if None in (ix, iy, iz):
+        raise CIFError("atom-site loop lacks fract_x/y/z")
+    isym = col("_atom_site_type_symbol")
+    ilab = col("_atom_site_label")
+    iocc = col("_atom_site_occupancy")
+    if isym is None and ilab is None:
+        raise CIFError("atom-site loop lacks both type_symbol and label")
+
+    symbols, fracs = [], []
+    for row in rows:
+        if iocc is not None and row[iocc] not in (".", "?"):
+            occ = _parse_number(row[iocc])
+            if occ < occupancy_tol:
+                raise CIFError(
+                    f"partial occupancy {occ} unsupported (site {row})"
+                )
+        raw = row[isym] if isym is not None else row[ilab]
+        symbols.append(_symbol_from_label(raw))
+        fracs.append([_parse_number(row[i]) for i in (ix, iy, iz)])
+
+    # Symmetry operators (default: identity only == P1).
+    ops: list[tuple[np.ndarray, np.ndarray]] = []
+    for headers2, rows2 in loops:
+        for tag in _SYMOP_TAGS:
+            if tag in headers2:
+                j = headers2.index(tag)
+                ops = [parse_symmetry_op(r[j]) for r in rows2]
+                break
+        if ops:
+            break
+    for tag in _SYMOP_TAGS:  # non-loop single op
+        if not ops and tag in items and items[tag]:
+            ops = [parse_symmetry_op(items[tag])]
+    if not ops:
+        ops = [(np.eye(3), np.zeros(3))]
+
+    # Expand and deduplicate (wrap to [0,1), merge within tolerance).
+    out_fracs: list[np.ndarray] = []
+    out_numbers: list[int] = []
+    tol = 1e-3
+    for sym, frac in zip(symbols, fracs):
+        z = SYMBOL_TO_Z[sym]
+        base = np.asarray(frac, dtype=np.float64)
+        for rot, trans in ops:
+            pos = (rot @ base + trans) % 1.0
+            dup = False
+            for existing in out_fracs:
+                delta = np.abs(pos - existing)
+                delta = np.minimum(delta, 1.0 - delta)  # periodic distance
+                if np.all(delta < tol):
+                    dup = True
+                    break
+            if not dup:
+                out_fracs.append(pos)
+                out_numbers.append(z)
+
+    return Structure(lattice, np.array(out_fracs), np.array(out_numbers))
+
+
+def parse_cif_file(path) -> Structure:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return parse_cif(f.read())

@@ -1,0 +1,157 @@
+"""Dataset assembly: structures -> featurized CrystalGraphs -> splits.
+
+TPU-native counterpart of the reference's ``CIFData`` + loader factory
+(SURVEY.md §2 components 3, 12; §3.1). Differences by design:
+
+- Featurization is an *offline, cached* step producing flat-COO graphs
+  (SURVEY.md §7 phase 4: at 10k structures/s/chip, per-step CIF parsing is
+  impossible; preprocess once, stream tensors).
+- Neighbor layout is flat COO, truncated to ``max_num_nbr`` nearest like the
+  reference, but without fake padding edges — static shapes come from the
+  batcher (graph.py), not per-atom padding.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import os
+import warnings
+from typing import Sequence
+
+import numpy as np
+
+from cgnn_tpu.data.cif import parse_cif_file
+from cgnn_tpu.data.elements import atom_features
+from cgnn_tpu.data.featurize import GaussianDistance
+from cgnn_tpu.data.graph import CrystalGraph
+from cgnn_tpu.data.neighbors import knn_neighbor_list
+from cgnn_tpu.data.structure import Structure
+from cgnn_tpu.data.synthetic import synthetic_dataset
+
+
+@dataclasses.dataclass
+class FeaturizeConfig:
+    """Featurization hyperparameters (mirror the reference CLI flags)."""
+
+    radius: float = 8.0
+    max_num_nbr: int = 12
+    dmin: float = 0.0
+    step: float = 0.2
+
+    def gdf(self) -> GaussianDistance:
+        return GaussianDistance(self.dmin, self.radius, self.step)
+
+
+def featurize_structure(
+    structure: Structure,
+    target,
+    cfg: FeaturizeConfig,
+    cif_id: str = "",
+    gdf: GaussianDistance | None = None,
+    target_mask=None,
+    keep_geometry: bool = False,
+) -> CrystalGraph:
+    """Structure + label -> flat-COO CrystalGraph (host-side)."""
+    gdf = gdf or cfg.gdf()
+    nl = knn_neighbor_list(
+        structure, cfg.radius, cfg.max_num_nbr, warn_under_coordinated=False
+    )
+    if len(nl) == 0:
+        raise ValueError(
+            f"structure {cif_id!r} has no neighbors within radius {cfg.radius}"
+        )
+    graph = CrystalGraph(
+        atom_fea=atom_features(structure.numbers),
+        edge_fea=gdf.expand(nl.distances),
+        centers=nl.centers,
+        neighbors=nl.neighbors,
+        target=np.atleast_1d(np.asarray(target, np.float32)),
+        cif_id=cif_id,
+        target_mask=(
+            None if target_mask is None
+            else np.atleast_1d(np.asarray(target_mask, np.float32))
+        ),
+        distances=nl.distances,
+    )
+    if keep_geometry:
+        graph.positions = structure.cart_coords.astype(np.float32)
+        graph.lattice = structure.lattice.astype(np.float32)
+        graph.offsets = nl.offsets.astype(np.int32)
+    return graph
+
+
+def load_cif_directory(
+    root_dir: str,
+    cfg: FeaturizeConfig | None = None,
+    id_prop_file: str = "id_prop.csv",
+    keep_geometry: bool = False,
+) -> list[CrystalGraph]:
+    """Reference-compatible directory layout: ``{root}/{id}.cif`` + id_prop.csv.
+
+    Each id_prop.csv row is ``cif_id, target[, target2, ...]`` — multi-column
+    rows feed the multi-task head; empty cells become masked-out labels.
+    """
+    cfg = cfg or FeaturizeConfig()
+    gdf = cfg.gdf()
+    prop_path = os.path.join(root_dir, id_prop_file)
+    if not os.path.exists(prop_path):
+        raise FileNotFoundError(f"missing {prop_path}")
+    graphs: list[CrystalGraph] = []
+    with open(prop_path, newline="") as f:
+        for row in csv.reader(f):
+            if not row:
+                continue
+            cif_id = row[0].strip()
+            raw = [c.strip() for c in row[1:]]
+            target = np.array([float(c) if c else 0.0 for c in raw], np.float32)
+            mask = np.array([1.0 if c else 0.0 for c in raw], np.float32)
+            cif_path = os.path.join(root_dir, cif_id + ".cif")
+            try:
+                structure = parse_cif_file(cif_path)
+                graphs.append(
+                    featurize_structure(
+                        structure, target, cfg, cif_id, gdf,
+                        target_mask=mask, keep_geometry=keep_geometry,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — reference warns and skips
+                warnings.warn(f"skipping {cif_id}: {e}", stacklevel=2)
+    if not graphs:
+        raise ValueError(f"no usable structures under {root_dir}")
+    return graphs
+
+
+def load_synthetic(
+    num_structures: int,
+    cfg: FeaturizeConfig | None = None,
+    seed: int = 0,
+    keep_geometry: bool = False,
+    **synth_kwargs,
+) -> list[CrystalGraph]:
+    cfg = cfg or FeaturizeConfig()
+    gdf = cfg.gdf()
+    return [
+        featurize_structure(s, t, cfg, sid, gdf, keep_geometry=keep_geometry)
+        for sid, s, t in synthetic_dataset(num_structures, seed, **synth_kwargs)
+    ]
+
+
+def train_val_test_split(
+    graphs: Sequence[CrystalGraph],
+    train_ratio: float = 0.8,
+    val_ratio: float = 0.1,
+    seed: int = 0,
+) -> tuple[list[CrystalGraph], list[CrystalGraph], list[CrystalGraph]]:
+    """Deterministic shuffled split (reference: ratio-based sampler split)."""
+    if train_ratio + val_ratio >= 1.0 + 1e-9:
+        raise ValueError("train_ratio + val_ratio must leave room for test")
+    idx = np.random.default_rng(seed).permutation(len(graphs))
+    n_train = int(len(graphs) * train_ratio)
+    n_val = int(len(graphs) * val_ratio)
+    pick = lambda ids: [graphs[int(i)] for i in ids]  # noqa: E731
+    return (
+        pick(idx[:n_train]),
+        pick(idx[n_train : n_train + n_val]),
+        pick(idx[n_train + n_val :]),
+    )

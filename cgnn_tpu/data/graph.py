@@ -1,0 +1,235 @@
+"""Graph containers and static-shape batching for TPU.
+
+The reference batches variable-size crystal graphs by concatenation with a
+``crystal_atom_idx`` range list and a dense [N, M] neighbor layout
+(SURVEY.md §2 components 5-6). TPU/XLA wants static shapes, so this module
+uses the idiomatic flat-COO design instead (SURVEY.md §7 phase 2):
+
+- ``CrystalGraph``: one featurized crystal, host-side numpy, flat edge list.
+- ``GraphBatch``: many crystals packed into fixed-capacity node/edge/graph
+  slots with masks — a jraph-``GraphsTuple``-like pytree (jraph is not
+  installed). Padding edges point at node slot 0 and are masked; padding
+  nodes belong to graph slot 0 and are masked.
+- bucketed capacity selection (geometric growth) to bound XLA recompiles
+  while keeping padding waste low (SURVEY.md §5 "long-context analog").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import numpy as np
+from flax import struct
+
+
+@dataclasses.dataclass
+class CrystalGraph:
+    """One featurized crystal (host-side, numpy)."""
+
+    atom_fea: np.ndarray  # [N, D] float32
+    edge_fea: np.ndarray  # [E, G] float32 (Gaussian-expanded distances)
+    centers: np.ndarray  # [E] int32 — receiving atom i
+    neighbors: np.ndarray  # [E] int32 — source atom j
+    target: np.ndarray  # [T] float32
+    cif_id: str = ""
+    # geometry (kept for the differentiable force path — SURVEY.md §7 phase 7)
+    positions: np.ndarray | None = None  # [N, 3] cartesian
+    lattice: np.ndarray | None = None  # [3, 3]
+    offsets: np.ndarray | None = None  # [E, 3] int32 periodic images
+    distances: np.ndarray | None = None  # [E] raw distances
+    target_mask: np.ndarray | None = None  # [T] 1.0 where label present
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.atom_fea)
+
+    @property
+    def num_edges(self) -> int:
+        return len(self.centers)
+
+
+class GraphBatch(struct.PyTreeNode):
+    """Fixed-capacity packed batch of graphs (device-side pytree)."""
+
+    nodes: Any  # [Ncap, D] f32
+    edges: Any  # [Ecap, G] f32
+    centers: Any  # [Ecap] i32 (receiving node slot)
+    neighbors: Any  # [Ecap] i32 (source node slot)
+    node_graph: Any  # [Ncap] i32 (graph slot of each node)
+    node_mask: Any  # [Ncap] f32 (1 = real)
+    edge_mask: Any  # [Ecap] f32
+    graph_mask: Any  # [Gcap] f32
+    targets: Any  # [Gcap, T] f32
+    target_mask: Any  # [Gcap, T] f32 (multi-task missing labels)
+    # optional geometry for the force head; zeros when unused
+    positions: Any  # [Ncap, 3] f32
+    lattices: Any  # [Gcap, 3, 3] f32
+    edge_offsets: Any  # [Ecap, 3] f32
+
+    @property
+    def node_capacity(self) -> int:
+        return self.nodes.shape[0]
+
+    @property
+    def edge_capacity(self) -> int:
+        return self.edges.shape[0]
+
+    @property
+    def graph_capacity(self) -> int:
+        return self.targets.shape[0]
+
+    def num_real_graphs(self) -> Any:
+        return self.graph_mask.sum()
+
+
+def round_to_bucket(n: int, minimum: int = 64, growth: float = 1.3) -> int:
+    """Smallest capacity in the geometric bucket ladder that fits ``n``.
+
+    Geometric buckets bound the number of distinct compiled shapes to
+    O(log(max/min) / log(growth)) while wasting at most (growth-1) padding.
+    """
+    if n <= minimum:
+        return minimum
+    steps = math.ceil(math.log(n / minimum) / math.log(growth))
+    return int(math.ceil(minimum * growth**steps))
+
+
+def pack_graphs(
+    graphs: Sequence[CrystalGraph],
+    node_cap: int,
+    edge_cap: int,
+    graph_cap: int,
+    num_targets: int | None = None,
+) -> GraphBatch:
+    """Concatenate graphs into one fixed-capacity GraphBatch (numpy)."""
+    if not graphs:
+        raise ValueError("cannot pack an empty graph list")
+    n_graphs = len(graphs)
+    total_nodes = sum(g.num_nodes for g in graphs)
+    total_edges = sum(g.num_edges for g in graphs)
+    if n_graphs > graph_cap or total_nodes > node_cap or total_edges > edge_cap:
+        raise ValueError(
+            f"batch ({n_graphs} graphs, {total_nodes} nodes, {total_edges} edges)"
+            f" exceeds capacity ({graph_cap}, {node_cap}, {edge_cap})"
+        )
+    node_dim = graphs[0].atom_fea.shape[1]
+    edge_dim = graphs[0].edge_fea.shape[1]
+    tdim = num_targets or int(np.atleast_1d(graphs[0].target).shape[0])
+
+    nodes = np.zeros((node_cap, node_dim), np.float32)
+    edges = np.zeros((edge_cap, edge_dim), np.float32)
+    centers = np.zeros(edge_cap, np.int32)
+    neighbors = np.zeros(edge_cap, np.int32)
+    node_graph = np.zeros(node_cap, np.int32)
+    node_mask = np.zeros(node_cap, np.float32)
+    edge_mask = np.zeros(edge_cap, np.float32)
+    graph_mask = np.zeros(graph_cap, np.float32)
+    targets = np.zeros((graph_cap, tdim), np.float32)
+    target_mask = np.zeros((graph_cap, tdim), np.float32)
+    positions = np.zeros((node_cap, 3), np.float32)
+    lattices = np.zeros((graph_cap, 3, 3), np.float32)
+    edge_offsets = np.zeros((edge_cap, 3), np.float32)
+
+    node_off, edge_off = 0, 0
+    for gi, g in enumerate(graphs):
+        nn, ne = g.num_nodes, g.num_edges
+        nodes[node_off : node_off + nn] = g.atom_fea
+        node_graph[node_off : node_off + nn] = gi
+        node_mask[node_off : node_off + nn] = 1.0
+        edges[edge_off : edge_off + ne] = g.edge_fea
+        centers[edge_off : edge_off + ne] = g.centers + node_off
+        neighbors[edge_off : edge_off + ne] = g.neighbors + node_off
+        edge_mask[edge_off : edge_off + ne] = 1.0
+        t = np.atleast_1d(np.asarray(g.target, np.float32))
+        targets[gi, : len(t)] = t
+        if g.target_mask is not None:
+            target_mask[gi, : len(t)] = np.atleast_1d(g.target_mask)
+        else:
+            target_mask[gi, : len(t)] = 1.0
+        graph_mask[gi] = 1.0
+        if g.positions is not None:
+            positions[node_off : node_off + nn] = g.positions
+        if g.lattice is not None:
+            lattices[gi] = g.lattice
+        if g.offsets is not None and ne:
+            edge_offsets[edge_off : edge_off + ne] = g.offsets
+        node_off += nn
+        edge_off += ne
+
+    return GraphBatch(
+        nodes=nodes,
+        edges=edges,
+        centers=centers,
+        neighbors=neighbors,
+        node_graph=node_graph,
+        node_mask=node_mask,
+        edge_mask=edge_mask,
+        graph_mask=graph_mask,
+        targets=targets,
+        target_mask=target_mask,
+        positions=positions,
+        lattices=lattices,
+        edge_offsets=edge_offsets,
+    )
+
+
+def pad_batch(
+    graphs: Sequence[CrystalGraph],
+    graph_cap: int,
+    bucket_min_nodes: int = 64,
+    bucket_min_edges: int = 512,
+    growth: float = 1.3,
+) -> GraphBatch:
+    """Pack with bucketed node/edge capacities chosen from the batch content."""
+    node_cap = round_to_bucket(
+        sum(g.num_nodes for g in graphs), bucket_min_nodes, growth
+    )
+    edge_cap = round_to_bucket(
+        sum(g.num_edges for g in graphs), bucket_min_edges, growth
+    )
+    return pack_graphs(graphs, node_cap, edge_cap, graph_cap)
+
+
+def batch_iterator(
+    graphs: Sequence[CrystalGraph],
+    batch_size: int,
+    node_cap: int,
+    edge_cap: int,
+    shuffle: bool = False,
+    rng: np.random.Generator | None = None,
+    drop_last: bool = False,
+):
+    """Yield fixed-shape GraphBatches of ``batch_size`` graphs each.
+
+    All batches share one (node_cap, edge_cap, graph_cap) shape so the jitted
+    train step compiles exactly once. Oversize batches (rare tail events) are
+    split greedily rather than dropped.
+    """
+    order = np.arange(len(graphs))
+    if shuffle:
+        (rng or np.random.default_rng()).shuffle(order)
+    bucket: list[CrystalGraph] = []
+    nn = ne = 0
+    for idx in order:
+        g = graphs[int(idx)]
+        if g.num_nodes > node_cap or g.num_edges > edge_cap:
+            raise ValueError(
+                f"graph {g.cif_id!r} ({g.num_nodes} nodes, {g.num_edges} edges) "
+                f"exceeds batch capacity ({node_cap}, {edge_cap}); "
+                f"increase caps or filter the dataset"
+            )
+        if bucket and (
+            len(bucket) == batch_size
+            or nn + g.num_nodes > node_cap
+            or ne + g.num_edges > edge_cap
+        ):
+            yield pack_graphs(bucket, node_cap, edge_cap, batch_size)
+            bucket, nn, ne = [], 0, 0
+        bucket.append(g)
+        nn += g.num_nodes
+        ne += g.num_edges
+    # drop_last drops only an *incomplete* tail (standard loader semantics)
+    if bucket and (not drop_last or len(bucket) == batch_size):
+        yield pack_graphs(bucket, node_cap, edge_cap, batch_size)

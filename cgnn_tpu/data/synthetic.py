@@ -1,0 +1,96 @@
+"""Synthetic crystal generator + packaged toy datasets.
+
+Stands in for Materials Project / OC20 / MD17 downloads, which are
+unavailable offline (SURVEY.md §7 phase 0). Structures are random perturbed
+lattices with a smooth, physically-flavored synthetic target so training
+curves are meaningful (loss must beat a mean predictor — SURVEY.md §4.4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cgnn_tpu.data.elements import ELEMENTS
+from cgnn_tpu.data.structure import Structure, lattice_from_parameters
+
+# A spread of common elements across blocks (s/p/d) for synthetic crystals.
+_SYNTH_ELEMENTS = np.array(
+    [1, 3, 6, 7, 8, 9, 11, 12, 13, 14, 15, 16, 17, 19, 20, 22, 24, 26, 27,
+     28, 29, 30, 31, 33, 38, 40, 42, 47, 50, 56, 74, 79, 82],
+    dtype=np.int32,
+)
+
+
+def random_structure(
+    rng: np.random.Generator,
+    min_atoms: int = 2,
+    max_atoms: int = 12,
+    a_range: tuple[float, float] = (3.5, 7.5),
+) -> Structure:
+    """Random near-orthorhombic cell with a minimum-separation rejection pass."""
+    n = int(rng.integers(min_atoms, max_atoms + 1))
+    abc = rng.uniform(*a_range, size=3) * (1.0 + 0.15 * (n / max_atoms))
+    angles = rng.uniform(80.0, 100.0, size=3)
+    lattice = lattice_from_parameters(*abc, *angles)
+    # place atoms with a crude minimum-distance rejection (not physical, just
+    # avoids coincident sites which would create zero-distance edges)
+    fracs: list[np.ndarray] = []
+    for _ in range(n):
+        for _attempt in range(64):
+            cand = rng.uniform(0, 1, size=3)
+            if all(
+                np.linalg.norm(((cand - f + 0.5) % 1.0 - 0.5) @ lattice) > 1.2
+                for f in fracs
+            ):
+                break
+        fracs.append(cand)
+    numbers = rng.choice(_SYNTH_ELEMENTS, size=n)
+    return Structure(lattice, np.array(fracs), numbers)
+
+
+def synthetic_target(structure: Structure, noise: float = 0.0,
+                     rng: np.random.Generator | None = None) -> float:
+    """Smooth function of composition + geometry (a fake formation energy).
+
+    Mixes per-element electronegativity/radius with a pairwise soft-coordination
+    term so the target depends on both node features and graph structure —
+    i.e. a model that ignores edges cannot fit it.
+    """
+    en = np.array(
+        [ELEMENTS[int(z)][4] if ELEMENTS[int(z)][4] == ELEMENTS[int(z)][4] else 1.5
+         for z in structure.numbers]
+    )
+    rad = np.array([ELEMENTS[int(z)][5] for z in structure.numbers]) / 100.0
+    comp = float(np.mean(-0.8 * en + 0.3 * rad))
+    # soft coordination: pairwise periodic min-image distances under 4.5 Å
+    cart = structure.cart_coords
+    lat = structure.lattice
+    coord = 0.0
+    n = structure.num_atoms
+    for i in range(n):
+        d_frac = (structure.frac_coords - structure.frac_coords[i] + 0.5) % 1.0 - 0.5
+        d = np.linalg.norm(d_frac @ lat, axis=1)
+        d = d[d > 1e-8]
+        coord += float(np.sum(np.exp(-((d / 2.5) ** 2))))
+    coord /= n
+    target = comp - 0.35 * coord
+    if noise and rng is not None:
+        target += float(rng.normal(0, noise))
+    return target
+
+
+def synthetic_dataset(
+    num_structures: int,
+    seed: int = 0,
+    noise: float = 0.01,
+    min_atoms: int = 2,
+    max_atoms: int = 12,
+) -> list[tuple[str, Structure, float]]:
+    """[(id, Structure, target)] — deterministic given the seed."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(num_structures):
+        s = random_structure(rng, min_atoms, max_atoms)
+        t = synthetic_target(s, noise, rng)
+        out.append((f"synth-{i:06d}", s, t))
+    return out

@@ -1,0 +1,15 @@
+"""Test configuration: run JAX on 8 virtual CPU devices (SURVEY.md §4.5).
+
+Must run before jax is imported anywhere — pytest imports conftest first.
+The real TPU chip is exercised separately by bench.py and the driver's
+compile checks, not by the unit suite.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

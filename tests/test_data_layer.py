@@ -1,0 +1,278 @@
+"""Data-layer unit tests: elements, featurization, CIF parsing, containers.
+
+Covers SURVEY.md §4.2 (golden values, round-trips) for the host-side pieces.
+"""
+
+import numpy as np
+import pytest
+
+from cgnn_tpu.data.elements import (
+    ATOM_FEA_DIM,
+    ELEMENTS,
+    atom_features,
+    full_embedding_table,
+)
+from cgnn_tpu.data.featurize import GaussianDistance
+from cgnn_tpu.data.cif import CIFError, parse_cif, parse_symmetry_op
+from cgnn_tpu.data.structure import Structure, lattice_from_parameters
+from cgnn_tpu.data.graph import (
+    CrystalGraph,
+    pack_graphs,
+    batch_iterator,
+    round_to_bucket,
+)
+from cgnn_tpu.data.dataset import FeaturizeConfig, featurize_structure
+from cgnn_tpu.data.synthetic import random_structure, synthetic_dataset
+
+
+class TestElements:
+    def test_dim_and_dtype(self):
+        fea = atom_features([1, 8, 26, 92])
+        assert fea.shape == (4, ATOM_FEA_DIM)
+        assert fea.dtype == np.float32
+        assert set(np.unique(fea)) <= {0.0, 1.0}
+
+    def test_table_complete(self):
+        table = full_embedding_table()
+        assert table.shape == (101, 92)
+        assert np.all(table[0] == 0)
+        # every real element must have group/period/block one-hots set
+        for z in range(1, 101):
+            assert table[z, :18].sum() == 1.0, f"group missing for Z={z}"
+            assert table[z, 18:26].sum() == 1.0, f"period missing for Z={z}"
+
+    def test_distinct_elements_distinct_features(self):
+        table = full_embedding_table()
+        # common elements should be pairwise distinguishable
+        common = [1, 3, 6, 7, 8, 9, 11, 14, 16, 26, 29, 79]
+        for i, a in enumerate(common):
+            for b in common[i + 1 :]:
+                assert not np.array_equal(table[a], table[b]), (a, b)
+
+    def test_unknown_z_raises(self):
+        with pytest.raises(KeyError):
+            atom_features([150])
+
+    def test_nan_properties_give_zero_segment(self):
+        he = atom_features([2])[0]
+        # electronegativity bins are dims 26..36 — He has no Pauling EN
+        assert he[26:36].sum() == 0.0
+
+
+class TestGaussianDistance:
+    def test_golden(self):
+        gdf = GaussianDistance(dmin=0.0, dmax=8.0, step=0.2)
+        assert gdf.num_features == 41
+        out = gdf.expand(np.array([1.0]))
+        assert out.shape == (1, 41)
+        # peak at mu=1.0 (bin 5), value exp(0)=1
+        assert out[0, 5] == pytest.approx(1.0, abs=1e-6)
+        # neighbor bin: exp(-(0.2^2)/0.2^2) = e^-1
+        assert out[0, 4] == pytest.approx(np.exp(-1.0), rel=1e-5)
+
+    def test_shapes(self):
+        gdf = GaussianDistance()
+        assert gdf.expand(np.zeros((7, 3))).shape == (7, 3, 41)
+
+
+class TestLattice:
+    def test_cubic(self):
+        lat = lattice_from_parameters(4, 4, 4, 90, 90, 90)
+        np.testing.assert_allclose(lat, np.eye(3) * 4, atol=1e-12)
+
+    def test_volume_triclinic(self):
+        lat = lattice_from_parameters(3, 4, 5, 80, 95, 103)
+        s = Structure(lat, [[0, 0, 0]], [6])
+        assert 0 < s.volume < 60
+
+    def test_cart_roundtrip(self):
+        lat = lattice_from_parameters(3.1, 4.2, 5.3, 82, 94, 101)
+        frac = np.array([[0.1, 0.7, 0.3]])
+        s = Structure(lat, frac, [14])
+        back = s.cart_coords @ np.linalg.inv(lat)
+        np.testing.assert_allclose(back, frac, atol=1e-12)
+
+
+NACL_CIF = """
+data_NaCl
+_cell_length_a 5.64
+_cell_length_b 5.64
+_cell_length_c 5.64
+_cell_angle_alpha 90
+_cell_angle_beta 90
+_cell_angle_gamma 90
+loop_
+_atom_site_label
+_atom_site_type_symbol
+_atom_site_fract_x
+_atom_site_fract_y
+_atom_site_fract_z
+Na1 Na 0.0 0.0 0.0
+Na2 Na 0.5 0.5 0.0
+Na3 Na 0.5 0.0 0.5
+Na4 Na 0.0 0.5 0.5
+Cl1 Cl 0.5 0.0 0.0
+Cl2 Cl 0.0 0.5 0.0
+Cl3 Cl 0.0 0.0 0.5
+Cl4 Cl 0.5 0.5 0.5
+"""
+
+SYMMETRY_CIF = """
+data_bcc_Fe
+_cell_length_a 2.87
+_cell_length_b 2.87
+_cell_length_c 2.87
+_cell_angle_alpha 90.0
+_cell_angle_beta 90.0
+_cell_angle_gamma 90.0
+loop_
+_symmetry_equiv_pos_as_xyz
+'x, y, z'
+'1/2+x, 1/2+y, 1/2+z'
+loop_
+_atom_site_label
+_atom_site_fract_x
+_atom_site_fract_y
+_atom_site_fract_z
+_atom_site_occupancy
+Fe1 0.0 0.0 0.0 1.0
+"""
+
+
+class TestCIF:
+    def test_p1(self):
+        s = parse_cif(NACL_CIF)
+        assert s.num_atoms == 8
+        assert sorted(s.numbers.tolist()) == [11] * 4 + [17] * 4
+
+    def test_symmetry_expansion(self):
+        s = parse_cif(SYMMETRY_CIF)
+        assert s.num_atoms == 2  # bcc: corner + body center
+        assert set(s.numbers.tolist()) == {26}
+        fracs = sorted(s.frac_coords.tolist())
+        np.testing.assert_allclose(fracs[1], [0.5, 0.5, 0.5], atol=1e-9)
+
+    def test_symmetry_op_parser(self):
+        rot, trans = parse_symmetry_op("-x, 1/2+y, x-z")
+        np.testing.assert_allclose(rot[0], [-1, 0, 0])
+        np.testing.assert_allclose(rot[1], [0, 1, 0])
+        np.testing.assert_allclose(rot[2], [1, 0, -1])
+        np.testing.assert_allclose(trans, [0, 0.5, 0])
+
+    def test_partial_occupancy_rejected(self):
+        bad = SYMMETRY_CIF.replace("Fe1 0.0 0.0 0.0 1.0", "Fe1 0.0 0.0 0.0 0.5")
+        with pytest.raises(CIFError, match="occupancy"):
+            parse_cif(bad)
+
+    def test_esd_numbers(self):
+        cif = NACL_CIF.replace("_cell_length_a 5.64", "_cell_length_a 5.64(2)")
+        assert parse_cif(cif).num_atoms == 8
+
+
+def _toy_graph(n_nodes, n_edges, target=1.0, seed=0):
+    rng = np.random.default_rng(seed)
+    return CrystalGraph(
+        atom_fea=rng.normal(size=(n_nodes, 92)).astype(np.float32),
+        edge_fea=rng.normal(size=(n_edges, 41)).astype(np.float32),
+        centers=rng.integers(0, n_nodes, n_edges).astype(np.int32),
+        neighbors=rng.integers(0, n_nodes, n_edges).astype(np.int32),
+        target=np.array([target], np.float32),
+        cif_id=f"toy-{seed}",
+    )
+
+
+class TestGraphBatch:
+    def test_pack_offsets_and_masks(self):
+        g1, g2 = _toy_graph(3, 10, 1.0, 1), _toy_graph(5, 20, 2.0, 2)
+        b = pack_graphs([g1, g2], node_cap=16, edge_cap=64, graph_cap=4)
+        assert b.nodes.shape == (16, 92)
+        assert b.node_mask.sum() == 8
+        assert b.edge_mask.sum() == 30
+        assert b.graph_mask.sum() == 2
+        # second graph's edges index into offset node slots
+        assert b.centers[10:30].min() >= 3
+        assert b.centers[10:30].max() < 8
+        np.testing.assert_array_equal(b.node_graph[:8], [0] * 3 + [1] * 5)
+        np.testing.assert_allclose(b.targets[:2, 0], [1.0, 2.0])
+
+    def test_capacity_overflow_raises(self):
+        with pytest.raises(ValueError, match="exceeds capacity"):
+            pack_graphs([_toy_graph(10, 5)], node_cap=4, edge_cap=64, graph_cap=2)
+
+    def test_bucket_ladder(self):
+        assert round_to_bucket(10, minimum=64) == 64
+        assert round_to_bucket(64, minimum=64) == 64
+        v1, v2 = round_to_bucket(65, minimum=64), round_to_bucket(1000, minimum=64)
+        assert v1 >= 65 and v2 >= 1000
+        # ladder is deterministic: same n -> same cap
+        assert round_to_bucket(999, minimum=64) == round_to_bucket(999, minimum=64)
+
+    def test_batch_iterator_fixed_shapes(self):
+        graphs = [_toy_graph(3 + i % 4, 10 + i % 7, seed=i) for i in range(20)]
+        batches = list(batch_iterator(graphs, batch_size=4, node_cap=64, edge_cap=256))
+        assert all(b.nodes.shape == (64, 92) for b in batches)
+        assert sum(int(b.graph_mask.sum()) for b in batches) == 20
+
+    def test_batch_iterator_respects_caps(self):
+        graphs = [_toy_graph(30, 100, seed=i) for i in range(4)]
+        batches = list(batch_iterator(graphs, batch_size=4, node_cap=64, edge_cap=512))
+        assert len(batches) == 2  # 2 graphs of 30 nodes fit per 64-node batch
+
+
+class TestSyntheticAndFeaturize:
+    def test_deterministic(self):
+        a = synthetic_dataset(3, seed=7)
+        b = synthetic_dataset(3, seed=7)
+        for (ida, sa, ta), (idb, sb, tb) in zip(a, b):
+            assert ida == idb and ta == tb
+            np.testing.assert_array_equal(sa.numbers, sb.numbers)
+
+    def test_featurize_structure(self):
+        rng = np.random.default_rng(0)
+        s = random_structure(rng)
+        g = featurize_structure(s, 1.5, FeaturizeConfig(radius=6.0, max_num_nbr=8),
+                                keep_geometry=True)
+        assert g.atom_fea.shape == (s.num_atoms, 92)
+        assert g.edge_fea.shape[1] == 31  # radius 6, step 0.2 -> 31 bins
+        assert g.centers.max() < s.num_atoms
+        # knn truncation: no atom exceeds max_num_nbr
+        assert np.bincount(g.centers).max() <= 8
+        assert g.positions.shape == (s.num_atoms, 3)
+
+
+class TestReviewRegressions:
+    """Regressions from the round-1 code review."""
+
+    def test_all_caps_labels(self):
+        from cgnn_tpu.data.cif import _symbol_from_label
+        assert _symbol_from_label("FE1") == "Fe"
+        assert _symbol_from_label("CA2") == "Ca"
+        assert _symbol_from_label("Fe2+") == "Fe"
+        assert _symbol_from_label("O1") == "O"
+        assert _symbol_from_label("OW") == "O"  # water oxygen label
+        assert _symbol_from_label("NB3") == "Nb"
+
+    def test_trailing_dot_numbers(self):
+        cif = NACL_CIF.replace("_cell_angle_alpha 90", "_cell_angle_alpha 90.")
+        assert parse_cif(cif).num_atoms == 8
+
+    def test_wrapped_halfopen(self):
+        s = Structure(np.eye(3) * 3.0, [[-1e-20, 0.5, 0.999999999]], [6])
+        w = s.wrapped()
+        assert np.all(w.frac_coords < 1.0)
+        assert np.all(w.frac_coords >= 0.0)
+
+    def test_drop_last_keeps_full_final_batch(self):
+        graphs = [_toy_graph(3, 10, seed=i) for i in range(8)]
+        batches = list(
+            batch_iterator(graphs, batch_size=4, node_cap=64, edge_cap=256,
+                           drop_last=True)
+        )
+        assert sum(int(b.graph_mask.sum()) for b in batches) == 8
+        # 9 graphs -> tail of 1 dropped
+        graphs9 = graphs + [_toy_graph(3, 10, seed=99)]
+        batches9 = list(
+            batch_iterator(graphs9, batch_size=4, node_cap=64, edge_cap=256,
+                           drop_last=True)
+        )
+        assert sum(int(b.graph_mask.sum()) for b in batches9) == 8

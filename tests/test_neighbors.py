@@ -1,0 +1,110 @@
+"""Periodic neighbor list: vectorized vs explicit-loop brute force.
+
+SURVEY.md §4.1: highest-risk in-house component (pymatgen unavailable) —
+property-test over random triclinic cells that both implementations return
+identical neighbor multisets and distances.
+"""
+
+import numpy as np
+import pytest
+
+from cgnn_tpu.data.neighbors import (
+    knn_neighbor_list,
+    neighbor_list,
+    neighbor_list_brute,
+)
+from cgnn_tpu.data.structure import Structure, lattice_from_parameters
+
+
+def _edge_set(nl):
+    return sorted(
+        zip(
+            nl.centers.tolist(),
+            nl.neighbors.tolist(),
+            map(tuple, nl.offsets.tolist()),
+            np.round(nl.distances, 5).tolist(),
+        )
+    )
+
+
+def _random_structure(rng, n_atoms):
+    abc = rng.uniform(2.5, 6.0, size=3)
+    angles = rng.uniform(60.0, 120.0, size=3)
+    while True:
+        try:
+            lat = lattice_from_parameters(*abc, *angles)
+            break
+        except ValueError:
+            angles = rng.uniform(70.0, 110.0, size=3)
+    fracs = rng.uniform(0, 1, size=(n_atoms, 3))
+    numbers = rng.integers(1, 80, size=n_atoms)
+    return Structure(lat, fracs, numbers)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_vectorized_matches_brute(seed):
+    rng = np.random.default_rng(seed)
+    s = _random_structure(rng, int(rng.integers(1, 6)))
+    radius = float(rng.uniform(2.0, 5.0))
+    fast = neighbor_list(s, radius)
+    slow = neighbor_list_brute(s, radius)
+    assert _edge_set(fast) == _edge_set(slow)
+
+
+def test_chunked_matches_unchunked():
+    rng = np.random.default_rng(42)
+    s = _random_structure(rng, 12)
+    full = neighbor_list(s, 4.0)
+    tiny_chunks = neighbor_list(s, 4.0, chunk_elems=10)
+    assert _edge_set(full) == _edge_set(tiny_chunks)
+
+
+def test_simple_cubic_coordination():
+    # simple cubic, a=3: 6 first neighbors at 3.0, 12 second at 3*sqrt(2)
+    s = Structure(np.eye(3) * 3.0, [[0, 0, 0]], [29])
+    nl = neighbor_list(s, 3.05)
+    assert len(nl) == 6
+    np.testing.assert_allclose(nl.distances, 3.0, atol=1e-5)
+    nl2 = neighbor_list(s, 3.0 * np.sqrt(2) + 0.01)
+    assert len(nl2) == 18
+
+
+def test_self_image_neighbors_included():
+    # one atom: neighbors are its own periodic copies only
+    s = Structure(np.eye(3) * 2.0, [[0.5, 0.5, 0.5]], [6])
+    nl = neighbor_list(s, 2.1)
+    assert len(nl) == 6
+    assert np.all(nl.centers == 0) and np.all(nl.neighbors == 0)
+    assert not any((o == (0, 0, 0)).all() for o in nl.offsets)
+
+
+def test_knn_truncation_orders_by_distance():
+    rng = np.random.default_rng(3)
+    s = _random_structure(rng, 5)
+    full = neighbor_list(s, 5.0)
+    m = 4
+    knn = knn_neighbor_list(s, 5.0, m, warn_under_coordinated=False)
+    counts = np.bincount(knn.centers, minlength=s.num_atoms)
+    assert counts.max() <= m
+    # kept edges per center must be the m smallest distances
+    for i in range(s.num_atoms):
+        all_d = np.sort(full.distances[full.centers == i])
+        kept = np.sort(knn.distances[knn.centers == i])
+        np.testing.assert_allclose(kept, all_d[: len(kept)], rtol=1e-6)
+
+
+def test_under_coordination_warns():
+    s = Structure(np.eye(3) * 4.0, [[0, 0, 0]], [29])
+    with pytest.warns(UserWarning, match="fewer than"):
+        knn_neighbor_list(s, 4.1, 12)
+
+
+def test_radius_symmetry():
+    # every edge (i -> j, off) has a mirror (j -> i, -off)
+    rng = np.random.default_rng(11)
+    s = _random_structure(rng, 4)
+    nl = neighbor_list(s, 4.0)
+    edges = set(zip(nl.centers.tolist(), nl.neighbors.tolist(),
+                    map(tuple, nl.offsets.tolist())))
+    for i, j, off in edges:
+        assert (j, i, tuple(-o for o in off)) in edges
