@@ -8,8 +8,15 @@ uses the idiomatic flat-COO design instead (SURVEY.md §7 phase 2):
 - ``CrystalGraph``: one featurized crystal, host-side numpy, flat edge list.
 - ``GraphBatch``: many crystals packed into fixed-capacity node/edge/graph
   slots with masks — a jraph-``GraphsTuple``-like pytree (jraph is not
-  installed). Padding edges point at node slot 0 and are masked; padding
-  nodes belong to graph slot 0 and are masked.
+  installed). Padding edges point at the LAST node slot and are masked;
+  padding nodes belong to graph slot 0 and are masked.
+
+  Invariant: ``centers`` is non-decreasing — ENFORCED by ``pack_graphs``
+  (edges are stable-sorted by center per graph at pack time; node offsets
+  grow monotonically across graphs; padding edges target the last slot).
+  The jitted aggregation can therefore pass ``indices_are_sorted=True`` to
+  XLA's scatter — an unchecked promise on TPU — and skip a device sort
+  (ops/segment.py).
 - bucketed capacity selection (geometric growth) to bound XLA recompiles
   while keeping padding waste low (SURVEY.md §5 "long-context analog").
 """
@@ -120,8 +127,10 @@ def pack_graphs(
 
     nodes = np.zeros((node_cap, node_dim), np.float32)
     edges = np.zeros((edge_cap, edge_dim), np.float32)
-    centers = np.zeros(edge_cap, np.int32)
-    neighbors = np.zeros(edge_cap, np.int32)
+    # padding edges point at the last node slot: keeps `centers` sorted
+    # (see module docstring) and their masked zero messages harmless
+    centers = np.full(edge_cap, node_cap - 1, np.int32)
+    neighbors = np.full(edge_cap, node_cap - 1, np.int32)
     node_graph = np.zeros(node_cap, np.int32)
     node_mask = np.zeros(node_cap, np.float32)
     edge_mask = np.zeros(edge_cap, np.float32)
@@ -138,9 +147,17 @@ def pack_graphs(
         nodes[node_off : node_off + nn] = g.atom_fea
         node_graph[node_off : node_off + nn] = gi
         node_mask[node_off : node_off + nn] = 1.0
-        edges[edge_off : edge_off + ne] = g.edge_fea
-        centers[edge_off : edge_off + ne] = g.centers + node_off
-        neighbors[edge_off : edge_off + ne] = g.neighbors + node_off
+        # stable-sort edges by center so the batch-wide `centers` vector is
+        # non-decreasing (the module-level sortedness invariant); no-op for
+        # knn_neighbor_list output, which is already center-sorted
+        order = (
+            np.arange(ne)
+            if ne == 0 or np.all(np.diff(g.centers) >= 0)
+            else np.argsort(g.centers, kind="stable")
+        )
+        edges[edge_off : edge_off + ne] = g.edge_fea[order]
+        centers[edge_off : edge_off + ne] = g.centers[order] + node_off
+        neighbors[edge_off : edge_off + ne] = g.neighbors[order] + node_off
         edge_mask[edge_off : edge_off + ne] = 1.0
         t = np.atleast_1d(np.asarray(g.target, np.float32))
         targets[gi, : len(t)] = t
@@ -154,7 +171,7 @@ def pack_graphs(
         if g.lattice is not None:
             lattices[gi] = g.lattice
         if g.offsets is not None and ne:
-            edge_offsets[edge_off : edge_off + ne] = g.offsets
+            edge_offsets[edge_off : edge_off + ne] = g.offsets[order]
         node_off += nn
         edge_off += ne
 

@@ -1,0 +1,19 @@
+"""Model layer: Flax crystal-graph networks (SURVEY.md §2 components 6-7).
+
+The reference's ``model.py`` (``ConvLayer`` + ``CrystalGraphConvNet``,
+PyTorch, dense [N, M] neighbor layout) is rebuilt here on flat COO edges with
+masked ops — the idiomatic XLA/segment-op shape (SURVEY.md §7 phase 2).
+"""
+
+from cgnn_tpu.models.cgcnn import CGConv, CrystalGraphConvNet
+from cgnn_tpu.models.heads import MultiTaskHead, ForceHead
+from cgnn_tpu.models.forcefield import ForceFieldCGCNN, energy_and_forces
+
+__all__ = [
+    "CGConv",
+    "CrystalGraphConvNet",
+    "MultiTaskHead",
+    "ForceHead",
+    "ForceFieldCGCNN",
+    "energy_and_forces",
+]

@@ -1,0 +1,110 @@
+"""Differentiable force field: positions -> energy -> forces by autodiff.
+
+BASELINE.json config #5 (MD17 per-atom force head) requires forces. The
+reference lineage's data path precomputes distances on the host, which cuts
+the autodiff graph at the geometry — so this model recomputes displacement
+vectors *inside* the forward pass from positions + neighbor indices +
+periodic image offsets (SURVEY.md §7 phase 7). Forces are then exactly
+``F = -dE/dr`` and automatically rotation-equivariant, because E depends on
+positions only through interatomic distances.
+
+The conv trunk reuses CGConv; only the edge featurization moves in-model.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from cgnn_tpu.data.graph import GraphBatch
+from cgnn_tpu.models.cgcnn import CGConv
+from cgnn_tpu.models.heads import ForceHead
+from cgnn_tpu.ops.segment import segment_sum
+
+
+def gaussian_expand(d: jax.Array, dmin: float, dmax: float, step: float) -> jax.Array:
+    """jnp twin of data/featurize.py GaussianDistance (differentiable)."""
+    mu = jnp.arange(dmin, dmax + step, step, dtype=d.dtype)
+    return jnp.exp(-((d[..., None] - mu) ** 2) / step**2)
+
+
+def edge_distances(batch: GraphBatch, positions: jax.Array) -> jax.Array:
+    """Per-edge periodic distances recomputed from positions (differentiable).
+
+    ``positions`` is passed explicitly (not read from the batch) so callers
+    can take gradients with respect to it.
+    """
+    lat_e = batch.lattices[batch.node_graph[batch.centers]]  # [E, 3, 3]
+    shift = jnp.einsum("ek,ekj->ej", batch.edge_offsets, lat_e)
+    rel = positions[batch.neighbors] + shift - positions[batch.centers]
+    # epsilon under the sqrt keeps the gradient finite on masked padding
+    # edges (rel == 0); real edges have d >> eps so values are unaffected
+    return jnp.sqrt(jnp.sum(rel * rel, axis=-1) + 1e-12)
+
+
+class ForceFieldCGCNN(nn.Module):
+    """CGCNN trunk + per-atom energy readout over in-model edge features."""
+
+    atom_fea_len: int = 64
+    n_conv: int = 3
+    h_fea_len: int = 64
+    dmin: float = 0.0
+    dmax: float = 8.0
+    step: float = 0.2
+    dtype: Any = jnp.float32
+    aggregation_impl: str | None = None
+
+    @nn.compact
+    def __call__(
+        self, batch: GraphBatch, positions: jax.Array, train: bool = False
+    ) -> jax.Array:
+        """-> per-graph total energies [G] (padding slots zero)."""
+        d = edge_distances(batch, positions)
+        edge_fea = gaussian_expand(
+            d.astype(self.dtype), self.dmin, self.dmax, self.step
+        ) * batch.edge_mask[:, None].astype(self.dtype)
+        nodes = nn.Dense(self.atom_fea_len, dtype=self.dtype, name="embedding")(
+            batch.nodes.astype(self.dtype)
+        )
+        nodes = nodes * batch.node_mask[:, None].astype(nodes.dtype)
+        for i in range(self.n_conv):
+            nodes = CGConv(
+                features=self.atom_fea_len,
+                dtype=self.dtype,
+                aggregation_impl=self.aggregation_impl,
+                name=f"conv_{i}",
+            )(
+                nodes,
+                edge_fea,
+                batch.centers,
+                batch.neighbors,
+                batch.edge_mask,
+                batch.node_mask,
+                train=train,
+            )
+        atom_energy = ForceHead(h_fea_len=self.h_fea_len, dtype=self.dtype)(
+            nodes, batch.node_mask
+        )
+        per_graph = segment_sum(
+            atom_energy.astype(jnp.float32), batch.node_graph, batch.graph_capacity
+        )
+        return per_graph * batch.graph_mask
+
+
+def energy_and_forces(
+    model: ForceFieldCGCNN, variables, batch: GraphBatch, train: bool = False
+):
+    """(energies [G], forces [N, 3]) with F = -dE_total/dpositions."""
+
+    def total_energy(pos):
+        e = model.apply(variables, batch, pos, train=train)
+        return jnp.sum(e), e
+
+    (_, energies), grad_pos = jax.value_and_grad(total_energy, has_aux=True)(
+        batch.positions
+    )
+    forces = -grad_pos * batch.node_mask[:, None]
+    return energies, forces

@@ -1,0 +1,61 @@
+"""Output heads: multi-task regression and per-atom readouts.
+
+The reference's multi-task config (BASELINE.json config #3: formation energy
++ band gap + bulk/shear modulus) shares one conv trunk and predicts several
+scalars. Missing labels are handled by ``target_mask`` in the loss, so
+datasets with partial label coverage batch together.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MultiTaskHead(nn.Module):
+    """Per-task MLP stacks over shared pooled crystal features.
+
+    Richer than the single shared ``fc_out`` with T outputs (which
+    CrystalGraphConvNet(num_targets=T) already provides): each task gets its
+    own hidden stack, which matters when tasks have very different scales
+    (formation energy vs. bulk modulus).
+    """
+
+    num_tasks: int
+    h_fea_len: int = 128
+    n_h: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, pooled: jax.Array) -> jax.Array:  # [G, H] -> [G, T]
+        outs = []
+        for t in range(self.num_tasks):
+            h = pooled
+            for i in range(self.n_h - 1):
+                h = nn.softplus(
+                    nn.Dense(self.h_fea_len, dtype=self.dtype, name=f"task{t}_fc{i}")(h)
+                )
+            outs.append(nn.Dense(1, dtype=self.dtype, name=f"task{t}_out")(h))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class ForceHead(nn.Module):
+    """Per-atom scalar-energy readout (node features -> per-atom energy).
+
+    Used by the force-field model (models/forcefield.py): per-atom energies
+    are summed per crystal and forces come from ``-d(total energy)/d(positions)``
+    via autodiff — an equivariant readout by construction (energies depend on
+    positions only through interatomic distances).
+    """
+
+    h_fea_len: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, node_fea: jax.Array, node_mask: jax.Array) -> jax.Array:
+        h = nn.softplus(nn.Dense(self.h_fea_len, dtype=self.dtype, name="fc")(node_fea))
+        e = nn.Dense(1, dtype=self.dtype, name="out")(h)[:, 0]
+        return e * node_mask.astype(e.dtype)  # [N] per-atom energies

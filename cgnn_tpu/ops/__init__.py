@@ -1,0 +1,28 @@
+"""Device-side ops: segment reductions, masked normalization, Pallas kernels.
+
+TPU-native replacement for the reference's native kernel surface
+(SURVEY.md §2 "Native components" table): ATen gather + per-node reduction
+become XLA segment ops (and optionally a Pallas gather-scatter kernel), and
+cuDNN BatchNorm becomes an in-tree masked BatchNorm that keeps padding out of
+the batch statistics.
+"""
+
+from cgnn_tpu.ops.segment import (
+    segment_sum,
+    segment_mean,
+    segment_softmax_denom,
+    gather,
+    aggregate_edge_messages,
+    set_default_aggregation_impl,
+)
+from cgnn_tpu.ops.norm import MaskedBatchNorm
+
+__all__ = [
+    "segment_sum",
+    "segment_mean",
+    "segment_softmax_denom",
+    "gather",
+    "aggregate_edge_messages",
+    "set_default_aggregation_impl",
+    "MaskedBatchNorm",
+]

@@ -1,0 +1,83 @@
+"""Masked BatchNorm — padding-aware batch normalization.
+
+The reference normalizes over all N·M edge slots and all N node slots with
+cuDNN/ATen BatchNorm1d (SURVEY.md §2 component 6). On TPU the batch is padded
+to static capacity, and padding rows must not pollute the batch statistics
+(SURVEY.md §7 "hard parts" #3) — this module computes masked moments.
+
+Semantics mirror ``torch.nn.BatchNorm1d`` for the oracle parity harness
+(SURVEY.md §4.3):
+
+- normalization uses the *biased* batch variance (divide by n);
+- running-variance updates use the *unbiased* estimate (divide by n-1);
+- running stats update as ``running = (1-momentum)*running + momentum*batch``
+  with torch's default momentum 0.1;
+- eval mode normalizes with running stats.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MaskedBatchNorm(nn.Module):
+    """BatchNorm1d over rows [R, C] with an optional [R] validity mask."""
+
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+    # output dtype; statistics follow promote_types(input, float32), so
+    # float64 activations keep float64 running stats (oracle parity)
+    dtype: jnp.dtype | None = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        mask: jax.Array | None = None,
+        use_running_average: bool = False,
+    ) -> jax.Array:
+        features = x.shape[-1]
+        # statistics in >= float32 (float64 when the input is float64, for
+        # the double-precision oracle parity harness)
+        stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros(features, jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones(features, jnp.float32)
+        )
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(stat_dtype)
+            if mask is not None:
+                m = mask.astype(jnp.float32)
+                n = jnp.maximum(m.sum(), 1.0)
+                mean = (xf * m[:, None]).sum(axis=0) / n
+                var = (((xf - mean) ** 2) * m[:, None]).sum(axis=0) / n
+            else:
+                n = jnp.asarray(x.shape[0], stat_dtype)
+                mean = xf.mean(axis=0)
+                var = xf.var(axis=0)
+            if not self.is_initializing():
+                unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+                ra_mean.value = (
+                    (1.0 - self.momentum) * ra_mean.value + self.momentum * mean
+                )
+                ra_var.value = (
+                    (1.0 - self.momentum) * ra_var.value + self.momentum * unbiased
+                )
+
+        y = (x.astype(stat_dtype) - mean) * jax.lax.rsqrt(
+            var.astype(stat_dtype) + self.epsilon
+        )
+        if self.use_scale:
+            y = y * self.param("scale", nn.initializers.ones, (features,), jnp.float32)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros, (features,), jnp.float32)
+        return y.astype(self.dtype or x.dtype)

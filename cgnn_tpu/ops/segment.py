@@ -1,0 +1,126 @@
+"""Segment reductions and the edge-aggregation dispatch point.
+
+The reference's hottest device loop is the per-edge gather + per-node
+scatter-sum inside its conv layer (SURVEY.md §3.3): on GPU it is ATen
+``index_select`` + ``sum(dim=1)``. The TPU-native equivalents (SURVEY.md §2
+native table) are:
+
+- ``xla``: `jax.ops.segment_sum` over a flat COO edge list. XLA lowers this
+  to a sorted-scatter that fuses with the surrounding elementwise work and is
+  deterministic per compilation (unlike CUDA atomicAdd scatter).
+- ``pallas``: a hand-written gather-scatter kernel (cgnn_tpu.ops.pallas_scatter)
+  for the cases where XLA's scatter is not bandwidth-optimal.
+
+`aggregate_edge_messages` is the single dispatch point; the model layer never
+calls a backend directly, so benchmarking/falling back is a one-flag change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_IMPL = "xla"
+_VALID_IMPLS = ("xla", "pallas", "sort")
+
+
+def set_default_aggregation_impl(impl: str) -> None:
+    """Select the global default edge-aggregation backend ('xla'|'pallas'|'sort')."""
+    global _DEFAULT_IMPL
+    if impl not in _VALID_IMPLS:
+        raise ValueError(f"impl must be one of {_VALID_IMPLS}, got {impl!r}")
+    if impl == "pallas":  # fail eagerly, not from inside a jitted trace
+        import cgnn_tpu.ops.pallas_scatter  # noqa: F401
+    _DEFAULT_IMPL = impl
+
+
+def gather(values: jax.Array, indices: jax.Array) -> jax.Array:
+    """values[indices] — the edge-endpoint gather ([N, F] + [E] -> [E, F])."""
+    return jnp.take(values, indices, axis=0)
+
+
+def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Sum ``data`` rows into ``num_segments`` buckets (deterministic on TPU)."""
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    weights: jax.Array | None = None,
+) -> jax.Array:
+    """Masked segment mean: sum(w*x)/sum(w); empty segments return 0.
+
+    ``weights`` (e.g. a node mask) keeps padding rows out of both numerator
+    and denominator — this is the masked pooling from SURVEY.md §7 "hard
+    parts" #3.
+    """
+    if weights is not None:
+        data = data * weights[..., None]
+        denom = segment_sum(weights, segment_ids, num_segments)
+    else:
+        denom = segment_sum(jnp.ones(data.shape[0], data.dtype), segment_ids, num_segments)
+    total = segment_sum(data, segment_ids, num_segments)
+    return total / jnp.maximum(denom, 1.0)[..., None]
+
+
+def segment_softmax_denom(
+    logits: jax.Array, segment_ids: jax.Array, num_segments: int,
+    mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Numerically-stable per-segment softmax pieces (for attention readouts).
+
+    Returns (exp(logits - max_per_segment)[masked], denom_per_segment).
+    """
+    neg = jnp.finfo(logits.dtype).min
+    masked_logits = logits if mask is None else jnp.where(mask > 0, logits, neg)
+    seg_max = jax.ops.segment_max(masked_logits, segment_ids, num_segments=num_segments)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    ex = jnp.exp(masked_logits - seg_max[segment_ids])
+    if mask is not None:
+        ex = ex * mask
+    denom = segment_sum(ex, segment_ids, num_segments)
+    return ex, jnp.maximum(denom, jnp.finfo(logits.dtype).tiny)
+
+
+def _aggregate_sort(messages: jax.Array, centers: jax.Array, num_nodes: int) -> jax.Array:
+    """Sort-based aggregation: sort edges by center then segment-sum.
+
+    On TPU, scatter over a *sorted* index vector lowers to a cheaper
+    monotonic-update pattern; useful when the batcher cannot pre-sort.
+    """
+    order = jnp.argsort(centers)
+    return jax.ops.segment_sum(
+        jnp.take(messages, order, axis=0),
+        jnp.take(centers, order),
+        num_segments=num_nodes,
+        indices_are_sorted=True,
+    )
+
+
+def aggregate_edge_messages(
+    messages: jax.Array,
+    centers: jax.Array,
+    num_nodes: int,
+    impl: str | None = None,
+    indices_are_sorted: bool = True,
+) -> jax.Array:
+    """Scatter-sum per-edge messages into per-node accumulators.
+
+    The batcher (data/graph.py) emits edges sorted by center node, so the
+    default path tells XLA ``indices_are_sorted`` and avoids a device sort.
+    """
+    impl = impl or _DEFAULT_IMPL
+    if impl == "xla":
+        return jax.ops.segment_sum(
+            messages, centers, num_segments=num_nodes,
+            indices_are_sorted=indices_are_sorted,
+        )
+    if impl == "sort":
+        return _aggregate_sort(messages, centers, num_nodes)
+    if impl == "pallas":
+        from cgnn_tpu.ops.pallas_scatter import segment_sum_pallas
+
+        return segment_sum_pallas(messages, centers, num_nodes)
+    raise ValueError(f"unknown aggregation impl {impl!r}")

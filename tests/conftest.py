@@ -8,8 +8,19 @@ compile checks, not by the unit suite.
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# float64 support for the double-precision oracle parity harness
+os.environ.setdefault("JAX_ENABLE_X64", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# jax may already have been imported by a pytest plugin, in which case the
+# env vars above were read too late — force the settings through jax.config
+# too (honoring an explicit env opt-out, e.g. JAX_ENABLE_X64=0 pytest).
+import jax  # noqa: E402
+
+if os.environ.get("JAX_ENABLE_X64", "1").lower() not in ("0", "false"):
+    jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -1,0 +1,85 @@
+"""In-tree torch-CPU CGCNN oracle (SURVEY.md §4.3).
+
+The reference tree is unavailable (SURVEY.md §0), so this ~150-LoC PyTorch
+model — written fresh from the publicly-known CGCNN architecture spec
+(SURVEY.md §2 components 6-7, §3.3) — serves as the numerical ground truth
+for the JAX implementation: identical weights must produce identical
+forwards/gradients. Dense [N, M] neighbor layout, exactly as the lineage
+computes it. Test-only; never imported by the framework.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn as nn
+
+
+class ConvLayer(nn.Module):
+    """Edge-gated crystal-graph convolution, dense [N, M] layout."""
+
+    def __init__(self, atom_fea_len: int, nbr_fea_len: int):
+        super().__init__()
+        self.atom_fea_len = atom_fea_len
+        self.fc_full = nn.Linear(2 * atom_fea_len + nbr_fea_len, 2 * atom_fea_len)
+        self.bn1 = nn.BatchNorm1d(2 * atom_fea_len)
+        self.bn2 = nn.BatchNorm1d(atom_fea_len)
+
+    def forward(self, atom_in_fea, nbr_fea, nbr_fea_idx):
+        n, m = nbr_fea_idx.shape
+        atom_nbr_fea = atom_in_fea[nbr_fea_idx, :]  # [N, M, F] gather
+        total_fea = torch.cat(
+            [
+                atom_in_fea.unsqueeze(1).expand(n, m, self.atom_fea_len),
+                atom_nbr_fea,
+                nbr_fea,
+            ],
+            dim=2,
+        )
+        gated = self.fc_full(total_fea)
+        gated = self.bn1(gated.view(-1, 2 * self.atom_fea_len)).view(
+            n, m, 2 * self.atom_fea_len
+        )
+        nbr_filter, nbr_core = gated.chunk(2, dim=2)
+        nbr_sumed = torch.sum(
+            torch.sigmoid(nbr_filter) * nn.functional.softplus(nbr_core), dim=1
+        )
+        nbr_sumed = self.bn2(nbr_sumed)
+        return nn.functional.softplus(atom_in_fea + nbr_sumed)
+
+
+class TorchCGCNN(nn.Module):
+    """Full oracle model: embedding, n_conv ConvLayers, pooling, MLP head."""
+
+    def __init__(
+        self,
+        orig_atom_fea_len: int,
+        nbr_fea_len: int,
+        atom_fea_len: int = 64,
+        n_conv: int = 3,
+        h_fea_len: int = 128,
+        n_h: int = 1,
+        num_targets: int = 1,
+    ):
+        super().__init__()
+        self.embedding = nn.Linear(orig_atom_fea_len, atom_fea_len)
+        self.convs = nn.ModuleList(
+            ConvLayer(atom_fea_len, nbr_fea_len) for _ in range(n_conv)
+        )
+        self.conv_to_fc = nn.Linear(atom_fea_len, h_fea_len)
+        self.fcs = nn.ModuleList(
+            nn.Linear(h_fea_len, h_fea_len) for _ in range(n_h - 1)
+        )
+        self.fc_out = nn.Linear(h_fea_len, num_targets)
+
+    def forward(self, atom_fea, nbr_fea, nbr_fea_idx, crystal_atom_idx):
+        atom_fea = self.embedding(atom_fea)
+        for conv in self.convs:
+            atom_fea = conv(atom_fea, nbr_fea, nbr_fea_idx)
+        crys_fea = torch.stack(
+            [atom_fea[idx].mean(dim=0) for idx in crystal_atom_idx]
+        )
+        crys_fea = self.conv_to_fc(nn.functional.softplus(crys_fea))
+        crys_fea = nn.functional.softplus(crys_fea)
+        for fc in self.fcs:
+            crys_fea = nn.functional.softplus(fc(crys_fea))
+        return self.fc_out(crys_fea)

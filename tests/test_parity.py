@@ -1,0 +1,163 @@
+"""Oracle parity harness (SURVEY.md §4.3): JAX model vs torch-CPU CGCNN.
+
+Identical weights, identical graphs -> forward and gradients must agree.
+Structures are chosen so every atom has >= max_num_nbr neighbors in radius
+(small cells + periodic images guarantee it), so the oracle's dense [N, M]
+layout and our flat COO layout describe the same edge set and the batch
+contains no padding — making train-mode BatchNorm statistics comparable too.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+from cgnn_tpu.data.graph import pack_graphs
+from cgnn_tpu.models import CrystalGraphConvNet
+from tests.oracle.torch_cgcnn import TorchCGCNN
+
+ATOM_FEA_LEN = 24
+N_CONV = 2
+H_FEA_LEN = 32
+N_H = 2
+MAX_NBR = 12
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = FeaturizeConfig(radius=8.0, max_num_nbr=MAX_NBR)
+    graphs = load_synthetic(4, cfg, seed=11, max_atoms=8)
+    # dense-layout precondition: every atom saturates max_num_nbr
+    for g in graphs:
+        counts = np.bincount(g.centers, minlength=g.num_nodes)
+        assert np.all(counts == MAX_NBR), "test structures must be fully coordinated"
+
+    total_nodes = sum(g.num_nodes for g in graphs)
+    total_edges = sum(g.num_edges for g in graphs)
+    batch = pack_graphs(graphs, total_nodes, total_edges, len(graphs))
+
+    # oracle inputs: dense [N, M] from the same flat edge list
+    nbr_idx = np.asarray(batch.centers).reshape(total_nodes, MAX_NBR)
+    assert np.all(nbr_idx == np.arange(total_nodes)[:, None]), "edges sorted by center"
+    nbr_fea_idx = np.asarray(batch.neighbors).reshape(total_nodes, MAX_NBR)
+    nbr_fea = np.asarray(batch.edges).reshape(total_nodes, MAX_NBR, -1)
+    crystal_atom_idx = []
+    off = 0
+    for g in graphs:
+        crystal_atom_idx.append(torch.arange(off, off + g.num_nodes))
+        off += g.num_nodes
+
+    torch.manual_seed(0)
+    oracle = TorchCGCNN(
+        orig_atom_fea_len=batch.nodes.shape[1],
+        nbr_fea_len=nbr_fea.shape[-1],
+        atom_fea_len=ATOM_FEA_LEN,
+        n_conv=N_CONV,
+        h_fea_len=H_FEA_LEN,
+        n_h=N_H,
+    ).double()
+
+    model = CrystalGraphConvNet(
+        atom_fea_len=ATOM_FEA_LEN, n_conv=N_CONV, h_fea_len=H_FEA_LEN, n_h=N_H,
+        dtype=jnp.float64,
+    )
+    variables = variables_from_torch(oracle, model.init(jax.random.key(0), batch))
+    t_inputs = (
+        torch.from_numpy(np.asarray(batch.nodes, np.float64)),
+        torch.from_numpy(nbr_fea.astype(np.float64)),
+        torch.from_numpy(nbr_fea_idx.astype(np.int64)),
+        crystal_atom_idx,
+    )
+    return graphs, batch, oracle, model, variables, t_inputs
+
+
+def variables_from_torch(oracle: TorchCGCNN, template):
+    """Transplant oracle weights into the flax variable tree."""
+
+    def w(linear):  # torch [out, in] -> flax kernel [in, out]
+        return jnp.asarray(linear.weight.detach().numpy().T)
+
+    def b(linear):
+        return jnp.asarray(linear.bias.detach().numpy())
+
+    params = jax.tree_util.tree_map(lambda x: x, template["params"])
+    stats = jax.tree_util.tree_map(lambda x: x, template["batch_stats"])
+    params["embedding"] = {"kernel": w(oracle.embedding), "bias": b(oracle.embedding)}
+    for i, conv in enumerate(oracle.convs):
+        params[f"conv_{i}"]["fc_full"] = {"kernel": w(conv.fc_full), "bias": b(conv.fc_full)}
+        for bn_name, bn in (("bn1", conv.bn1), ("bn2", conv.bn2)):
+            params[f"conv_{i}"][bn_name] = {
+                "scale": jnp.asarray(bn.weight.detach().numpy()),
+                "bias": jnp.asarray(bn.bias.detach().numpy()),
+            }
+            stats[f"conv_{i}"][bn_name] = {
+                "mean": jnp.asarray(bn.running_mean.detach().numpy()),
+                "var": jnp.asarray(bn.running_var.detach().numpy()),
+            }
+    params["conv_to_fc"] = {"kernel": w(oracle.conv_to_fc), "bias": b(oracle.conv_to_fc)}
+    for i, fc in enumerate(oracle.fcs):
+        params[f"fc_{i}"] = {"kernel": w(fc), "bias": b(fc)}
+    params["fc_out"] = {"kernel": w(oracle.fc_out), "bias": b(oracle.fc_out)}
+    return {"params": params, "batch_stats": stats}
+
+
+class TestOracleParity:
+    def test_forward_eval(self, setup):
+        graphs, batch, oracle, model, variables, t_inputs = setup
+        oracle.eval()
+        with torch.no_grad():
+            ref = oracle(*t_inputs).numpy()
+        out = np.asarray(model.apply(variables, batch))
+        np.testing.assert_allclose(out[: len(graphs)], ref, rtol=1e-9, atol=1e-9)
+
+    def test_forward_train_batchstats(self, setup):
+        graphs, batch, oracle, model, variables, t_inputs = setup
+        oracle.train()
+        ref = oracle(*t_inputs).detach().numpy()
+        out, updated = model.apply(
+            variables, batch, train=True, mutable=["batch_stats"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(out)[: len(graphs)], ref, rtol=1e-8, atol=1e-8
+        )
+        # running stats updated identically (torch mutated oracle in-place)
+        for i, conv in enumerate(oracle.convs):
+            for bn_name, bn in (("bn1", conv.bn1), ("bn2", conv.bn2)):
+                got = updated["batch_stats"][f"conv_{i}"][bn_name]
+                np.testing.assert_allclose(
+                    got["mean"], bn.running_mean.numpy(), rtol=1e-8, atol=1e-10
+                )
+                np.testing.assert_allclose(
+                    got["var"], bn.running_var.numpy(), rtol=1e-8, atol=1e-10
+                )
+
+    def test_gradient_parity(self, setup):
+        graphs, batch, oracle, model, variables, t_inputs = setup
+        targets = np.linspace(-1.0, 1.0, len(graphs))
+
+        oracle.train()
+        oracle.zero_grad()
+        ref_out = oracle(*t_inputs)
+        loss = ((ref_out[:, 0] - torch.from_numpy(targets)) ** 2).mean()
+        loss.backward()
+
+        def loss_fn(params):
+            out, _ = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                batch, train=True, mutable=["batch_stats"],
+            )
+            err = out[: len(graphs), 0] - jnp.asarray(targets)
+            return jnp.mean(err**2)
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        pairs = [
+            (grads["embedding"]["kernel"], oracle.embedding.weight.grad.numpy().T),
+            (grads["conv_0"]["fc_full"]["kernel"], oracle.convs[0].fc_full.weight.grad.numpy().T),
+            (grads["conv_0"]["bn1"]["scale"], oracle.convs[0].bn1.weight.grad.numpy()),
+            (grads["fc_out"]["bias"], oracle.fc_out.bias.grad.numpy()),
+        ]
+        for got, ref in pairs:
+            np.testing.assert_allclose(got, ref, rtol=1e-7, atol=1e-9)
