@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Benchmark: training throughput in structures/sec/chip (BASELINE.md).
+
+Measures steady-state jitted train-step throughput on the flagship CGCNN
+config (64-dim, 3 conv layers — BASELINE.json config #2 shape) over
+synthetic MP-like crystals, with ``jax.block_until_ready`` fencing and
+compile excluded (SURVEY.md §6 measurement protocol).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is value / 10_000 (the driver's north-star target,
+BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+    from cgnn_tpu.data.graph import batch_iterator
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.loop import capacities_for
+    from cgnn_tpu.train.step import make_train_step
+
+    batch_size = 512
+    n_structures = 4096
+    graphs = load_synthetic(
+        n_structures, FeaturizeConfig(radius=6.0, max_num_nbr=12), seed=0
+    )
+    node_cap, edge_cap = capacities_for(graphs, batch_size)
+
+    batches = list(batch_iterator(graphs, batch_size, node_cap, edge_cap))
+    real_per_batch = [float(np.asarray(b.graph_mask).sum()) for b in batches]
+
+    model = CrystalGraphConvNet(
+        atom_fea_len=64, n_conv=3, h_fea_len=128, dtype=jax.numpy.bfloat16
+    )
+    tx = make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10_000])
+    normalizer = Normalizer.fit(np.stack([g.target for g in graphs]))
+    state = create_train_state(model, batches[0], tx, normalizer)
+
+    train_step = jax.jit(make_train_step(), donate_argnums=0)
+    device_batches = [jax.device_put(b) for b in batches]
+
+    # warmup: compile + 2 steps
+    state, _ = train_step(state, device_batches[0])
+    state, _ = train_step(state, device_batches[1 % len(device_batches)])
+    jax.block_until_ready(state.params)
+
+    # timed steady state
+    n_timed = 30
+    structures = 0.0
+    t0 = time.perf_counter()
+    for i in range(n_timed):
+        k = i % len(device_batches)
+        state, _ = train_step(state, device_batches[k])
+        structures += real_per_batch[k]
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    value = structures / dt
+    print(
+        json.dumps(
+            {
+                "metric": "train_structures_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "structures/sec/chip",
+                "vs_baseline": round(value / 10_000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Config dataclasses shared by train.py / predict.py (SURVEY.md §5).
+
+The reference embeds its argparse namespace inside checkpoints so
+``predict.py`` can rebuild the exact model; these dataclasses are that
+contract, serialized into checkpoint metadata as a flat dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    atom_fea_len: int = 64
+    n_conv: int = 3
+    h_fea_len: int = 128
+    n_h: int = 1
+    num_targets: int = 1
+    classification: bool = False
+    num_classes: int = 2
+    dropout: float = 0.0
+    dtype: str = "float32"  # 'float32' | 'bfloat16'
+    aggregation: str | None = None  # None -> global default
+
+    def to_meta(self) -> dict:
+        return dataclasses.asdict(self) | {
+            "aggregation": self.aggregation or "__none__"
+        }
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "ModelConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in meta.items() if k in fields}
+        kw["classification"] = bool(kw.get("classification", 0))
+        if kw.get("aggregation") in ("__none__", None):
+            kw["aggregation"] = None
+        return cls(**kw)
+
+    def build(self, head=None):
+        from cgnn_tpu.models import CrystalGraphConvNet
+
+        return CrystalGraphConvNet(
+            atom_fea_len=self.atom_fea_len,
+            n_conv=self.n_conv,
+            h_fea_len=self.h_fea_len,
+            n_h=self.n_h,
+            num_targets=self.num_targets,
+            classification=self.classification,
+            num_classes=self.num_classes,
+            dropout_rate=self.dropout,
+            dtype=jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32,
+            aggregation_impl=self.aggregation,
+            head=head,
+        )
+
+
+@dataclasses.dataclass
+class DataConfig:
+    radius: float = 8.0
+    max_num_nbr: int = 12
+    dmin: float = 0.0
+    step: float = 0.2
+
+    def to_meta(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "DataConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in meta.items() if k in fields})
+
+    def featurize_config(self):
+        from cgnn_tpu.data.dataset import FeaturizeConfig
+
+        return FeaturizeConfig(
+            radius=self.radius,
+            max_num_nbr=self.max_num_nbr,
+            dmin=self.dmin,
+            step=self.step,
+        )

@@ -97,14 +97,23 @@ class ForceFieldCGCNN(nn.Module):
 def energy_and_forces(
     model: ForceFieldCGCNN, variables, batch: GraphBatch, train: bool = False
 ):
-    """(energies [G], forces [N, 3]) with F = -dE_total/dpositions."""
+    """(energies [G], forces [N, 3], new_batch_stats) with F = -dE/dr.
+
+    ``new_batch_stats`` is None in eval mode; in train mode it carries the
+    updated BatchNorm running statistics for the caller's state update.
+    """
 
     def total_energy(pos):
-        e = model.apply(variables, batch, pos, train=train)
-        return jnp.sum(e), e
+        if train:
+            e, mutated = model.apply(
+                variables, batch, pos, train=True, mutable=["batch_stats"]
+            )
+            return jnp.sum(e), (e, mutated["batch_stats"])
+        e = model.apply(variables, batch, pos, train=False)
+        return jnp.sum(e), (e, None)
 
-    (_, energies), grad_pos = jax.value_and_grad(total_energy, has_aux=True)(
-        batch.positions
-    )
+    (_, (energies, new_stats)), grad_pos = jax.value_and_grad(
+        total_energy, has_aux=True
+    )(batch.positions)
     forces = -grad_pos * batch.node_mask[:, None]
-    return energies, forces
+    return energies, forces, new_stats

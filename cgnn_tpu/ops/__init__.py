@@ -10,7 +10,6 @@ the batch statistics.
 from cgnn_tpu.ops.segment import (
     segment_sum,
     segment_mean,
-    segment_softmax_denom,
     gather,
     aggregate_edge_messages,
     set_default_aggregation_impl,
@@ -20,7 +19,6 @@ from cgnn_tpu.ops.norm import MaskedBatchNorm
 __all__ = [
     "segment_sum",
     "segment_mean",
-    "segment_softmax_denom",
     "gather",
     "aggregate_edge_messages",
     "set_default_aggregation_impl",

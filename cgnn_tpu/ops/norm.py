@@ -56,21 +56,29 @@ class MaskedBatchNorm(nn.Module):
         else:
             xf = x.astype(stat_dtype)
             if mask is not None:
-                m = mask.astype(jnp.float32)
-                n = jnp.maximum(m.sum(), 1.0)
+                m = mask.astype(stat_dtype)
+                n_real = m.sum()
+                n = jnp.maximum(n_real, 1.0)
                 mean = (xf * m[:, None]).sum(axis=0) / n
                 var = (((xf - mean) ** 2) * m[:, None]).sum(axis=0) / n
             else:
-                n = jnp.asarray(x.shape[0], stat_dtype)
+                n_real = n = jnp.asarray(x.shape[0], stat_dtype)
                 mean = xf.mean(axis=0)
                 var = xf.var(axis=0)
             if not self.is_initializing():
+                # a fully-masked batch (all padding, e.g. an empty DP eval
+                # shard) must not decay the running stats toward (0, 0)
+                has_rows = n_real > 0
                 unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
-                ra_mean.value = (
-                    (1.0 - self.momentum) * ra_mean.value + self.momentum * mean
+                ra_mean.value = jnp.where(
+                    has_rows,
+                    (1.0 - self.momentum) * ra_mean.value + self.momentum * mean,
+                    ra_mean.value,
                 )
-                ra_var.value = (
-                    (1.0 - self.momentum) * ra_var.value + self.momentum * unbiased
+                ra_var.value = jnp.where(
+                    has_rows,
+                    (1.0 - self.momentum) * ra_var.value + self.momentum * unbiased,
+                    ra_var.value,
                 )
 
         y = (x.astype(stat_dtype) - mean) * jax.lax.rsqrt(

@@ -65,25 +65,6 @@ def segment_mean(
     return total / jnp.maximum(denom, 1.0)[..., None]
 
 
-def segment_softmax_denom(
-    logits: jax.Array, segment_ids: jax.Array, num_segments: int,
-    mask: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array]:
-    """Numerically-stable per-segment softmax pieces (for attention readouts).
-
-    Returns (exp(logits - max_per_segment)[masked], denom_per_segment).
-    """
-    neg = jnp.finfo(logits.dtype).min
-    masked_logits = logits if mask is None else jnp.where(mask > 0, logits, neg)
-    seg_max = jax.ops.segment_max(masked_logits, segment_ids, num_segments=num_segments)
-    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
-    ex = jnp.exp(masked_logits - seg_max[segment_ids])
-    if mask is not None:
-        ex = ex * mask
-    denom = segment_sum(ex, segment_ids, num_segments)
-    return ex, jnp.maximum(denom, jnp.finfo(logits.dtype).tiny)
-
-
 def _aggregate_sort(messages: jax.Array, centers: jax.Array, num_nodes: int) -> jax.Array:
     """Sort-based aggregation: sort edges by center then segment-sum.
 

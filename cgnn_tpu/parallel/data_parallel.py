@@ -1,0 +1,208 @@
+"""Data-parallel training over a mesh (SURVEY.md §2 parallelism inventory).
+
+The reference wraps its model in DDP: per-rank processes, NCCL allreduce on
+gradient buckets overlapped with backward (SURVEY.md §3.5). The TPU-native
+equivalent is one SPMD program: per-device packed GraphBatches are stacked
+on a leading device axis, sharded over ``Mesh(('data',))``, and the step
+body (cgnn_tpu.train.step with ``axis_name='data'``) runs under shard_map —
+``pmean`` on grads/BatchNorm stats becomes an ICI allreduce placed by XLA
+wherever it overlaps best. Batch semantics match DDP: identical params on
+every device, global batch = sum of per-device batches, metric sums are
+exact psum totals.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cgnn_tpu.data.graph import CrystalGraph, GraphBatch, batch_iterator
+from cgnn_tpu.train.state import TrainState
+from cgnn_tpu.train.step import make_eval_step, make_train_step
+
+
+def stack_batches(batches: Sequence[GraphBatch]) -> GraphBatch:
+    """Stack D same-shape batches on a new leading device axis."""
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+
+
+def empty_batch_like(batch: GraphBatch) -> GraphBatch:
+    """All-padding batch with the same capacities (masks are zero).
+
+    Used to pad the last eval step up to a full device group; contributes
+    exactly zero to psum-ed metric sums. Never use for training steps —
+    running-stat updates would average in its degenerate statistics.
+    """
+    ncap = batch.node_capacity
+    ecap = batch.edge_capacity
+    return GraphBatch(
+        nodes=np.zeros_like(batch.nodes),
+        edges=np.zeros_like(batch.edges),
+        centers=np.full_like(batch.centers, ncap - 1),
+        neighbors=np.full_like(batch.neighbors, ncap - 1),
+        node_graph=np.zeros_like(batch.node_graph),
+        node_mask=np.zeros_like(batch.node_mask),
+        edge_mask=np.zeros_like(batch.edge_mask),
+        graph_mask=np.zeros_like(batch.graph_mask),
+        targets=np.zeros_like(batch.targets),
+        target_mask=np.zeros_like(batch.target_mask),
+        positions=np.zeros_like(batch.positions),
+        lattices=np.zeros_like(batch.lattices),
+        edge_offsets=np.zeros_like(batch.edge_offsets),
+    )
+
+
+def parallel_batches(
+    graphs: Sequence[CrystalGraph],
+    n_devices: int,
+    batch_size: int,
+    node_cap: int,
+    edge_cap: int,
+    shuffle: bool = False,
+    rng: np.random.Generator | None = None,
+    pad_incomplete: bool = False,
+) -> Iterable[GraphBatch]:
+    """Yield device-stacked batches: leaves have leading axis [D, ...].
+
+    ``batch_size`` is per device (global batch = D * batch_size). Training
+    drops an incomplete trailing device group (DDP drop_last semantics);
+    eval pads it with empty batches so every structure is scored.
+    """
+    group: list[GraphBatch] = []
+    for b in batch_iterator(
+        graphs, batch_size, node_cap, edge_cap, shuffle=shuffle, rng=rng
+    ):
+        group.append(b)
+        if len(group) == n_devices:
+            yield stack_batches(group)
+            group = []
+    if group and pad_incomplete:
+        group += [empty_batch_like(group[0])] * (n_devices - len(group))
+        yield stack_batches(group)
+
+
+def shard_leading_axis(tree, mesh: Mesh):
+    """device_put a stacked batch with its leading axis split over 'data'."""
+    def put(x):
+        return jax.device_put(
+            x, NamedSharding(mesh, P("data", *([None] * (np.ndim(x) - 1)))))
+    return jax.tree_util.tree_map(put, tree)
+
+
+def _squeeze0(tree):
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def make_parallel_train_step(
+    mesh: Mesh, classification: bool = False, loss_fn: Callable | None = None
+) -> Callable:
+    """shard_map-wrapped train step: (replicated state, [D,...] batch)."""
+    inner = make_train_step(classification, axis_name="data", loss_fn=loss_fn)
+
+    def body(state: TrainState, stacked: GraphBatch):
+        return inner(state, _squeeze0(stacked))
+
+    smapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P(), P()),
+        check_vma=False,  # grads/stats are pmean-ed -> replicated outputs
+    )
+    return jax.jit(smapped, donate_argnums=0)
+
+
+def make_parallel_eval_step(
+    mesh: Mesh, classification: bool = False, loss_fn: Callable | None = None
+) -> Callable:
+    inner = make_eval_step(classification, axis_name="data", loss_fn=loss_fn)
+
+    def body(state: TrainState, stacked: GraphBatch):
+        return inner(state, _squeeze0(stacked))
+
+    smapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(smapped)
+
+
+def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place every state leaf replicated across the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), state
+    )
+
+
+def fit_data_parallel(
+    state: TrainState,
+    train_graphs: Sequence[CrystalGraph],
+    val_graphs: Sequence[CrystalGraph],
+    *,
+    epochs: int,
+    batch_size: int,
+    node_cap: int,
+    edge_cap: int,
+    classification: bool = False,
+    seed: int = 0,
+    print_freq: int = 10,
+    on_epoch_end: Callable | None = None,
+    log_fn: Callable = print,
+    start_epoch: int = 0,
+    mesh: Mesh | None = None,
+) -> tuple[TrainState, dict]:
+    """DP twin of train.loop.fit; ``batch_size`` is per device."""
+    from cgnn_tpu.parallel.mesh import make_mesh
+
+    mesh = mesh or make_mesh()
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    train_step = make_parallel_train_step(mesh, classification)
+    eval_step = make_parallel_eval_step(mesh, classification)
+    state = replicate_state(state, mesh)
+    best = -np.inf if classification else np.inf
+    history = []
+    rng = np.random.default_rng(seed)
+    for epoch in range(start_epoch, epochs):
+        t0 = time.perf_counter()
+        sums: dict[str, float] = {}
+        for stacked in parallel_batches(
+            train_graphs, n_dev, batch_size, node_cap, edge_cap,
+            shuffle=True, rng=rng,
+        ):
+            state, metrics = train_step(state, shard_leading_axis(stacked, mesh))
+            for k, v in jax.device_get(metrics).items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+        train_count = max(sums.get("count", 1.0), 1.0)
+        train_loss = sums.get("loss_sum", np.nan) / train_count
+
+        vsums: dict[str, float] = {}
+        for stacked in parallel_batches(
+            val_graphs, n_dev, batch_size, node_cap, edge_cap, pad_incomplete=True
+        ):
+            metrics = eval_step(state, shard_leading_axis(stacked, mesh))
+            for k, v in jax.device_get(metrics).items():
+                vsums[k] = vsums.get(k, 0.0) + float(v)
+        vcount = max(vsums.get("count", 1.0), 1.0)
+        val_m = {
+            k[: -len("_sum")]: v / vcount
+            for k, v in vsums.items() if k.endswith("_sum")
+        }
+        metric = val_m.get("correct" if classification else "mae", np.nan)
+        is_best = metric > best if classification else metric < best
+        if is_best:
+            best = metric
+        history.append({"epoch": epoch, "train_loss": train_loss, "val": val_m})
+        log_fn(
+            f"Epoch {epoch} [dp x{n_dev}]: train loss {train_loss:.4f}"
+            f"  val {'acc' if classification else 'mae'} {metric:.4f}"
+            f"{' *' if is_best else ''}  ({time.perf_counter() - t0:.1f}s)"
+        )
+        if on_epoch_end is not None:
+            on_epoch_end(state, epoch, val_m, is_best)
+    return state, {"best": best, "history": history}

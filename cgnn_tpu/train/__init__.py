@@ -1,0 +1,29 @@
+"""Training runtime (SURVEY.md §1 "Training runtime", §2 components 1, 8-12).
+
+The reference's ``main.py`` epoch loop, Normalizer, checkpointing, LR
+schedule, and meters — rebuilt around a single jitted, state-donating train
+step that works unchanged on CPU, one TPU chip, or a data-parallel mesh
+(cgnn_tpu.parallel).
+"""
+
+from cgnn_tpu.train.normalizer import Normalizer
+from cgnn_tpu.train.state import TrainState, create_train_state, make_optimizer
+from cgnn_tpu.train.step import make_train_step, make_eval_step
+from cgnn_tpu.train.metrics import AverageMeter, mae, class_eval
+from cgnn_tpu.train.checkpoint import CheckpointManager
+from cgnn_tpu.train.loop import fit, evaluate
+
+__all__ = [
+    "Normalizer",
+    "TrainState",
+    "create_train_state",
+    "make_optimizer",
+    "make_train_step",
+    "make_eval_step",
+    "AverageMeter",
+    "mae",
+    "class_eval",
+    "CheckpointManager",
+    "fit",
+    "evaluate",
+]

@@ -1,0 +1,103 @@
+"""Checkpoint/resume on orbax (SURVEY.md §2 component 10, §5).
+
+Same semantics as the reference's ``save_checkpoint``/``--resume``: every
+epoch saves the full training state (params, BatchNorm stats, optimizer
+state, step, Normalizer, RNG) plus metadata (config dict, epoch, best
+metric); the best-so-far checkpoint is retained alongside the latest
+(``model_best.pth.tar`` equivalent). Saves are async — orbax writes in a
+background thread while training continues.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+from cgnn_tpu.train.state import TrainState
+
+_LATEST = "latest"
+_BEST = "best"
+
+
+def _state_pytree(state: TrainState) -> dict:
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "normalizer": {"mean": state.normalizer.mean, "std": state.normalizer.std},
+        "rng": jax.random.key_data(state.rng),
+    }
+
+
+class CheckpointManager:
+    """Latest + best checkpoint pair with JSON metadata, async saves."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def _path(self, tag: str) -> str:
+        return os.path.join(self.directory, tag)
+
+    def _meta_path(self, tag: str) -> str:
+        return os.path.join(self.directory, f"meta-{tag}.json")
+
+    def read_meta(self, tag: str = _LATEST) -> dict:
+        if not os.path.exists(self._meta_path(tag)):
+            return {}
+        with open(self._meta_path(tag)) as f:
+            return json.load(f)
+
+    def save(self, state: TrainState, meta: dict, is_best: bool = False):
+        """Save 'latest' (and 'best' when ``is_best``); meta rides alongside
+        as JSON (orbax pytrees are arrays-only; config strings go to JSON,
+        mirroring the reference's checkpoint-embedded ``args``)."""
+        tree = _state_pytree(state)
+        for tag in [_LATEST] + ([_BEST] if is_best else []):
+            self._ckptr.save(self._path(tag), tree, force=True)
+            with open(self._meta_path(tag), "w") as f:
+                json.dump(meta, f, indent=1)
+
+    def wait(self):
+        self._ckptr.wait_until_finished()
+
+    def exists(self, tag: str = _LATEST) -> bool:
+        return os.path.isdir(self._path(tag))
+
+    def restore(self, state: TrainState, tag: str = _LATEST) -> tuple[TrainState, dict]:
+        """Restore into the structure of ``state`` -> (state, meta)."""
+        self.wait()
+        tree = self._ckptr.restore(self._path(tag), _state_pytree(state))
+        from cgnn_tpu.train.normalizer import Normalizer
+
+        restored = state.replace(
+            step=tree["step"],
+            params=tree["params"],
+            batch_stats=tree["batch_stats"],
+            opt_state=tree["opt_state"],
+            normalizer=Normalizer.from_state_dict(tree["normalizer"]),
+            rng=jax.random.wrap_key_data(tree["rng"]),
+        )
+        return restored, self.read_meta(tag)
+
+    def restore_for_inference(self, state: TrainState, tag: str = _LATEST):
+        """Restore params/stats/normalizer only (no optimizer template)."""
+        self.wait()
+        with ocp.PyTreeCheckpointer() as ckptr:
+            raw = ckptr.restore(self._path(tag))
+        from cgnn_tpu.train.normalizer import Normalizer
+
+        return state.replace(
+            params=raw["params"],
+            batch_stats=raw["batch_stats"],
+            normalizer=Normalizer.from_state_dict(raw["normalizer"]),
+        )
+
+    def close(self):
+        self.wait()
+        self._ckptr.close()

@@ -1,0 +1,166 @@
+"""Epoch loops (SURVEY.md §2 component 1: ``train()``/``validate()``).
+
+Host-side orchestration only — all math lives in the jitted step. The loop
+overlaps host batch packing with device execution naturally: dispatching a
+jitted step is async, so packing batch k+1 proceeds while the device runs
+batch k. Timing meters separate data time from step time, like the
+reference's console output.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Sequence
+
+import jax
+import numpy as np
+
+from cgnn_tpu.data.graph import CrystalGraph, GraphBatch, batch_iterator, round_to_bucket
+from cgnn_tpu.train.metrics import AverageMeter
+from cgnn_tpu.train.state import TrainState
+from cgnn_tpu.train.step import make_eval_step, make_train_step
+
+
+def capacities_for(
+    graphs: Sequence[CrystalGraph], batch_size: int, headroom: float = 1.15
+) -> tuple[int, int]:
+    """Pick one (node_cap, edge_cap) for a dataset so every shuffled batch
+    fits: batch_size * max-per-graph sizes would be safe but wasteful; use
+    mean + headroom over the largest observed, bucketed."""
+    nodes = np.array([g.num_nodes for g in graphs])
+    edges = np.array([g.num_edges for g in graphs])
+    node_cap = round_to_bucket(
+        int(max(batch_size * nodes.mean() * headroom, nodes.max()))
+    )
+    edge_cap = round_to_bucket(
+        int(max(batch_size * edges.mean() * headroom, edges.max()))
+    )
+    return node_cap, edge_cap
+
+
+def run_epoch(
+    step_fn: Callable,
+    state: TrainState,
+    batches: Iterable[GraphBatch],
+    train: bool,
+    print_freq: int = 0,
+    epoch: int = 0,
+    log_fn: Callable = print,
+) -> tuple[TrainState, dict]:
+    """Drive one epoch; returns (state, aggregated metric means)."""
+    meters = {
+        "batch_time": AverageMeter(),
+        "data_time": AverageMeter(),
+    }
+    sums: dict[str, float] = {}
+    end = time.perf_counter()
+    it = -1
+    for it, batch in enumerate(batches):
+        meters["data_time"].update(time.perf_counter() - end)
+        if train:
+            state, metrics = step_fn(state, batch)
+        else:
+            metrics = step_fn(state, batch)
+        metrics = jax.device_get(metrics)
+        for k, v in metrics.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+        meters["batch_time"].update(time.perf_counter() - end)
+        end = time.perf_counter()
+        if print_freq and it % print_freq == 0:
+            count = max(sums.get("count", 1.0), 1.0)
+            parts = [
+                f"{'Epoch' if train else 'Val'}: [{epoch}][{it}]",
+                f"Time {meters['batch_time'].val:.3f} ({meters['batch_time'].avg:.3f})",
+                f"Data {meters['data_time'].val:.3f} ({meters['data_time'].avg:.3f})",
+                f"Loss {sums.get('loss_sum', 0.0) / count:.4f}",
+            ]
+            if "mae_sum" in sums:
+                parts.append(f"MAE {sums['mae_sum'] / count:.4f}")
+            if "correct_sum" in sums:
+                parts.append(f"Acc {sums['correct_sum'] / count:.4f}")
+            log_fn("  ".join(parts))
+    count = max(sums.get("count", 1.0), 1.0)
+    out = {k[: -len("_sum")]: v / count for k, v in sums.items() if k.endswith("_sum")}
+    out["count"] = sums.get("count", 0.0)
+    out["steps"] = it + 1
+    return state, out
+
+
+def fit(
+    state: TrainState,
+    train_graphs: Sequence[CrystalGraph],
+    val_graphs: Sequence[CrystalGraph],
+    *,
+    epochs: int,
+    batch_size: int,
+    node_cap: int | None = None,
+    edge_cap: int | None = None,
+    classification: bool = False,
+    seed: int = 0,
+    print_freq: int = 10,
+    on_epoch_end: Callable | None = None,
+    log_fn: Callable = print,
+    start_epoch: int = 0,
+) -> tuple[TrainState, dict]:
+    """Reference ``main()`` loop: train/validate per epoch, track best."""
+    if node_cap is None or edge_cap is None:
+        nc, ec = capacities_for(train_graphs, batch_size)
+        node_cap, edge_cap = node_cap or nc, edge_cap or ec
+    train_step = jax.jit(make_train_step(classification), donate_argnums=0)
+    eval_step = jax.jit(make_eval_step(classification))
+    best_key = "acc" if classification else "mae"
+    best = -np.inf if classification else np.inf
+    history = []
+    rng = np.random.default_rng(seed)
+    for epoch in range(start_epoch, epochs):
+        t0 = time.perf_counter()
+        state, train_m = run_epoch(
+            train_step,
+            state,
+            batch_iterator(
+                train_graphs, batch_size, node_cap, edge_cap, shuffle=True, rng=rng
+            ),
+            train=True,
+            print_freq=print_freq,
+            epoch=epoch,
+            log_fn=log_fn,
+        )
+        _, val_m = run_epoch(
+            eval_step,
+            state,
+            batch_iterator(val_graphs, batch_size, node_cap, edge_cap),
+            train=False,
+            epoch=epoch,
+            log_fn=log_fn,
+        )
+        metric = val_m.get("correct" if classification else "mae", np.nan)
+        is_best = metric > best if classification else metric < best
+        if is_best:
+            best = metric
+        history.append({"epoch": epoch, "train": train_m, "val": val_m})
+        log_fn(
+            f"Epoch {epoch}: train loss {train_m.get('loss', np.nan):.4f}"
+            f"  val {best_key} {metric:.4f}{' *' if is_best else ''}"
+            f"  ({time.perf_counter() - t0:.1f}s)"
+        )
+        if on_epoch_end is not None:
+            on_epoch_end(state, epoch, val_m, is_best)
+    return state, {"best": best, "history": history}
+
+
+def evaluate(
+    state: TrainState,
+    graphs: Sequence[CrystalGraph],
+    batch_size: int,
+    node_cap: int,
+    edge_cap: int,
+    classification: bool = False,
+) -> dict:
+    eval_step = jax.jit(make_eval_step(classification))
+    _, metrics = run_epoch(
+        eval_step,
+        state,
+        batch_iterator(graphs, batch_size, node_cap, edge_cap),
+        train=False,
+    )
+    return metrics

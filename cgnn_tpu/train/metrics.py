@@ -1,0 +1,84 @@
+"""Meters and eval metrics (SURVEY.md §2 component 9).
+
+Console-visible quantities match the reference's operator experience: loss,
+MAE (regression) or accuracy/AUC/F1 (classification), batch/data timing.
+sklearn is not installed; AUC/F1 are implemented in-tree on numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class AverageMeter:
+    """Running (value, average) meter — the reference's training display."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0.0
+        self.avg = 0.0
+
+    def update(self, val: float, n: float = 1):
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1e-12)
+
+
+def mae(pred: np.ndarray, target: np.ndarray) -> float:
+    return float(np.mean(np.abs(np.asarray(pred) - np.asarray(target))))
+
+
+def _binary_auc(scores: np.ndarray, labels: np.ndarray) -> float:
+    """Rank-based AUC (Mann-Whitney), ties handled by midranks."""
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(sorted_scores):
+        j = i
+        while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i : j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    pos = labels == 1
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def class_eval(log_probs: np.ndarray, labels: np.ndarray) -> dict:
+    """accuracy / precision / recall / F1 / AUC for binary classification.
+
+    Mirrors the reference's ``class_eval`` metric set (computed there with
+    sklearn, which is unavailable in this image).
+    """
+    log_probs = np.asarray(log_probs)
+    labels = np.asarray(labels).astype(int)
+    pred = log_probs.argmax(axis=-1)
+    acc = float((pred == labels).mean()) if len(labels) else float("nan")
+    out = {"accuracy": acc}
+    if log_probs.shape[-1] == 2:
+        tp = float(((pred == 1) & (labels == 1)).sum())
+        fp = float(((pred == 1) & (labels == 0)).sum())
+        fn = float(((pred == 0) & (labels == 1)).sum())
+        precision = tp / (tp + fp) if tp + fp else float("nan")
+        recall = tp / (tp + fn) if tp + fn else float("nan")
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if precision == precision and recall == recall and precision + recall
+            else float("nan")
+        )
+        out.update(
+            precision=precision,
+            recall=recall,
+            f1=f1,
+            auc=_binary_auc(np.exp(log_probs[:, 1]), labels),
+        )
+    return out

@@ -1,0 +1,59 @@
+"""Target normalization (SURVEY.md §2 component 8).
+
+The reference's ``Normalizer`` standardizes regression targets with
+train-sample mean/std, stores its state inside checkpoints, and denormalizes
+at eval/predict time. Here the stats are jnp arrays of shape [T] (one per
+task) so they live inside the jitted step and the checkpoint pytree, and
+multi-task targets with missing labels are handled via the target mask.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from flax import struct
+
+
+class Normalizer(struct.PyTreeNode):
+    mean: jnp.ndarray  # [T]
+    std: jnp.ndarray  # [T]
+
+    @classmethod
+    def fit(cls, targets: np.ndarray, mask: np.ndarray | None = None) -> "Normalizer":
+        """Per-task masked mean/std over a training sample ([S, T] arrays)."""
+        t = np.atleast_2d(np.asarray(targets, np.float64))
+        if mask is None:
+            m = np.ones_like(t)
+        else:
+            m = np.atleast_2d(np.asarray(mask, np.float64))
+        n = np.maximum(m.sum(axis=0), 1.0)
+        mean = (t * m).sum(axis=0) / n
+        var = (((t - mean) ** 2) * m).sum(axis=0) / n
+        std = np.sqrt(np.maximum(var, 1e-12))
+        return cls(
+            mean=jnp.asarray(mean, jnp.float32), std=jnp.asarray(std, jnp.float32)
+        )
+
+    @classmethod
+    def identity(cls, num_targets: int = 1) -> "Normalizer":
+        """No-op normalizer (classification / pre-normalized targets)."""
+        return cls(
+            mean=jnp.zeros(num_targets, jnp.float32),
+            std=jnp.ones(num_targets, jnp.float32),
+        )
+
+    def norm(self, x):
+        return (x - self.mean) / self.std
+
+    def denorm(self, x):
+        return x * self.std + self.mean
+
+    def state_dict(self) -> dict:
+        return {"mean": np.asarray(self.mean), "std": np.asarray(self.std)}
+
+    @classmethod
+    def from_state_dict(cls, d: dict) -> "Normalizer":
+        return cls(
+            mean=jnp.asarray(d["mean"], jnp.float32),
+            std=jnp.asarray(d["std"], jnp.float32),
+        )

@@ -1,0 +1,115 @@
+"""Jitted train/eval steps (SURVEY.md §3.1 hot loop, rebuilt for XLA).
+
+One traced function per (model, task): forward, masked loss, grads, optimizer
+update, BatchNorm stat update — all fused by XLA into a single device
+program. The same step body runs single-device (plain ``jit``) or
+data-parallel (inside ``shard_map`` with ``axis_name='data'`` — grads and
+stats are ``pmean``-ed over ICI, metrics ``psum``-ed; cgnn_tpu.parallel).
+
+Metrics are returned as (sum, count) pairs, never means, so cross-device and
+cross-batch accumulation is exact.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cgnn_tpu.data.graph import GraphBatch
+from cgnn_tpu.train.state import TrainState
+
+
+def regression_loss(out, batch: GraphBatch, normalizer):
+    """Masked MSE on normalized targets; metrics in original units."""
+    t_norm = normalizer.norm(batch.targets)
+    w = batch.target_mask * batch.graph_mask[:, None]
+    se = (out - t_norm) ** 2 * w
+    n = jnp.maximum(w.sum(), 1.0)
+    loss = se.sum() / n
+    ae = jnp.abs(normalizer.denorm(out) - batch.targets) * w
+    metrics = {"loss_sum": se.sum(), "mae_sum": ae.sum(), "count": w.sum()}
+    return loss, metrics
+
+
+def classification_loss(out, batch: GraphBatch, normalizer):
+    """NLL over log-probs (reference: NLLLoss after LogSoftmax) + accuracy."""
+    labels = batch.targets[:, 0].astype(jnp.int32)
+    w = batch.graph_mask
+    nll = -jnp.take_along_axis(out, labels[:, None], axis=1)[:, 0] * w
+    n = jnp.maximum(w.sum(), 1.0)
+    loss = nll.sum() / n
+    correct = (jnp.argmax(out, axis=-1) == labels).astype(jnp.float32) * w
+    metrics = {"loss_sum": nll.sum(), "correct_sum": correct.sum(), "count": w.sum()}
+    return loss, metrics
+
+
+def make_train_step(
+    classification: bool = False,
+    axis_name: str | None = None,
+    loss_fn: Callable | None = None,
+) -> Callable:
+    """Build the (state, batch) -> (state, metrics) step body.
+
+    ``axis_name`` activates cross-device reductions; only set it when the
+    step runs inside shard_map/vmap with that axis bound.
+    """
+    compute_loss = loss_fn or (classification_loss if classification else regression_loss)
+
+    def train_step(state: TrainState, batch: GraphBatch):
+        rngs = {"dropout": jax.random.fold_in(state.rng, state.step)}
+
+        def loss_with_aux(params):
+            out, mutated = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch,
+                train=True,
+                mutable=["batch_stats"],
+                rngs=rngs,
+            )
+            loss, metrics = compute_loss(out, batch, state.normalizer)
+            return loss, (metrics, mutated["batch_stats"])
+
+        (_, (metrics, new_stats)), grads = jax.value_and_grad(
+            loss_with_aux, has_aux=True
+        )(state.params)
+        if axis_name is not None:
+            # DDP-equivalent: average grads across replicas; running stats are
+            # also averaged (stronger than torch DDP, which keeps rank-0's);
+            # metric sums add up exactly.
+            grads = lax.pmean(grads, axis_name)
+            new_stats = lax.pmean(new_stats, axis_name)
+            metrics = lax.psum(metrics, axis_name)
+        return state.apply_gradients(grads, new_stats), metrics
+
+    return train_step
+
+
+def make_eval_step(
+    classification: bool = False,
+    axis_name: str | None = None,
+    loss_fn: Callable | None = None,
+) -> Callable:
+    """(state, batch) -> metrics, using running BatchNorm statistics."""
+    compute_loss = loss_fn or (classification_loss if classification else regression_loss)
+
+    def eval_step(state: TrainState, batch: GraphBatch):
+        out = state.apply_fn(state.variables(), batch, train=False)
+        _, metrics = compute_loss(out, batch, state.normalizer)
+        if axis_name is not None:
+            metrics = lax.psum(metrics, axis_name)
+        return metrics
+
+    return eval_step
+
+
+def make_predict_step() -> Callable:
+    """(state, batch) -> denormalized predictions [G, T]."""
+
+    def predict_step(state: TrainState, batch: GraphBatch):
+        out = state.apply_fn(state.variables(), batch, train=False)
+        return state.normalizer.denorm(out) * batch.graph_mask[:, None]
+
+    return predict_step

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Reference-compatible inference entrypoint (SURVEY.md §2 component 2, §3.2).
+
+Loads a checkpoint saved by train.py (model hyperparams + featurization
+config + Normalizer state ride inside it, like the reference's checkpoint
+``args``), runs the forward pass over a directory of CIFs, denormalizes,
+and writes ``test_results.csv`` rows of ``id, target, prediction``.
+
+Usage:
+    python predict.py CKPT_DIR DATA_DIR [--device=...] [--out csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("ckpt_dir", help="checkpoint directory written by train.py")
+    p.add_argument("root_dir", help="dataset dir: {id}.cif + id_prop.csv")
+    p.add_argument("--device", choices=["auto", "cpu", "tpu"], default="auto")
+    p.add_argument("--best", action="store_true",
+                   help="load the best checkpoint instead of the latest")
+    p.add_argument("-b", "--batch-size", type=int, default=256)
+    p.add_argument("--out", default="test_results.csv")
+    p.add_argument("--synthetic", type=int, default=0,
+                   help="predict on N synthetic structures (smoke runs)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.device == "cpu":
+        # env var alone is not honored under the axon TPU tunnel
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from cgnn_tpu.config import DataConfig, ModelConfig
+    from cgnn_tpu.data.dataset import load_cif_directory, load_synthetic
+    from cgnn_tpu.data.graph import batch_iterator
+    from cgnn_tpu.train import CheckpointManager, Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.loop import capacities_for
+    from cgnn_tpu.train.step import make_predict_step
+
+    mgr = CheckpointManager(args.ckpt_dir)
+    tag = "best" if args.best else "latest"
+    if not mgr.exists(tag):
+        print(f"no '{tag}' checkpoint under {args.ckpt_dir}", file=sys.stderr)
+        return 2
+
+    meta = mgr.read_meta(tag)
+    model_cfg = ModelConfig.from_meta(meta["model"])
+    data_cfg = DataConfig.from_meta(meta["data"])
+    model = model_cfg.build()
+
+    if args.synthetic:
+        graphs = load_synthetic(args.synthetic, data_cfg.featurize_config())
+    else:
+        graphs = load_cif_directory(args.root_dir, data_cfg.featurize_config())
+    node_cap, edge_cap = capacities_for(graphs, args.batch_size)
+
+    from cgnn_tpu.data.graph import pack_graphs
+
+    example = pack_graphs(graphs[: args.batch_size], node_cap, edge_cap,
+                          args.batch_size)
+    state = create_train_state(
+        model, example, make_optimizer(),
+        Normalizer.identity(model_cfg.num_targets), rng=jax.random.key(0),
+    )
+    state = mgr.restore_for_inference(state, tag)
+
+    predict_step = jax.jit(make_predict_step())
+    rows = []
+    idx = 0
+    for batch in batch_iterator(graphs, args.batch_size, node_cap, edge_cap):
+        preds = np.asarray(jax.device_get(predict_step(state, batch)))
+        n_real = int(np.asarray(batch.graph_mask).sum())
+        for k in range(n_real):
+            g = graphs[idx]
+            rows.append(
+                [g.cif_id]
+                + [f"{t:.6f}" for t in np.atleast_1d(g.target)]
+                + [f"{p:.6f}" for p in preds[k]]
+            )
+            idx += 1
+    with open(args.out, "w", newline="") as f:
+        csv.writer(f).writerows(rows)
+    print(f"wrote {len(rows)} predictions to {args.out}")
+    mgr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

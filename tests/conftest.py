@@ -7,7 +7,9 @@ compile checks, not by the unit suite.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU: the surrounding environment pins JAX_PLATFORMS=axon (the real
+# TPU tunnel), but the unit suite runs on 8 virtual CPU devices by design
+os.environ["JAX_PLATFORMS"] = "cpu"
 # float64 support for the double-precision oracle parity harness
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -122,11 +122,17 @@ class TestForceField:
         batch = _make_batch(graphs, 128, 2048, 10)
         model = ForceFieldCGCNN(atom_fea_len=16, n_conv=2, dmax=6.0)
         variables = model.init(jax.random.key(0), batch, batch.positions)
-        energies, forces = energy_and_forces(model, variables, batch)
+        energies, forces, stats = energy_and_forces(model, variables, batch)
         assert energies.shape == (10,)
         assert forces.shape == (128, 3)
+        assert stats is None  # eval mode
         assert np.all(np.isfinite(energies)) and np.all(np.isfinite(forces))
         np.testing.assert_allclose(energies[len(graphs):], 0.0)
+        # train mode returns updated running stats for the state update
+        _, _, new_stats = energy_and_forces(model, variables, batch, train=True)
+        assert new_stats is not None
+        leaves = jax.tree_util.tree_leaves(new_stats)
+        assert leaves and all(np.all(np.isfinite(l)) for l in leaves)
 
     def test_translation_invariance(self, graphs):
         """Rigid translation changes no distances -> forces sum to ~0."""
@@ -137,7 +143,7 @@ class TestForceField:
         shifted = batch.positions + jnp.array([1.7, -0.4, 2.2])
         e1 = model.apply(variables, batch, shifted)
         np.testing.assert_allclose(e0, e1, rtol=1e-4, atol=1e-4)
-        _, forces = energy_and_forces(model, variables, batch)
+        _, forces, _ = energy_and_forces(model, variables, batch)
         # net force on each crystal vanishes by translation symmetry
         net = jax.ops.segment_sum(forces, batch.node_graph, 10)
         np.testing.assert_allclose(net, 0.0, atol=1e-3)

@@ -83,6 +83,21 @@ class TestMaskedBatchNorm:
         y = mod.apply(variables, jnp.asarray(x), use_running_average=True)
         np.testing.assert_allclose(y, x / np.sqrt(1 + 1e-5), rtol=1e-5, atol=1e-5)
 
+    def test_fully_masked_batch_preserves_running_stats(self):
+        """An all-padding batch (empty DP shard) must not decay stats."""
+        x = np.zeros((8, 3), np.float32)
+        mask = np.zeros(8, np.float32)
+        mod = MaskedBatchNorm()
+        v = mod.init(jax.random.key(0), jnp.asarray(x))
+        before = jax.device_get(v["batch_stats"])
+        _, upd = mod.apply(
+            v, jnp.asarray(x), mask=jnp.asarray(mask),
+            mutable=["batch_stats"], use_running_average=False,
+        )
+        after = jax.device_get(upd["batch_stats"])
+        np.testing.assert_array_equal(after["mean"], before["mean"])
+        np.testing.assert_array_equal(after["var"], before["var"])
+
     def test_masked_equals_unmasked_on_real_rows(self):
         """SURVEY.md §4.2: masked BN over padded data == BN over unpadded."""
         rng = np.random.default_rng(4)

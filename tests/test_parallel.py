@@ -1,0 +1,123 @@
+"""Data-parallel tests on the 8-virtual-device CPU mesh (SURVEY.md §4.5).
+
+The fake-NCCL analog: assert the shard_map DP step reproduces the
+single-device step exactly when every device sees the same batch, and that
+eval padding batches contribute nothing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+from cgnn_tpu.data.graph import pack_graphs
+from cgnn_tpu.models import CrystalGraphConvNet
+from cgnn_tpu.parallel import (
+    empty_batch_like,
+    make_parallel_eval_step,
+    make_parallel_train_step,
+    parallel_batches,
+    replicate_state,
+    shard_leading_axis,
+    stack_batches,
+)
+from cgnn_tpu.parallel.mesh import make_mesh
+from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+from cgnn_tpu.train.step import make_eval_step, make_train_step
+
+N_DEV = 8
+
+
+# function scope: the DP train step donates its (replicated) state, and
+# replication aliases the device-0 shard — a module-scoped state would be
+# deleted for later tests
+@pytest.fixture()
+def setup():
+    assert len(jax.devices()) >= N_DEV, "conftest must provide 8 CPU devices"
+    graphs = load_synthetic(16, FeaturizeConfig(radius=5.0, max_num_nbr=8),
+                            seed=9, max_atoms=6)
+    node_cap, edge_cap = 96, 768
+    batch = pack_graphs(graphs[:4], node_cap, edge_cap, 4)
+    model = CrystalGraphConvNet(atom_fea_len=12, n_conv=2, h_fea_len=16)
+    tx = make_optimizer(optim="sgd", lr=0.05)
+    normalizer = Normalizer.fit(np.stack([g.target for g in graphs]))
+    state = create_train_state(model, batch, tx, normalizer)
+    return graphs, batch, model, state, (node_cap, edge_cap)
+
+
+class TestDataParallel:
+    def test_replicated_batch_matches_single_device(self, setup):
+        """Same batch on all 8 devices -> pmean(grads)==grads, so the DP
+        step must equal the single-device step; metric sums are 8x."""
+        graphs, batch, model, state, _ = setup
+        mesh = make_mesh(N_DEV)
+
+        single_step = jax.jit(make_train_step())  # no donation: reuse state
+        s_single, m_single = single_step(state, batch)
+
+        dp_step = make_parallel_train_step(mesh)
+        stacked = stack_batches([batch] * N_DEV)
+        s_dp, m_dp = dp_step(
+            replicate_state(state, mesh), shard_leading_axis(stacked, mesh)
+        )
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+            jax.device_get(s_dp.params), jax.device_get(s_single.params),
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+            jax.device_get(s_dp.batch_stats), jax.device_get(s_single.batch_stats),
+        )
+        np.testing.assert_allclose(
+            float(m_dp["loss_sum"]), N_DEV * float(m_single["loss_sum"]),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(float(m_dp["count"]), N_DEV * 4.0)
+
+    def test_eval_padding_contributes_zero(self, setup):
+        graphs, batch, model, state, _ = setup
+        mesh = make_mesh(N_DEV)
+        eval_single = jax.jit(make_eval_step())
+        m_single = jax.device_get(eval_single(state, batch))
+
+        # one real batch + 7 empty padding batches
+        stacked = stack_batches([batch] + [empty_batch_like(batch)] * (N_DEV - 1))
+        dp_eval = make_parallel_eval_step(mesh)
+        m_dp = jax.device_get(
+            dp_eval(replicate_state(state, mesh), shard_leading_axis(stacked, mesh))
+        )
+        for k in m_single:
+            np.testing.assert_allclose(
+                float(m_dp[k]), float(m_single[k]), rtol=1e-6, atol=1e-8
+            )
+
+    def test_parallel_batches_grouping(self, setup):
+        graphs, _, _, _, (node_cap, edge_cap) = setup
+        stacked_list = list(
+            parallel_batches(graphs, 4, 2, node_cap, edge_cap, pad_incomplete=True)
+        )
+        assert all(s.nodes.shape[0] == 4 for s in stacked_list)
+        total_real = sum(float(np.sum(s.graph_mask)) for s in stacked_list)
+        assert total_real == len(graphs)
+        # without padding, incomplete trailing groups are dropped
+        stacked_drop = list(parallel_batches(graphs, 5, 2, node_cap, edge_cap))
+        assert all(s.nodes.shape[0] == 5 for s in stacked_drop)
+
+    def test_sharded_train_progresses(self, setup):
+        """Distinct per-device batches: loss goes down over DP steps."""
+        graphs, batch, model, state, (node_cap, edge_cap) = setup
+        mesh = make_mesh(N_DEV)
+        dp_step = make_parallel_train_step(mesh)
+        state = replicate_state(state, mesh)
+        losses = []
+        for _ in range(6):
+            for stacked in parallel_batches(
+                graphs, N_DEV, 2, node_cap, edge_cap, pad_incomplete=False,
+                shuffle=True, rng=np.random.default_rng(0),
+            ):
+                state, m = dp_step(state, shard_leading_axis(stacked, mesh))
+                m = jax.device_get(m)
+                losses.append(float(m["loss_sum"]) / max(float(m["count"]), 1))
+        assert losses[-1] < losses[0]

@@ -26,7 +26,9 @@ N_H = 2
 MAX_NBR = 12
 
 
-@pytest.fixture(scope="module")
+# function scope: the train-mode test mutates the torch oracle's running
+# stats in place, so sharing one oracle across tests would be order-dependent
+@pytest.fixture()
 def setup():
     cfg = FeaturizeConfig(radius=8.0, max_num_nbr=MAX_NBR)
     graphs = load_synthetic(4, cfg, seed=11, max_atoms=8)
@@ -75,13 +77,18 @@ def setup():
 
 
 def variables_from_torch(oracle: TorchCGCNN, template):
-    """Transplant oracle weights into the flax variable tree."""
+    """Transplant oracle weights into the flax variable tree.
+
+    jnp.array (copy), never jnp.asarray: on CPU, asarray of tensor.numpy()
+    is zero-copy, so torch's in-place running-stat updates during the oracle
+    forward would silently mutate the transplanted JAX arrays too.
+    """
 
     def w(linear):  # torch [out, in] -> flax kernel [in, out]
-        return jnp.asarray(linear.weight.detach().numpy().T)
+        return jnp.array(linear.weight.detach().numpy().T)
 
     def b(linear):
-        return jnp.asarray(linear.bias.detach().numpy())
+        return jnp.array(linear.bias.detach().numpy())
 
     params = jax.tree_util.tree_map(lambda x: x, template["params"])
     stats = jax.tree_util.tree_map(lambda x: x, template["batch_stats"])
@@ -90,12 +97,12 @@ def variables_from_torch(oracle: TorchCGCNN, template):
         params[f"conv_{i}"]["fc_full"] = {"kernel": w(conv.fc_full), "bias": b(conv.fc_full)}
         for bn_name, bn in (("bn1", conv.bn1), ("bn2", conv.bn2)):
             params[f"conv_{i}"][bn_name] = {
-                "scale": jnp.asarray(bn.weight.detach().numpy()),
-                "bias": jnp.asarray(bn.bias.detach().numpy()),
+                "scale": jnp.array(bn.weight.detach().numpy()),
+                "bias": jnp.array(bn.bias.detach().numpy()),
             }
             stats[f"conv_{i}"][bn_name] = {
-                "mean": jnp.asarray(bn.running_mean.detach().numpy()),
-                "var": jnp.asarray(bn.running_var.detach().numpy()),
+                "mean": jnp.array(bn.running_mean.detach().numpy()),
+                "var": jnp.array(bn.running_var.detach().numpy()),
             }
     params["conv_to_fc"] = {"kernel": w(oracle.conv_to_fc), "bias": b(oracle.conv_to_fc)}
     for i, fc in enumerate(oracle.fcs):
@@ -149,7 +156,7 @@ class TestOracleParity:
                 {"params": params, "batch_stats": variables["batch_stats"]},
                 batch, train=True, mutable=["batch_stats"],
             )
-            err = out[: len(graphs), 0] - jnp.asarray(targets)
+            err = out[: len(graphs), 0] - jnp.array(targets)
             return jnp.mean(err**2)
 
         grads = jax.grad(loss_fn)(variables["params"])

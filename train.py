@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Reference-compatible training entrypoint with ``--device={cpu,tpu}``.
+
+Flag surface mirrors the reference lineage's ``main.py``/``train.py``
+(SURVEY.md §2 component 1, §5 config system): same names where known
+(``--task``, ``--n-conv``, ``--atom-fea-len``, ``--max-num-nbr``,
+``--radius``, ``--resume``, ``--lr-milestones`` in epochs, ...), plus the
+TPU-native additions: ``--device``, ``--data-parallel``, ``--bf16``,
+``--aggregation``, and ``--synthetic N`` (offline stand-in for MP/OC20
+downloads, SURVEY.md §7 phase 0).
+
+Usage:
+    python train.py DATA_DIR [flags]         # {id}.cif + id_prop.csv layout
+    python train.py --synthetic 1000 [flags] # packaged synthetic dataset
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("root_dir", nargs="?", default=None,
+                   help="dataset dir: {id}.cif files + id_prop.csv")
+    p.add_argument("--synthetic", type=int, default=0, metavar="N",
+                   help="train on N synthetic crystals instead of root_dir")
+    p.add_argument("--task", choices=["regression", "classification"],
+                   default="regression")
+    p.add_argument("--device", choices=["auto", "cpu", "tpu"], default="auto",
+                   help="accelerator (reference flag; 'auto' uses what jax finds)")
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--start-epoch", type=int, default=0)
+    p.add_argument("-b", "--batch-size", type=int, default=256)
+    p.add_argument("--lr", "--learning-rate", type=float, default=0.01, dest="lr")
+    p.add_argument("--lr-milestones", type=int, nargs="*", default=[100],
+                   help="epochs at which lr decays by 10x (torch MultiStepLR)")
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--optim", choices=["SGD", "Adam", "AdamW"], default="SGD")
+    p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--resume", type=str, default="",
+                   help="checkpoint dir to resume from")
+    p.add_argument("--train-ratio", type=float, default=0.8)
+    p.add_argument("--val-ratio", type=float, default=0.1)
+    # model hyperparams (reference names)
+    p.add_argument("--atom-fea-len", type=int, default=64)
+    p.add_argument("--h-fea-len", type=int, default=128)
+    p.add_argument("--n-conv", type=int, default=3)
+    p.add_argument("--n-h", type=int, default=1)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--num-classes", type=int, default=2)
+    # featurization (reference names)
+    p.add_argument("--max-num-nbr", type=int, default=12)
+    p.add_argument("--radius", type=float, default=8.0)
+    p.add_argument("--dmin", type=float, default=0.0)
+    p.add_argument("--step", type=float, default=0.2)
+    # runtime
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ckpt-dir", type=str, default="checkpoints")
+    p.add_argument("--node-cap", type=int, default=0, help="0 = auto")
+    p.add_argument("--edge-cap", type=int, default=0, help="0 = auto")
+    # TPU-native additions
+    p.add_argument("--data-parallel", action="store_true",
+                   help="shard batches over all visible devices (DP over ICI)")
+    p.add_argument("--bf16", action="store_true",
+                   help="bfloat16 compute on the MXU (f32 params/stats)")
+    p.add_argument("--aggregation", choices=["xla", "sort", "pallas"],
+                   default=None, help="edge-aggregation backend")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.device == "cpu":
+        # env var alone is not honored under the axon TPU tunnel
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from cgnn_tpu.config import DataConfig, ModelConfig
+    from cgnn_tpu.data.dataset import (
+        load_cif_directory,
+        load_synthetic,
+        train_val_test_split,
+    )
+    from cgnn_tpu.train import (
+        CheckpointManager,
+        Normalizer,
+        create_train_state,
+        make_optimizer,
+    )
+    from cgnn_tpu.train.loop import capacities_for, evaluate, fit
+
+    devices = jax.devices()
+    if args.device == "tpu" and devices[0].platform not in ("tpu", "axon"):
+        print(f"--device=tpu requested but jax found {devices[0].platform}",
+              file=sys.stderr)
+        return 2
+    print(f"devices: {devices}")
+
+    data_cfg = DataConfig(
+        radius=args.radius, max_num_nbr=args.max_num_nbr,
+        dmin=args.dmin, step=args.step,
+    )
+    t0 = time.perf_counter()
+    if args.synthetic:
+        graphs = load_synthetic(args.synthetic, data_cfg.featurize_config(),
+                                seed=args.seed)
+    elif args.root_dir:
+        graphs = load_cif_directory(args.root_dir, data_cfg.featurize_config())
+    else:
+        print("either DATA_DIR or --synthetic N is required", file=sys.stderr)
+        return 2
+    print(f"featurized {len(graphs)} structures in {time.perf_counter() - t0:.1f}s")
+
+    train_g, val_g, test_g = train_val_test_split(
+        graphs, args.train_ratio, args.val_ratio, seed=args.seed
+    )
+    num_targets = int(train_g[0].target.shape[0])
+    classification = args.task == "classification"
+
+    model_cfg = ModelConfig(
+        atom_fea_len=args.atom_fea_len, n_conv=args.n_conv,
+        h_fea_len=args.h_fea_len, n_h=args.n_h, num_targets=num_targets,
+        classification=classification, num_classes=args.num_classes,
+        dropout=args.dropout, dtype="bfloat16" if args.bf16 else "float32",
+        aggregation=args.aggregation,
+    )
+    model = model_cfg.build()
+
+    if classification:
+        normalizer = Normalizer.identity(num_targets)
+    else:
+        normalizer = Normalizer.fit(
+            np.stack([g.target for g in train_g]),
+            np.stack([
+                g.target_mask if g.target_mask is not None
+                else np.ones_like(g.target) for g in train_g
+            ]),
+        )
+
+    node_cap, edge_cap = capacities_for(train_g, args.batch_size)
+    node_cap = args.node_cap or node_cap
+    edge_cap = args.edge_cap or edge_cap
+    steps_per_epoch = max(1, len(train_g) // args.batch_size)
+    tx = make_optimizer(
+        optim=args.optim.lower(), lr=args.lr, momentum=args.momentum,
+        weight_decay=args.weight_decay,
+        lr_milestones=[m * steps_per_epoch for m in args.lr_milestones],
+    )
+
+    from cgnn_tpu.data.graph import pack_graphs
+
+    example = pack_graphs(train_g[: args.batch_size], node_cap, edge_cap,
+                          args.batch_size)
+    state = create_train_state(model, example, tx, normalizer,
+                               rng=jax.random.key(args.seed))
+
+    ckpt = CheckpointManager(args.ckpt_dir)
+    start_epoch = args.start_epoch
+    if args.resume:
+        resume_mgr = ckpt if os.path.abspath(args.resume) == ckpt.directory \
+            else CheckpointManager(args.resume)
+        state, meta = resume_mgr.restore(state)
+        start_epoch = int(meta.get("epoch", -1)) + 1
+        print(f"resumed from {args.resume} at epoch {start_epoch}")
+
+    meta_base = {"model": model_cfg.to_meta(), "data": data_cfg.to_meta(),
+                 "task": args.task}
+
+    if args.data_parallel and len(devices) > 1:
+        from cgnn_tpu.parallel import fit_data_parallel
+
+        state, result = fit_data_parallel(
+            state, train_g, val_g, epochs=args.epochs, batch_size=args.batch_size,
+            node_cap=node_cap, edge_cap=edge_cap, classification=classification,
+            seed=args.seed, print_freq=args.print_freq,
+            on_epoch_end=lambda s, e, m, b: ckpt.save(
+                s, dict(meta_base, epoch=e, best_mae=m.get("mae", -1.0)), is_best=b
+            ),
+            start_epoch=start_epoch,
+        )
+    else:
+        state, result = fit(
+            state, train_g, val_g, epochs=args.epochs, batch_size=args.batch_size,
+            node_cap=node_cap, edge_cap=edge_cap, classification=classification,
+            seed=args.seed, print_freq=args.print_freq,
+            on_epoch_end=lambda s, e, m, b: ckpt.save(
+                s, dict(meta_base, epoch=e, best_mae=m.get("mae", -1.0)), is_best=b
+            ),
+            start_epoch=start_epoch,
+        )
+
+    test_m = evaluate(state, test_g, args.batch_size, node_cap, edge_cap,
+                      classification)
+    key = "correct" if classification else "mae"
+    print(f"** test {key}: {test_m.get(key, float('nan')):.4f} "
+          f"(best val: {result['best']:.4f})")
+    ckpt.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
