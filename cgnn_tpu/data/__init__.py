@@ -17,6 +17,12 @@ from cgnn_tpu.data.neighbors import (
 )
 from cgnn_tpu.data.graph import CrystalGraph, GraphBatch, pack_graphs, pad_batch
 from cgnn_tpu.data.synthetic import random_structure, synthetic_dataset
+from cgnn_tpu.data.cache import (
+    save_graph_cache,
+    load_graph_cache,
+    featurize_directory_parallel,
+)
+from cgnn_tpu.data.loader import prefetch_to_device
 
 __all__ = [
     "Structure",
@@ -35,4 +41,8 @@ __all__ = [
     "pad_batch",
     "random_structure",
     "synthetic_dataset",
+    "save_graph_cache",
+    "load_graph_cache",
+    "featurize_directory_parallel",
+    "prefetch_to_device",
 ]

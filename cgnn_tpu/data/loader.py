@@ -1,0 +1,51 @@
+"""Prefetching device loader (SURVEY.md §2 native table: H2D double-buffer).
+
+The reference overlaps H2D copies with compute via ``pin_memory`` +
+``non_blocking`` CUDA copies. The TPU equivalent: a background thread packs
+GraphBatches and ``jax.device_put``s them while the device runs the current
+step, keeping a small queue of ready-on-device batches ahead of the
+consumer. Packing is numpy (releases the GIL for the big copies), so one
+thread suffices to hide host latency behind multi-ms device steps.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator
+
+import jax
+
+from cgnn_tpu.data.graph import GraphBatch
+
+_SENTINEL = object()
+
+
+def prefetch_to_device(
+    batches: Iterable[GraphBatch],
+    size: int = 2,
+    device_put: Callable = jax.device_put,
+) -> Iterator[GraphBatch]:
+    """Wrap a host batch iterator with an N-deep on-device prefetch queue."""
+    q: queue.Queue = queue.Queue(maxsize=size)
+    err: list[BaseException] = []
+
+    def producer():
+        try:
+            for b in batches:
+                q.put(device_put(b))
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer side
+            err.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=producer, daemon=True, name="cgnn-prefetch")
+    t.start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            break
+        yield item
+    t.join()
+    if err:
+        raise err[0]

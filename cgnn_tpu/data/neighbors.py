@@ -76,11 +76,32 @@ def neighbor_list_brute(structure: Structure, radius: float) -> NeighborList:
 
 
 def neighbor_list(
-    structure: Structure, radius: float, chunk_elems: int = 8_000_000
+    structure: Structure,
+    radius: float,
+    chunk_elems: int = 8_000_000,
+    backend: str = "auto",
 ) -> NeighborList:
-    """Vectorized periodic radius search (production host path)."""
+    """Periodic radius search (production host path).
+
+    backend='auto' uses the C++ kernel (cgnn_tpu.native) when a compiler is
+    available and falls back to the vectorized numpy path; 'numpy'/'native'
+    force one side ('native' raises if the library can't be built).
+    """
     if radius <= 0:
         raise ValueError(f"radius must be positive, got {radius}")
+    if backend not in ("auto", "numpy", "native"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if backend in ("auto", "native"):
+        from cgnn_tpu.native import neighbor_search_native
+
+        result = neighbor_search_native(
+            structure.lattice, structure.frac_coords, radius
+        )
+        if result is not None:
+            c, nb, d, off = result
+            return NeighborList(c, nb, d, off)
+        if backend == "native":
+            raise RuntimeError("native neighbor backend unavailable (no g++?)")
     s = structure.wrapped()
     cart = s.cart_coords  # [N, 3]
     n = s.num_atoms

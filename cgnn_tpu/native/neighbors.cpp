@@ -1,0 +1,134 @@
+// Native periodic neighbor search — the host-side hot loop of the offline
+// preprocessor (SURVEY.md §2 native table: "pymatgen/spglib periodic
+// neighbor search" -> in-tree host kernel; §7 "hard parts" #2).
+//
+// Same semantics as cgnn_tpu/data/neighbors.py::neighbor_list (the numpy
+// reference used in tests): fractional coords are wrapped into [0,1); the
+// image range per axis is ceil(radius / plane_spacing); self-pairs are
+// excluded only in the home image. Emits flat COO sorted by (center, order
+// of discovery) — the Python wrapper re-sorts by distance for knn anyway.
+//
+// C ABI only (ctypes binding, no pybind11 in this image). Returns the pair
+// count, or -(needed_hint) when `cap` is too small so the caller can retry.
+
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+// inverse of a row-major 3x3 matrix; returns false if singular
+bool invert3(const double* m, double* inv) {
+  const double a = m[0], b = m[1], c = m[2];
+  const double d = m[3], e = m[4], f = m[5];
+  const double g = m[6], h = m[7], i = m[8];
+  const double det =
+      a * (e * i - f * h) - b * (d * i - f * g) + c * (d * h - e * g);
+  if (std::fabs(det) < 1e-300) return false;
+  const double s = 1.0 / det;
+  inv[0] = (e * i - f * h) * s;
+  inv[1] = (c * h - b * i) * s;
+  inv[2] = (b * f - c * e) * s;
+  inv[3] = (f * g - d * i) * s;
+  inv[4] = (a * i - c * g) * s;
+  inv[5] = (c * d - a * f) * s;
+  inv[6] = (d * h - e * g) * s;
+  inv[7] = (b * g - a * h) * s;
+  inv[8] = (a * e - b * d) * s;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// lattice: [9] row-major (rows are lattice vectors, row-vector convention)
+// frac:    [n*3] fractional coordinates (any range; wrapped internally)
+// outputs: centers/neighbors [cap], dists [cap], offsets [cap*3]
+// returns pair count, or -needed when cap is insufficient, -1 on bad input
+long long cgnn_neighbor_search(const double* lattice, const double* frac,
+                               long long n, double radius, long long cap,
+                               int32_t* centers, int32_t* neighbors,
+                               float* dists, int32_t* offsets) {
+  if (n <= 0 || radius <= 0.0) return -1;
+  double inv[9];
+  if (!invert3(lattice, inv)) return -1;
+
+  // images per axis: ceil(radius * ||inv column k|| - eps)
+  int na[3];
+  for (int k = 0; k < 3; ++k) {
+    const double norm = std::sqrt(inv[k] * inv[k] + inv[k + 3] * inv[k + 3] +
+                                  inv[k + 6] * inv[k + 6]);
+    na[k] = static_cast<int>(std::ceil(radius * norm - 1e-12));
+    if (na[k] < 0) na[k] = 0;
+  }
+
+  // wrapped cartesian coordinates
+  std::vector<double> cart(static_cast<size_t>(n) * 3);
+  for (long long i = 0; i < n; ++i) {
+    double w[3];
+    for (int k = 0; k < 3; ++k) {
+      double fk = std::fmod(frac[i * 3 + k], 1.0);
+      if (fk < 0) fk += 1.0;
+      w[k] = fk;
+    }
+    for (int k = 0; k < 3; ++k) {
+      cart[i * 3 + k] =
+          w[0] * lattice[0 + k] + w[1] * lattice[3 + k] + w[2] * lattice[6 + k];
+    }
+  }
+
+  // precompute image shift vectors
+  struct Shift {
+    double v[3];
+    int img[3];
+  };
+  std::vector<Shift> shifts;
+  shifts.reserve(static_cast<size_t>(2 * na[0] + 1) * (2 * na[1] + 1) *
+                 (2 * na[2] + 1));
+  for (int ia = -na[0]; ia <= na[0]; ++ia)
+    for (int ib = -na[1]; ib <= na[1]; ++ib)
+      for (int ic = -na[2]; ic <= na[2]; ++ic) {
+        Shift s;
+        for (int k = 0; k < 3; ++k)
+          s.v[k] = ia * lattice[0 + k] + ib * lattice[3 + k] + ic * lattice[6 + k];
+        s.img[0] = ia;
+        s.img[1] = ib;
+        s.img[2] = ic;
+        shifts.push_back(s);
+      }
+
+  const double r2 = radius * radius;
+  long long count = 0;
+  for (long long i = 0; i < n; ++i) {
+    const double xi = cart[i * 3], yi = cart[i * 3 + 1], zi = cart[i * 3 + 2];
+    for (long long j = 0; j < n; ++j) {
+      const double dx0 = cart[j * 3] - xi;
+      const double dy0 = cart[j * 3 + 1] - yi;
+      const double dz0 = cart[j * 3 + 2] - zi;
+      for (const Shift& s : shifts) {
+        const bool home = s.img[0] == 0 && s.img[1] == 0 && s.img[2] == 0;
+        if (home && i == j) continue;
+        const double dx = dx0 + s.v[0];
+        const double dy = dy0 + s.v[1];
+        const double dz = dz0 + s.v[2];
+        const double d2 = dx * dx + dy * dy + dz * dz;
+        if (d2 <= r2) {
+          if (count < cap) {
+            centers[count] = static_cast<int32_t>(i);
+            neighbors[count] = static_cast<int32_t>(j);
+            dists[count] = static_cast<float>(std::sqrt(d2));
+            offsets[count * 3] = s.img[0];
+            offsets[count * 3 + 1] = s.img[1];
+            offsets[count * 3 + 2] = s.img[2];
+          }
+          ++count;
+        }
+      }
+    }
+  }
+  if (count > cap) return -count;  // caller retries with `count` capacity
+  return count;
+}
+
+}  // extern "C"

@@ -168,24 +168,34 @@ def fit_data_parallel(
     best = -np.inf if classification else np.inf
     history = []
     rng = np.random.default_rng(seed)
+    from cgnn_tpu.data.loader import prefetch_to_device
+
+    shard_put = lambda b: shard_leading_axis(b, mesh)  # noqa: E731
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         sums: dict[str, float] = {}
-        for stacked in parallel_batches(
-            train_graphs, n_dev, batch_size, node_cap, edge_cap,
-            shuffle=True, rng=rng,
+        for stacked in prefetch_to_device(
+            parallel_batches(
+                train_graphs, n_dev, batch_size, node_cap, edge_cap,
+                shuffle=True, rng=rng,
+            ),
+            device_put=shard_put,
         ):
-            state, metrics = train_step(state, shard_leading_axis(stacked, mesh))
+            state, metrics = train_step(state, stacked)
             for k, v in jax.device_get(metrics).items():
                 sums[k] = sums.get(k, 0.0) + float(v)
         train_count = max(sums.get("count", 1.0), 1.0)
         train_loss = sums.get("loss_sum", np.nan) / train_count
 
         vsums: dict[str, float] = {}
-        for stacked in parallel_batches(
-            val_graphs, n_dev, batch_size, node_cap, edge_cap, pad_incomplete=True
+        for stacked in prefetch_to_device(
+            parallel_batches(
+                val_graphs, n_dev, batch_size, node_cap, edge_cap,
+                pad_incomplete=True,
+            ),
+            device_put=shard_put,
         ):
-            metrics = eval_step(state, shard_leading_axis(stacked, mesh))
+            metrics = eval_step(state, stacked)
             for k, v in jax.device_get(metrics).items():
                 vsums[k] = vsums.get(k, 0.0) + float(v)
         vcount = max(vsums.get("count", 1.0), 1.0)

@@ -106,6 +106,8 @@ def fit(
     if node_cap is None or edge_cap is None:
         nc, ec = capacities_for(train_graphs, batch_size)
         node_cap, edge_cap = node_cap or nc, edge_cap or ec
+    from cgnn_tpu.data.loader import prefetch_to_device
+
     train_step = jax.jit(make_train_step(classification), donate_argnums=0)
     eval_step = jax.jit(make_eval_step(classification))
     best_key = "acc" if classification else "mae"
@@ -117,8 +119,11 @@ def fit(
         state, train_m = run_epoch(
             train_step,
             state,
-            batch_iterator(
-                train_graphs, batch_size, node_cap, edge_cap, shuffle=True, rng=rng
+            prefetch_to_device(
+                batch_iterator(
+                    train_graphs, batch_size, node_cap, edge_cap,
+                    shuffle=True, rng=rng,
+                )
             ),
             train=True,
             print_freq=print_freq,
@@ -128,7 +133,9 @@ def fit(
         _, val_m = run_epoch(
             eval_step,
             state,
-            batch_iterator(val_graphs, batch_size, node_cap, edge_cap),
+            prefetch_to_device(
+                batch_iterator(val_graphs, batch_size, node_cap, edge_cap)
+            ),
             train=False,
             epoch=epoch,
             log_fn=log_fn,

@@ -1,0 +1,113 @@
+"""Input-pipeline tests: graph cache round-trip, prefetch loader, native
+neighbor backend vs numpy (SURVEY.md §7 phase 4)."""
+
+import numpy as np
+import pytest
+
+from cgnn_tpu.data.cache import load_graph_cache, save_graph_cache
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+from cgnn_tpu.data.graph import batch_iterator
+from cgnn_tpu.data.loader import prefetch_to_device
+from cgnn_tpu.data.neighbors import neighbor_list
+from cgnn_tpu.data.synthetic import random_structure
+from cgnn_tpu.native import native_available, neighbor_search_native
+
+
+@pytest.fixture(scope="module")
+def graphs():
+    return load_synthetic(12, FeaturizeConfig(radius=5.0, max_num_nbr=8),
+                          seed=3, keep_geometry=True)
+
+
+class TestGraphCache:
+    def test_round_trip(self, graphs, tmp_path):
+        path = str(tmp_path / "cache.npz")
+        save_graph_cache(graphs, path)
+        loaded = load_graph_cache(path)
+        assert len(loaded) == len(graphs)
+        for a, b in zip(graphs, loaded):
+            np.testing.assert_array_equal(a.atom_fea, b.atom_fea)
+            np.testing.assert_array_equal(a.edge_fea, b.edge_fea)
+            np.testing.assert_array_equal(a.centers, b.centers)
+            np.testing.assert_array_equal(a.neighbors, b.neighbors)
+            np.testing.assert_allclose(
+                np.atleast_1d(a.target), b.target[: len(np.atleast_1d(a.target))]
+            )
+            assert a.cif_id == b.cif_id
+            np.testing.assert_allclose(a.positions, b.positions)
+            np.testing.assert_allclose(a.lattice, b.lattice)
+            np.testing.assert_array_equal(a.offsets, b.offsets)
+
+    def test_cached_graphs_batch_identically(self, graphs, tmp_path):
+        path = str(tmp_path / "cache.npz")
+        save_graph_cache(graphs, path)
+        loaded = load_graph_cache(path)
+        b1 = next(batch_iterator(graphs, 4, 128, 1024))
+        b2 = next(batch_iterator(loaded, 4, 128, 1024))
+        np.testing.assert_array_equal(b1.nodes, b2.nodes)
+        np.testing.assert_array_equal(b1.centers, b2.centers)
+        np.testing.assert_array_equal(b1.edges, b2.edges)
+
+
+class TestPrefetch:
+    def test_yields_all_batches_in_order(self, graphs):
+        batches = list(batch_iterator(graphs, 4, 128, 1024))
+        fetched = list(prefetch_to_device(batch_iterator(graphs, 4, 128, 1024)))
+        assert len(fetched) == len(batches)
+        for a, b in zip(batches, fetched):
+            np.testing.assert_allclose(a.nodes, np.asarray(b.nodes))
+
+    def test_propagates_producer_errors(self):
+        def boom():
+            yield from ()
+            raise RuntimeError("producer failed")
+
+        def gen():
+            raise RuntimeError("producer failed")
+            yield  # noqa
+
+        with pytest.raises(RuntimeError, match="producer failed"):
+            list(prefetch_to_device(gen()))
+
+
+class TestNativeNeighbors:
+    def test_native_builds(self):
+        # g++ is part of this image (SURVEY.md §7); the build must succeed
+        assert native_available(), "native neighbor kernel failed to build"
+
+    def test_native_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        for trial in range(8):
+            s = random_structure(rng, min_atoms=2, max_atoms=10)
+            radius = float(rng.uniform(3.0, 7.0))
+            ref = neighbor_list(s, radius, backend="numpy")
+            got = neighbor_search_native(s.lattice, s.frac_coords, radius)
+            assert got is not None
+            c, nb, d, off = got
+            assert len(c) == len(ref), f"trial {trial}: {len(c)} vs {len(ref)}"
+            # compare as sets of (i, j, image) -> distance
+            def key(cs, ns, offs):
+                return {
+                    (int(a), int(b), tuple(int(x) for x in o))
+                    for a, b, o in zip(cs, ns, offs)
+                }
+
+            assert key(c, nb, off) == key(ref.centers, ref.neighbors, ref.offsets)
+            ref_map = {
+                (int(a), int(b), tuple(map(int, o))): float(dd)
+                for a, b, o, dd in zip(
+                    ref.centers, ref.neighbors, ref.offsets, ref.distances
+                )
+            }
+            for a, b, o, dd in zip(c, nb, off, d):
+                np.testing.assert_allclose(
+                    dd, ref_map[(int(a), int(b), tuple(map(int, o)))],
+                    rtol=1e-5, atol=1e-5,
+                )
+
+    def test_auto_backend_used_in_featurization(self):
+        rng = np.random.default_rng(1)
+        s = random_structure(rng)
+        auto = neighbor_list(s, 5.0, backend="auto")
+        ref = neighbor_list(s, 5.0, backend="numpy")
+        assert len(auto) == len(ref)

@@ -58,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--radius", type=float, default=8.0)
     p.add_argument("--dmin", type=float, default=0.0)
     p.add_argument("--step", type=float, default=0.2)
+    # input pipeline
+    p.add_argument("--cache", type=str, default="",
+                   help="graph cache (.npz): loaded if present, else written "
+                        "after featurization (see cgnn_tpu.data.preprocess)")
+    p.add_argument("-j", "--workers", type=int, default=0,
+                   help="featurization worker processes (0 = all cores)")
     # runtime
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ckpt-dir", type=str, default="checkpoints")
@@ -110,15 +116,38 @@ def main(argv=None) -> int:
         dmin=args.dmin, step=args.step,
     )
     t0 = time.perf_counter()
-    if args.synthetic:
+    if args.cache and os.path.exists(args.cache):
+        from cgnn_tpu.data.cache import load_graph_cache
+
+        graphs = load_graph_cache(args.cache)
+        print(f"loaded {len(graphs)} graphs from {args.cache} "
+              f"in {time.perf_counter() - t0:.1f}s")
+    elif args.synthetic:
         graphs = load_synthetic(args.synthetic, data_cfg.featurize_config(),
                                 seed=args.seed)
     elif args.root_dir:
-        graphs = load_cif_directory(args.root_dir, data_cfg.featurize_config())
+        if args.workers != 1:
+            from cgnn_tpu.data.cache import featurize_directory_parallel
+
+            graphs, failures = featurize_directory_parallel(
+                args.root_dir, data_cfg.featurize_config(),
+                workers=args.workers or None,
+            )
+            for cif_id, err in failures[:10]:
+                print(f"skipped {cif_id}: {err}", file=sys.stderr)
+        else:
+            graphs = load_cif_directory(args.root_dir, data_cfg.featurize_config())
     else:
         print("either DATA_DIR or --synthetic N is required", file=sys.stderr)
         return 2
-    print(f"featurized {len(graphs)} structures in {time.perf_counter() - t0:.1f}s")
+    if not (args.cache and os.path.exists(args.cache)):
+        print(f"featurized {len(graphs)} structures "
+              f"in {time.perf_counter() - t0:.1f}s")
+        if args.cache:
+            from cgnn_tpu.data.cache import save_graph_cache
+
+            save_graph_cache(graphs, args.cache)
+            print(f"wrote cache {args.cache}")
 
     train_g, val_g, test_g = train_val_test_split(
         graphs, args.train_ratio, args.val_ratio, seed=args.seed
