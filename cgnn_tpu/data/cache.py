@@ -61,6 +61,8 @@ def save_graph_cache(graphs: Sequence[CrystalGraph], path: str) -> None:
         payload["positions"] = np.concatenate([g.positions for g in graphs])
         payload["lattices"] = np.stack([g.lattice for g in graphs])
         payload["offsets"] = np.concatenate([g.offsets for g in graphs])
+    if all(g.forces is not None for g in graphs):
+        payload["forces"] = np.concatenate([g.forces for g in graphs])
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
@@ -103,6 +105,7 @@ def load_graph_cache(path: str) -> list[CrystalGraph]:
                 positions=z["positions"][ns] if has_geom else None,
                 lattice=np.asarray(z["lattices"][i]) if has_geom else None,
                 offsets=z["offsets"][ne] if has_geom else None,
+                forces=z["forces"][ns] if "forces" in z else None,
             )
         )
     return graphs

@@ -47,6 +47,7 @@ class CrystalGraph:
     offsets: np.ndarray | None = None  # [E, 3] int32 periodic images
     distances: np.ndarray | None = None  # [E] raw distances
     target_mask: np.ndarray | None = None  # [T] 1.0 where label present
+    forces: np.ndarray | None = None  # [N, 3] per-atom force labels (MD17)
 
     @property
     def num_nodes(self) -> int:
@@ -74,6 +75,7 @@ class GraphBatch(struct.PyTreeNode):
     positions: Any  # [Ncap, 3] f32
     lattices: Any  # [Gcap, 3, 3] f32
     edge_offsets: Any  # [Ecap, 3] f32
+    node_targets: Any  # [Ncap, 3] f32 per-atom force labels; zeros when unused
 
     @property
     def node_capacity(self) -> int:
@@ -140,6 +142,7 @@ def pack_graphs(
     positions = np.zeros((node_cap, 3), np.float32)
     lattices = np.zeros((graph_cap, 3, 3), np.float32)
     edge_offsets = np.zeros((edge_cap, 3), np.float32)
+    node_targets = np.zeros((node_cap, 3), np.float32)
 
     node_off, edge_off = 0, 0
     for gi, g in enumerate(graphs):
@@ -172,6 +175,8 @@ def pack_graphs(
             lattices[gi] = g.lattice
         if g.offsets is not None and ne:
             edge_offsets[edge_off : edge_off + ne] = g.offsets[order]
+        if g.forces is not None:
+            node_targets[node_off : node_off + nn] = g.forces
         node_off += nn
         edge_off += ne
 
@@ -189,6 +194,7 @@ def pack_graphs(
         positions=positions,
         lattices=lattices,
         edge_offsets=edge_offsets,
+        node_targets=node_targets,
     )
 
 

@@ -94,3 +94,120 @@ def synthetic_dataset(
         t = synthetic_target(s, noise, rng)
         out.append((f"synth-{i:06d}", s, t))
     return out
+
+
+def lj_energy_forces(
+    structure: Structure, epsilon: float = 0.4, sigma: float = 2.2,
+    cutoff: float = 6.0,
+) -> tuple[float, np.ndarray]:
+    """Lennard-Jones energy + analytic forces under PBC (MD17 stand-in).
+
+    Physical ground truth for the force head: forces are exactly -dE/dr of
+    a smooth pair potential, so a correct model/autodiff pipeline can fit
+    both consistently (SURVEY.md §7 phase 7).
+    """
+    from cgnn_tpu.data.neighbors import neighbor_list
+
+    nl = neighbor_list(structure, cutoff)
+    cart = structure.cart_coords
+    rel = (
+        cart[nl.neighbors]
+        + nl.offsets.astype(np.float64) @ structure.lattice
+        - cart[nl.centers]
+    )  # vector from center i to neighbor j
+    r = np.linalg.norm(rel, axis=1)
+    sr6 = (sigma / r) ** 6
+    # each ordered pair appears twice -> half energy per ordered pair
+    energy = float(np.sum(2.0 * epsilon * (sr6**2 - sr6)))
+    # dE/dr per ordered pair (full pair derivative split symmetrically)
+    dEdr = 4.0 * epsilon * (-12.0 * sr6**2 + 6.0 * sr6) / r
+    f_pair = -(dEdr / r)[:, None] * rel  # force on i from j (ordered pair)
+    forces = np.zeros_like(cart)
+    np.add.at(forces, nl.centers, f_pair)
+    return energy, forces.astype(np.float32)
+
+
+def synthetic_trajectory(
+    num_frames: int,
+    seed: int = 0,
+    num_atoms: int = 8,
+    jitter: float = 0.25,
+) -> list[tuple[str, Structure, float, np.ndarray]]:
+    """MD17-like trajectory: one cell, per-frame position jitter, LJ labels.
+
+    [(id, Structure, energy, forces[N,3])]; energies/forces are consistent
+    (same potential), so fitting both is well-posed.
+    """
+    rng = np.random.default_rng(seed)
+    base = random_structure(rng, num_atoms, num_atoms, a_range=(5.5, 7.0))
+    out = []
+    for k in range(num_frames):
+        fracs = base.frac_coords + rng.normal(0, jitter, base.frac_coords.shape) @ np.linalg.inv(base.lattice)
+        s = Structure(base.lattice, fracs, base.numbers)
+        e, f = lj_energy_forces(s)
+        out.append((f"frame-{k:05d}", s, e, f))
+    return out
+
+
+def synthetic_slab(
+    rng: np.random.Generator,
+    nx: int = 3,
+    ny: int = 3,
+    layers: int = 4,
+    a0: float = 3.9,
+    adsorbate_atoms: int = 2,
+) -> Structure:
+    """OC20-like catalyst slab: fcc(100)-ish surface + small adsorbate.
+
+    Produces the large-graph regime (50-200+ atoms, vacuum gap, surface
+    under-coordination) that BASELINE config #4 calls 'large catalyst-surface
+    graphs'."""
+    metal = int(rng.choice([26, 27, 28, 29, 42, 46, 47, 74, 78, 79]))
+    ads = rng.choice([1, 6, 7, 8], size=adsorbate_atoms)
+    vacuum = 12.0
+    lattice = np.diag([nx * a0, ny * a0, layers * a0 / 2 + vacuum])
+    fracs, numbers = [], []
+    for iz in range(layers):
+        for ix in range(nx):
+            for iy in range(ny):
+                off = 0.5 if iz % 2 else 0.0
+                fracs.append([
+                    ((ix + off) / nx) % 1.0,
+                    ((iy + off) / ny) % 1.0,
+                    (iz * a0 / 2) / lattice[2, 2],
+                ])
+                numbers.append(metal)
+    surface_z = (layers - 1) * a0 / 2
+    for k, z in enumerate(ads):
+        fracs.append([
+            rng.uniform(0, 1),
+            rng.uniform(0, 1),
+            (surface_z + 1.6 + 1.1 * k) / lattice[2, 2],
+        ])
+        numbers.append(int(z))
+    s = Structure(lattice, np.array(fracs), np.array(numbers, np.int32))
+    # small thermal rattle so graphs aren't perfectly degenerate
+    return Structure(
+        lattice,
+        s.frac_coords + rng.normal(0, 0.01, s.frac_coords.shape),
+        s.numbers,
+    )
+
+
+def synthetic_oc20_dataset(
+    num_structures: int, seed: int = 0
+) -> list[tuple[str, Structure, float]]:
+    """[(id, slab Structure, adsorption-energy-like target)]."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(num_structures):
+        s = synthetic_slab(
+            rng,
+            nx=int(rng.integers(2, 4)),
+            ny=int(rng.integers(2, 4)),
+            layers=int(rng.integers(3, 6)),
+            adsorbate_atoms=int(rng.integers(1, 4)),
+        )
+        t = synthetic_target(s, noise=0.02, rng=rng)
+        out.append((f"slab-{i:06d}", s, t))
+    return out

@@ -54,6 +54,7 @@ def empty_batch_like(batch: GraphBatch) -> GraphBatch:
         positions=np.zeros_like(batch.positions),
         lattices=np.zeros_like(batch.lattices),
         edge_offsets=np.zeros_like(batch.edge_offsets),
+        node_targets=np.zeros_like(batch.node_targets),
     )
 
 
