@@ -1,0 +1,54 @@
+"""Entrypoint regression tests (SURVEY.md §4.4, VERDICT.md next-step #9).
+
+These run the driver-facing and user-facing entrypoints the way their real
+callers do — in subprocesses with realistic (sometimes hostile) environments
+— to catch the platform/env bug class that unit tests cannot see.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, env_overrides=None, timeout=600):
+    env = dict(os.environ)
+    # simulate the driver env: no pytest-conftest CPU pinning
+    env.pop("_CGNN_DRYRUN_CHILD", None)
+    if env_overrides:
+        env.update(env_overrides)
+    return subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def test_dryrun_multichip_survives_pinned_axon_platform():
+    """The driver pins JAX_PLATFORMS to the real-TPU tunnel; the dry run
+    must self-provision a virtual CPU mesh anyway (round-1 red check)."""
+    code = "import __graft_entry__ as g; g.dryrun_multichip(2)"
+    proc = _run(
+        [sys.executable, "-c", code],
+        env_overrides={"JAX_PLATFORMS": "axon", "XLA_FLAGS": ""},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "step ok" in proc.stdout, proc.stdout
+
+
+def test_dryrun_multichip_child_guard_runs_inline():
+    """With the child guard set, dryrun must execute inline (no recursion)."""
+    code = (
+        "import __graft_entry__ as g; g.dryrun_multichip(2); "
+        "import sys; print('CHILDMODE-DONE')"
+    )
+    proc = _run(
+        [sys.executable, "-c", code],
+        env_overrides={
+            "_CGNN_DRYRUN_CHILD": "1",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "CHILDMODE-DONE" in proc.stdout
