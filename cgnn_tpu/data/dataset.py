@@ -75,7 +75,10 @@ def featurize_structure(
         distances=nl.distances,
     )
     if keep_geometry:
-        graph.positions = structure.cart_coords.astype(np.float32)
+        # neighbor offsets are computed against WRAPPED coordinates (both
+        # neighbor backends wrap fracs into [0,1)); stored geometry must
+        # match or in-model edge_distances() recomputes wrong distances
+        graph.positions = structure.wrapped().cart_coords.astype(np.float32)
         graph.lattice = structure.lattice.astype(np.float32)
         graph.offsets = nl.offsets.astype(np.int32)
     return graph

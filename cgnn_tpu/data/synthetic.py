@@ -121,7 +121,9 @@ def lj_energy_forces(
     energy = float(np.sum(2.0 * epsilon * (sr6**2 - sr6)))
     # dE/dr per ordered pair (full pair derivative split symmetrically)
     dEdr = 4.0 * epsilon * (-12.0 * sr6**2 + 6.0 * sr6) / r
-    f_pair = -(dEdr / r)[:, None] * rel  # force on i from j (ordered pair)
+    # F_i = -dE/dr_i; with rel = r_j - r_i, dr/dr_i = -rel/r, so the force
+    # on i from the ordered pair (i,j) is +(dE/dr)(rel/r)
+    f_pair = (dEdr / r)[:, None] * rel
     forces = np.zeros_like(cart)
     np.add.at(forces, nl.centers, f_pair)
     return energy, forces.astype(np.float32)

@@ -1,0 +1,78 @@
+"""Force-path correctness: LJ ground truth + stored-geometry consistency.
+
+Covers the two silent-corruption bugs ADVICE.md (round 1) identified:
+sign-flipped LJ forces and unwrapped stored geometry.
+"""
+
+import numpy as np
+import pytest
+
+from cgnn_tpu.data.dataset import FeaturizeConfig, featurize_structure
+from cgnn_tpu.data.structure import Structure, lattice_from_parameters
+from cgnn_tpu.data.synthetic import (
+    lj_energy_forces,
+    random_structure,
+    synthetic_trajectory,
+)
+
+
+def test_lj_forces_match_finite_differences():
+    """F must equal -dE/dx of the same energy function (central diff)."""
+    rng = np.random.default_rng(7)
+    s = random_structure(rng, 6, 6, a_range=(5.5, 7.0))
+    energy, forces = lj_energy_forces(s)
+    assert np.isfinite(energy)
+    inv_lat = np.linalg.inv(s.lattice)
+    h = 1e-5
+    cart = s.cart_coords
+    for atom in range(s.num_atoms):
+        for axis in range(3):
+            for sign, store in ((+1, "p"), (-1, "m")):
+                c = cart.copy()
+                c[atom, axis] += sign * h
+                e = lj_energy_forces(Structure(s.lattice, c @ inv_lat, s.numbers))[0]
+                if store == "p":
+                    ep = e
+                else:
+                    em = e
+            fd_force = -(ep - em) / (2 * h)
+            assert forces[atom, axis] == pytest.approx(fd_force, rel=1e-3, abs=1e-5)
+
+
+def test_lj_forces_sum_to_zero():
+    """Newton's third law: net force on a periodic cell is zero."""
+    rng = np.random.default_rng(3)
+    s = random_structure(rng, 8, 8, a_range=(5.5, 7.0))
+    _, forces = lj_energy_forces(s)
+    np.testing.assert_allclose(forces.sum(axis=0), 0.0, atol=1e-4)
+
+
+def test_trajectory_labels_are_consistent():
+    frames = synthetic_trajectory(3, seed=1, num_atoms=6)
+    for _, s, e, f in frames:
+        e2, f2 = lj_energy_forces(s)
+        assert e == pytest.approx(e2)
+        np.testing.assert_allclose(f, f2, atol=1e-6)
+
+
+def test_keep_geometry_stores_wrapped_positions():
+    """Stored positions + offsets must reproduce the neighbor-list distances
+    even when input fractional coordinates fall outside [0, 1)."""
+    lattice = lattice_from_parameters(5.5, 6.0, 6.5, 88.0, 92.0, 95.0)
+    # deliberately out-of-cell fracs (synthetic_trajectory jitter regime)
+    fracs = np.array(
+        [
+            [0.1, 0.2, 0.3],
+            [-0.35, 0.6, 1.42],
+            [0.7, 1.15, -0.2],
+            [2.3, 0.4, 0.55],
+        ]
+    )
+    s = Structure(lattice, fracs, np.array([8, 14, 26, 29], np.int32))
+    g = featurize_structure(
+        s, 0.0, FeaturizeConfig(radius=6.0, max_num_nbr=12), keep_geometry=True
+    )
+    shift = g.offsets.astype(np.float64) @ g.lattice.astype(np.float64)
+    rel = g.positions[g.neighbors].astype(np.float64) + shift - g.positions[g.centers].astype(np.float64)
+    recomputed = np.linalg.norm(rel, axis=1)
+    np.testing.assert_allclose(recomputed, g.distances, rtol=1e-5, atol=1e-5)
