@@ -57,6 +57,27 @@ class ModelConfig:
         )
 
 
+def build_model(model_cfg: "ModelConfig", data_cfg: "DataConfig",
+                task: str = "regression"):
+    """Build the model for a task; the force task needs the edge featurization
+    hyperparameters in-model (distances are recomputed differentiably from
+    positions — models/forcefield.py)."""
+    if task == "force":
+        from cgnn_tpu.models.forcefield import ForceFieldCGCNN
+
+        return ForceFieldCGCNN(
+            atom_fea_len=model_cfg.atom_fea_len,
+            n_conv=model_cfg.n_conv,
+            h_fea_len=model_cfg.h_fea_len,
+            dmin=data_cfg.dmin,
+            dmax=data_cfg.radius,
+            step=data_cfg.step,
+            dtype=jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32,
+            aggregation_impl=model_cfg.aggregation,
+        )
+    return model_cfg.build()
+
+
 @dataclasses.dataclass
 class DataConfig:
     radius: float = 8.0

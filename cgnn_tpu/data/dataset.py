@@ -140,6 +140,35 @@ def load_synthetic(
     ]
 
 
+def load_trajectory(
+    num_frames: int,
+    cfg: FeaturizeConfig | None = None,
+    seed: int = 0,
+    num_atoms: int = 8,
+    jitter: float = 0.08,
+) -> list[CrystalGraph]:
+    """MD17 stand-in: LJ trajectory frames with energy + force labels.
+
+    Graphs carry geometry (positions/lattice/offsets) so the differentiable
+    force model can recompute distances in-model, plus per-atom ``forces``
+    labels for the composite loss (BASELINE config #5).
+    """
+    from cgnn_tpu.data.synthetic import synthetic_trajectory
+
+    cfg = cfg or FeaturizeConfig()
+    gdf = cfg.gdf()
+    graphs = []
+    for sid, s, energy, forces in synthetic_trajectory(
+        num_frames, seed=seed, num_atoms=num_atoms, jitter=jitter
+    ):
+        g = featurize_structure(
+            s, energy, cfg, sid, gdf, keep_geometry=True
+        )
+        g.forces = forces.astype(np.float32)
+        graphs.append(g)
+    return graphs
+
+
 def train_val_test_split(
     graphs: Sequence[CrystalGraph],
     train_ratio: float = 0.8,

@@ -26,6 +26,7 @@ def random_structure(
     min_atoms: int = 2,
     max_atoms: int = 12,
     a_range: tuple[float, float] = (3.5, 7.5),
+    min_separation: float = 1.2,
 ) -> Structure:
     """Random near-orthorhombic cell with a minimum-separation rejection pass."""
     n = int(rng.integers(min_atoms, max_atoms + 1))
@@ -36,10 +37,11 @@ def random_structure(
     # avoids coincident sites which would create zero-distance edges)
     fracs: list[np.ndarray] = []
     for _ in range(n):
-        for _attempt in range(64):
+        for _attempt in range(256):
             cand = rng.uniform(0, 1, size=3)
             if all(
-                np.linalg.norm(((cand - f + 0.5) % 1.0 - 0.5) @ lattice) > 1.2
+                np.linalg.norm(((cand - f + 0.5) % 1.0 - 0.5) @ lattice)
+                > min_separation
                 for f in fracs
             ):
                 break
@@ -133,15 +135,20 @@ def synthetic_trajectory(
     num_frames: int,
     seed: int = 0,
     num_atoms: int = 8,
-    jitter: float = 0.25,
+    jitter: float = 0.08,
 ) -> list[tuple[str, Structure, float, np.ndarray]]:
     """MD17-like trajectory: one cell, per-frame position jitter, LJ labels.
 
     [(id, Structure, energy, forces[N,3])]; energies/forces are consistent
-    (same potential), so fitting both is well-posed.
+    (same potential), so fitting both is well-posed. Atoms start near the LJ
+    equilibrium distance (r_eq = 2^(1/6)·σ ≈ 2.47 Å for the default σ=2.2)
+    and the default jitter keeps pair distances off the r^-13 repulsive wall,
+    so label magnitudes stay O(1) like a real MD trajectory's.
     """
     rng = np.random.default_rng(seed)
-    base = random_structure(rng, num_atoms, num_atoms, a_range=(5.5, 7.0))
+    base = random_structure(
+        rng, num_atoms, num_atoms, a_range=(6.0, 7.5), min_separation=2.5
+    )
     out = []
     for k in range(num_frames):
         fracs = base.frac_coords + rng.normal(0, jitter, base.frac_coords.shape) @ np.linalg.inv(base.lattice)

@@ -59,9 +59,18 @@ class ForceFieldCGCNN(nn.Module):
 
     @nn.compact
     def __call__(
-        self, batch: GraphBatch, positions: jax.Array, train: bool = False
+        self,
+        batch: GraphBatch,
+        positions: jax.Array | None = None,
+        train: bool = False,
     ) -> jax.Array:
-        """-> per-graph total energies [G] (padding slots zero)."""
+        """-> per-graph total energies [G] (padding slots zero).
+
+        ``positions`` defaults to ``batch.positions``; the force path passes
+        it explicitly so it can differentiate with respect to it.
+        """
+        if positions is None:
+            positions = batch.positions
         d = edge_distances(batch, positions)
         edge_fea = gaussian_expand(
             d.astype(self.dtype), self.dmin, self.dmax, self.step
@@ -75,6 +84,8 @@ class ForceFieldCGCNN(nn.Module):
                 features=self.atom_fea_len,
                 dtype=self.dtype,
                 aggregation_impl=self.aggregation_impl,
+                # BatchNorm breaks train/eval force consistency (see CGConv)
+                use_batchnorm=False,
                 name=f"conv_{i}",
             )(
                 nodes,
@@ -108,7 +119,9 @@ def energy_and_forces(
             e, mutated = model.apply(
                 variables, batch, pos, train=True, mutable=["batch_stats"]
             )
-            return jnp.sum(e), (e, mutated["batch_stats"])
+            # the trunk is BatchNorm-free (see CGConv.use_batchnorm), so the
+            # mutated collection is typically empty
+            return jnp.sum(e), (e, mutated.get("batch_stats", {}))
         e = model.apply(variables, batch, pos, train=False)
         return jnp.sum(e), (e, None)
 

@@ -100,10 +100,19 @@ def _squeeze0(tree):
 
 
 def make_parallel_train_step(
-    mesh: Mesh, classification: bool = False, loss_fn: Callable | None = None
+    mesh: Mesh,
+    classification: bool = False,
+    loss_fn: Callable | None = None,
+    inner_step: Callable | None = None,
 ) -> Callable:
-    """shard_map-wrapped train step: (replicated state, [D,...] batch)."""
-    inner = make_train_step(classification, axis_name="data", loss_fn=loss_fn)
+    """shard_map-wrapped train step: (replicated state, [D,...] batch).
+
+    ``inner_step`` overrides the default step body entirely (it must already
+    be built with ``axis_name='data'`` — e.g. the force-task step).
+    """
+    inner = inner_step or make_train_step(
+        classification, axis_name="data", loss_fn=loss_fn
+    )
 
     def body(state: TrainState, stacked: GraphBatch):
         return inner(state, _squeeze0(stacked))
@@ -119,9 +128,14 @@ def make_parallel_train_step(
 
 
 def make_parallel_eval_step(
-    mesh: Mesh, classification: bool = False, loss_fn: Callable | None = None
+    mesh: Mesh,
+    classification: bool = False,
+    loss_fn: Callable | None = None,
+    inner_step: Callable | None = None,
 ) -> Callable:
-    inner = make_eval_step(classification, axis_name="data", loss_fn=loss_fn)
+    inner = inner_step or make_eval_step(
+        classification, axis_name="data", loss_fn=loss_fn
+    )
 
     def body(state: TrainState, stacked: GraphBatch):
         return inner(state, _squeeze0(stacked))
@@ -157,14 +171,26 @@ def fit_data_parallel(
     log_fn: Callable = print,
     start_epoch: int = 0,
     mesh: Mesh | None = None,
+    train_step_fn: Callable | None = None,
+    eval_step_fn: Callable | None = None,
+    best_metric: str | None = None,
 ) -> tuple[TrainState, dict]:
-    """DP twin of train.loop.fit; ``batch_size`` is per device."""
+    """DP twin of train.loop.fit; ``batch_size`` is per device.
+
+    ``train_step_fn``/``eval_step_fn`` override the step bodies (they must
+    be built with ``axis_name='data'``); ``best_metric`` overrides the
+    model-selection key.
+    """
     from cgnn_tpu.parallel.mesh import make_mesh
 
     mesh = mesh or make_mesh()
     n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
-    train_step = make_parallel_train_step(mesh, classification)
-    eval_step = make_parallel_eval_step(mesh, classification)
+    train_step = make_parallel_train_step(
+        mesh, classification, inner_step=train_step_fn
+    )
+    eval_step = make_parallel_eval_step(
+        mesh, classification, inner_step=eval_step_fn
+    )
     state = replicate_state(state, mesh)
     best = -np.inf if classification else np.inf
     history = []
@@ -201,17 +227,20 @@ def fit_data_parallel(
                 vsums[k] = vsums.get(k, 0.0) + float(v)
         vcount = max(vsums.get("count", 1.0), 1.0)
         val_m = {
-            k[: -len("_sum")]: v / vcount
+            k[: -len("_sum")]: v / max(
+                vsums.get(k[: -len("_sum")] + "_count", vcount), 1.0
+            )
             for k, v in vsums.items() if k.endswith("_sum")
         }
-        metric = val_m.get("correct" if classification else "mae", np.nan)
+        best_key = best_metric or ("correct" if classification else "mae")
+        metric = val_m.get(best_key, np.nan)
         is_best = metric > best if classification else metric < best
         if is_best:
             best = metric
         history.append({"epoch": epoch, "train_loss": train_loss, "val": val_m})
         log_fn(
             f"Epoch {epoch} [dp x{n_dev}]: train loss {train_loss:.4f}"
-            f"  val {'acc' if classification else 'mae'} {metric:.4f}"
+            f"  val {best_key} {metric:.4f}"
             f"{' *' if is_best else ''}  ({time.perf_counter() - t0:.1f}s)"
         )
         if on_epoch_end is not None:

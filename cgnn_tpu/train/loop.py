@@ -76,11 +76,20 @@ def run_epoch(
             ]
             if "mae_sum" in sums:
                 parts.append(f"MAE {sums['mae_sum'] / count:.4f}")
+            if "force_mae_sum" in sums:
+                fcount = max(sums.get("force_mae_count", 1.0), 1.0)
+                parts.append(f"F-MAE {sums['force_mae_sum'] / fcount:.4f}")
             if "correct_sum" in sums:
                 parts.append(f"Acc {sums['correct_sum'] / count:.4f}")
             log_fn("  ".join(parts))
     count = max(sums.get("count", 1.0), 1.0)
-    out = {k[: -len("_sum")]: v / count for k, v in sums.items() if k.endswith("_sum")}
+    # each "<name>_sum" averages by its matching "<name>_count" when present
+    # (e.g. force MAE counts atom components, not graphs), else by "count"
+    out = {
+        k[: -len("_sum")]: v / max(sums.get(k[: -len("_sum")] + "_count", count), 1.0)
+        for k, v in sums.items()
+        if k.endswith("_sum")
+    }
     out["count"] = sums.get("count", 0.0)
     out["steps"] = it + 1
     return state, out
@@ -101,16 +110,26 @@ def fit(
     on_epoch_end: Callable | None = None,
     log_fn: Callable = print,
     start_epoch: int = 0,
+    train_step_fn: Callable | None = None,
+    eval_step_fn: Callable | None = None,
+    best_metric: str | None = None,
 ) -> tuple[TrainState, dict]:
-    """Reference ``main()`` loop: train/validate per epoch, track best."""
+    """Reference ``main()`` loop: train/validate per epoch, track best.
+
+    ``train_step_fn``/``eval_step_fn`` override the default task steps (the
+    force task passes its composite-loss steps); ``best_metric`` overrides
+    the model-selection metric key (lower-is-better unless classification).
+    """
     if node_cap is None or edge_cap is None:
         nc, ec = capacities_for(train_graphs, batch_size)
         node_cap, edge_cap = node_cap or nc, edge_cap or ec
     from cgnn_tpu.data.loader import prefetch_to_device
 
-    train_step = jax.jit(make_train_step(classification), donate_argnums=0)
-    eval_step = jax.jit(make_eval_step(classification))
-    best_key = "acc" if classification else "mae"
+    train_step = jax.jit(
+        train_step_fn or make_train_step(classification), donate_argnums=0
+    )
+    eval_step = jax.jit(eval_step_fn or make_eval_step(classification))
+    best_key = best_metric or ("correct" if classification else "mae")
     best = -np.inf if classification else np.inf
     history = []
     rng = np.random.default_rng(seed)
@@ -140,7 +159,7 @@ def fit(
             epoch=epoch,
             log_fn=log_fn,
         )
-        metric = val_m.get("correct" if classification else "mae", np.nan)
+        metric = val_m.get(best_key, np.nan)
         is_best = metric > best if classification else metric < best
         if is_best:
             best = metric
@@ -162,8 +181,9 @@ def evaluate(
     node_cap: int,
     edge_cap: int,
     classification: bool = False,
+    eval_step_fn: Callable | None = None,
 ) -> dict:
-    eval_step = jax.jit(make_eval_step(classification))
+    eval_step = jax.jit(eval_step_fn or make_eval_step(classification))
     _, metrics = run_epoch(
         eval_step,
         state,

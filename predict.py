@@ -43,8 +43,12 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
-    from cgnn_tpu.config import DataConfig, ModelConfig
-    from cgnn_tpu.data.dataset import load_cif_directory, load_synthetic
+    from cgnn_tpu.config import DataConfig, ModelConfig, build_model
+    from cgnn_tpu.data.dataset import (
+        load_cif_directory,
+        load_synthetic,
+        load_trajectory,
+    )
     from cgnn_tpu.data.graph import batch_iterator
     from cgnn_tpu.train import CheckpointManager, Normalizer, create_train_state, make_optimizer
     from cgnn_tpu.train.loop import capacities_for
@@ -59,12 +63,20 @@ def main(argv=None) -> int:
     meta = mgr.read_meta(tag)
     model_cfg = ModelConfig.from_meta(meta["model"])
     data_cfg = DataConfig.from_meta(meta["data"])
-    model = model_cfg.build()
+    task = meta.get("task", "regression")
+    force_task = task == "force"
+    model = build_model(model_cfg, data_cfg, task)
 
     if args.synthetic:
-        graphs = load_synthetic(args.synthetic, data_cfg.featurize_config())
+        if force_task:
+            graphs = load_trajectory(args.synthetic, data_cfg.featurize_config())
+        else:
+            graphs = load_synthetic(args.synthetic, data_cfg.featurize_config())
     else:
-        graphs = load_cif_directory(args.root_dir, data_cfg.featurize_config())
+        graphs = load_cif_directory(
+            args.root_dir, data_cfg.featurize_config(),
+            keep_geometry=force_task,
+        )
     node_cap, edge_cap = capacities_for(graphs, args.batch_size)
 
     from cgnn_tpu.data.graph import pack_graphs
@@ -77,11 +89,25 @@ def main(argv=None) -> int:
     )
     state = mgr.restore_for_inference(state, tag)
 
-    predict_step = jax.jit(make_predict_step())
+    if force_task:
+        from cgnn_tpu.train.force_step import make_force_predict_step
+
+        predict_step = jax.jit(make_force_predict_step())
+    else:
+        predict_step = jax.jit(make_predict_step())
     rows = []
+    force_ids: list[str] = []
+    force_arrays: list[np.ndarray] = []
     idx = 0
     for batch in batch_iterator(graphs, args.batch_size, node_cap, edge_cap):
-        preds = np.asarray(jax.device_get(predict_step(state, batch)))
+        out = jax.device_get(predict_step(state, batch))
+        if force_task:
+            energies, forces = (np.asarray(out[0]), np.asarray(out[1]))
+            preds = energies[:, None]
+            node_graph = np.asarray(batch.node_graph)
+            node_mask = np.asarray(batch.node_mask) > 0
+        else:
+            preds = np.asarray(out)
         n_real = int(np.asarray(batch.graph_mask).sum())
         for k in range(n_real):
             g = graphs[idx]
@@ -90,10 +116,20 @@ def main(argv=None) -> int:
                 + [f"{t:.6f}" for t in np.atleast_1d(g.target)]
                 + [f"{p:.6f}" for p in preds[k]]
             )
+            if force_task:
+                force_ids.append(g.cif_id)
+                force_arrays.append(forces[(node_graph == k) & node_mask])
             idx += 1
     with open(args.out, "w", newline="") as f:
         csv.writer(f).writerows(rows)
     print(f"wrote {len(rows)} predictions to {args.out}")
+    if force_task:
+        np.savez(
+            args.out + ".forces.npz",
+            ids=np.array(force_ids),
+            **{f"forces_{i}": f for i, f in enumerate(force_arrays)},
+        )
+        print(f"wrote per-atom forces to {args.out}.forces.npz")
     mgr.close()
     return 0
 

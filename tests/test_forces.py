@@ -55,6 +55,48 @@ def test_trajectory_labels_are_consistent():
         np.testing.assert_allclose(f, f2, atol=1e-6)
 
 
+def test_force_training_fits_lj_ground_truth():
+    """End-to-end config #5: composite energy+force loss on LJ trajectory
+    frames; force MAE vs the analytic forces must drop far below the
+    untrained model and below an absolute bound (measured ~0.15 at 60
+    epochs; bound leaves 2x margin). BASELINE config #5, SURVEY.md §7 ph. 7."""
+    import jax
+
+    from cgnn_tpu.data.dataset import load_trajectory
+    from cgnn_tpu.data.graph import pack_graphs
+    from cgnn_tpu.models.forcefield import ForceFieldCGCNN
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.force_step import (
+        make_force_eval_step,
+        make_force_train_step,
+    )
+    from cgnn_tpu.train.loop import capacities_for, evaluate, fit
+
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    graphs = load_trajectory(320, cfg, seed=0, num_atoms=6)
+    train_g, val_g = graphs[:280], graphs[280:]
+    norm = Normalizer.fit(np.stack([g.target for g in train_g]))
+    model = ForceFieldCGCNN(atom_fea_len=64, n_conv=3, h_fea_len=64, dmax=6.0)
+    node_cap, edge_cap = capacities_for(graphs, 32)
+    example = pack_graphs(train_g[:32], node_cap, edge_cap, 32)
+    state = create_train_state(
+        model, example, make_optimizer(optim="adam", lr=2e-3), norm,
+        rng=jax.random.key(0),
+    )
+    ev = make_force_eval_step()
+    m0 = evaluate(state, val_g, 32, node_cap, edge_cap, eval_step_fn=ev)
+    state, _ = fit(
+        state, train_g, val_g, epochs=60, batch_size=32,
+        node_cap=node_cap, edge_cap=edge_cap, print_freq=0,
+        train_step_fn=make_force_train_step(),
+        eval_step_fn=ev, best_metric="force_mae", log_fn=lambda *_: None,
+    )
+    m1 = evaluate(state, val_g, 32, node_cap, edge_cap, eval_step_fn=ev)
+    assert float(m1["force_mae"]) < 0.25 * float(m0["force_mae"])
+    assert float(m1["force_mae"]) < 0.30
+    assert float(m1["mae"]) < float(m0["mae"])  # energy improves too
+
+
 def test_keep_geometry_stores_wrapped_positions():
     """Stored positions + offsets must reproduce the neighbor-list distances
     even when input fractional coordinates fall outside [0, 1)."""

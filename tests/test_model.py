@@ -128,11 +128,15 @@ class TestForceField:
         assert stats is None  # eval mode
         assert np.all(np.isfinite(energies)) and np.all(np.isfinite(forces))
         np.testing.assert_allclose(energies[len(graphs):], 0.0)
-        # train mode returns updated running stats for the state update
-        _, _, new_stats = energy_and_forces(model, variables, batch, train=True)
-        assert new_stats is not None
-        leaves = jax.tree_util.tree_leaves(new_stats)
-        assert leaves and all(np.all(np.isfinite(l)) for l in leaves)
+        # the force trunk is BatchNorm-free by design (train/eval force
+        # consistency — see CGConv.use_batchnorm), so train mode returns an
+        # empty stats collection and train == eval energies
+        e_train, f_train, new_stats = energy_and_forces(
+            model, variables, batch, train=True
+        )
+        assert jax.tree_util.tree_leaves(new_stats) == []
+        np.testing.assert_allclose(e_train, energies, rtol=1e-5)
+        np.testing.assert_allclose(f_train, forces, rtol=1e-5, atol=1e-6)
 
     def test_translation_invariance(self, graphs):
         """Rigid translation changes no distances -> forces sum to ~0."""

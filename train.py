@@ -28,8 +28,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dataset dir: {id}.cif files + id_prop.csv")
     p.add_argument("--synthetic", type=int, default=0, metavar="N",
                    help="train on N synthetic crystals instead of root_dir")
-    p.add_argument("--task", choices=["regression", "classification"],
-                   default="regression")
+    p.add_argument("--task",
+                   choices=["regression", "classification", "force"],
+                   default="regression",
+                   help="'force' trains the differentiable force field on "
+                        "energy+force labels (BASELINE config #5)")
     p.add_argument("--device", choices=["auto", "cpu", "tpu"], default="auto",
                    help="accelerator (reference flag; 'auto' uses what jax finds)")
     p.add_argument("--epochs", type=int, default=30)
@@ -69,6 +72,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-dir", type=str, default="checkpoints")
     p.add_argument("--node-cap", type=int, default=0, help="0 = auto")
     p.add_argument("--edge-cap", type=int, default=0, help="0 = auto")
+    # force task (BASELINE config #5)
+    p.add_argument("--energy-weight", type=float, default=1.0,
+                   help="w_e in L = w_e*MSE(E) + w_f*MSE(F)")
+    p.add_argument("--force-weight", type=float, default=10.0,
+                   help="w_f in L = w_e*MSE(E) + w_f*MSE(F)")
+    p.add_argument("--md-atoms", type=int, default=8,
+                   help="atoms per frame for --synthetic MD trajectories")
+    p.add_argument("--md-jitter", type=float, default=0.08,
+                   help="per-frame Cartesian jitter (Å) for synthetic MD")
     # TPU-native additions
     p.add_argument("--data-parallel", action="store_true",
                    help="shard batches over all visible devices (DP over ICI)")
@@ -90,10 +102,11 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
-    from cgnn_tpu.config import DataConfig, ModelConfig
+    from cgnn_tpu.config import DataConfig, ModelConfig, build_model
     from cgnn_tpu.data.dataset import (
         load_cif_directory,
         load_synthetic,
+        load_trajectory,
         train_val_test_split,
     )
     from cgnn_tpu.train import (
@@ -123,8 +136,18 @@ def main(argv=None) -> int:
         print(f"loaded {len(graphs)} graphs from {args.cache} "
               f"in {time.perf_counter() - t0:.1f}s")
     elif args.synthetic:
-        graphs = load_synthetic(args.synthetic, data_cfg.featurize_config(),
-                                seed=args.seed)
+        if args.task == "force":
+            graphs = load_trajectory(
+                args.synthetic, data_cfg.featurize_config(), seed=args.seed,
+                num_atoms=args.md_atoms, jitter=args.md_jitter,
+            )
+        else:
+            graphs = load_synthetic(args.synthetic, data_cfg.featurize_config(),
+                                    seed=args.seed)
+    elif args.task == "force":
+        print("--task force requires --synthetic N (no offline force-labeled "
+              "CIF format is defined)", file=sys.stderr)
+        return 2
     elif args.root_dir:
         if args.workers != 1:
             from cgnn_tpu.data.cache import featurize_directory_parallel
@@ -154,6 +177,7 @@ def main(argv=None) -> int:
     )
     num_targets = int(train_g[0].target.shape[0])
     classification = args.task == "classification"
+    force_task = args.task == "force"
 
     model_cfg = ModelConfig(
         atom_fea_len=args.atom_fea_len, n_conv=args.n_conv,
@@ -162,7 +186,7 @@ def main(argv=None) -> int:
         dropout=args.dropout, dtype="bfloat16" if args.bf16 else "float32",
         aggregation=args.aggregation,
     )
-    model = model_cfg.build()
+    model = build_model(model_cfg, data_cfg, args.task)
 
     if classification:
         normalizer = Normalizer.identity(num_targets)
@@ -203,35 +227,62 @@ def main(argv=None) -> int:
 
     meta_base = {"model": model_cfg.to_meta(), "data": data_cfg.to_meta(),
                  "task": args.task}
+    sel_key = "force_mae" if force_task else (
+        "correct" if classification else "mae")
+    save_cb = lambda s, e, m, b: ckpt.save(  # noqa: E731
+        s, dict(meta_base, epoch=e, best_mae=m.get(sel_key, -1.0)), is_best=b
+    )
+
+    step_overrides = {}
+    eval_step_fn = None
+    if force_task:
+        from cgnn_tpu.train.force_step import (
+            make_force_eval_step,
+            make_force_train_step,
+        )
+
+        eval_step_fn = make_force_eval_step(args.energy_weight, args.force_weight)
+        step_overrides = {"best_metric": "force_mae"}
 
     if args.data_parallel and len(devices) > 1:
         from cgnn_tpu.parallel import fit_data_parallel
 
+        if force_task:
+            step_overrides |= {
+                "train_step_fn": make_force_train_step(
+                    args.energy_weight, args.force_weight, axis_name="data"
+                ),
+                "eval_step_fn": make_force_eval_step(
+                    args.energy_weight, args.force_weight, axis_name="data"
+                ),
+            }
         state, result = fit_data_parallel(
             state, train_g, val_g, epochs=args.epochs, batch_size=args.batch_size,
             node_cap=node_cap, edge_cap=edge_cap, classification=classification,
             seed=args.seed, print_freq=args.print_freq,
-            on_epoch_end=lambda s, e, m, b: ckpt.save(
-                s, dict(meta_base, epoch=e, best_mae=m.get("mae", -1.0)), is_best=b
-            ),
-            start_epoch=start_epoch,
+            on_epoch_end=save_cb, start_epoch=start_epoch, **step_overrides,
         )
     else:
+        if force_task:
+            step_overrides |= {
+                "train_step_fn": make_force_train_step(
+                    args.energy_weight, args.force_weight
+                ),
+                "eval_step_fn": eval_step_fn,
+            }
         state, result = fit(
             state, train_g, val_g, epochs=args.epochs, batch_size=args.batch_size,
             node_cap=node_cap, edge_cap=edge_cap, classification=classification,
             seed=args.seed, print_freq=args.print_freq,
-            on_epoch_end=lambda s, e, m, b: ckpt.save(
-                s, dict(meta_base, epoch=e, best_mae=m.get("mae", -1.0)), is_best=b
-            ),
-            start_epoch=start_epoch,
+            on_epoch_end=save_cb, start_epoch=start_epoch, **step_overrides,
         )
 
     test_m = evaluate(state, test_g, args.batch_size, node_cap, edge_cap,
-                      classification)
-    key = "correct" if classification else "mae"
-    print(f"** test {key}: {test_m.get(key, float('nan')):.4f} "
+                      classification, eval_step_fn=eval_step_fn)
+    print(f"** test {sel_key}: {test_m.get(sel_key, float('nan')):.4f} "
           f"(best val: {result['best']:.4f})")
+    if force_task:
+        print(f"** test energy mae: {test_m.get('mae', float('nan')):.4f}")
     ckpt.close()
     return 0
 
