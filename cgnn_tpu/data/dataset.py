@@ -140,6 +140,26 @@ def load_synthetic(
     ]
 
 
+def load_synthetic_oc20(
+    num_structures: int,
+    cfg: FeaturizeConfig | None = None,
+    seed: int = 0,
+) -> list[CrystalGraph]:
+    """OC20 IS2RE stand-in: large catalyst-slab graphs (50-200+ atoms).
+
+    Exercises the large-graph regime of BASELINE config #4 — surface
+    under-coordination, vacuum gaps, and a wide node/edge size spread that
+    stresses the bucketed batcher (SURVEY.md §2 [B:10])."""
+    from cgnn_tpu.data.synthetic import synthetic_oc20_dataset
+
+    cfg = cfg or FeaturizeConfig()
+    gdf = cfg.gdf()
+    return [
+        featurize_structure(s, t, cfg, sid, gdf)
+        for sid, s, t in synthetic_oc20_dataset(num_structures, seed)
+    ]
+
+
 def load_trajectory(
     num_frames: int,
     cfg: FeaturizeConfig | None = None,

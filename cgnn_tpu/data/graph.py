@@ -215,6 +215,152 @@ def pad_batch(
     return pack_graphs(graphs, node_cap, edge_cap, graph_cap)
 
 
+def capacities_for(
+    graphs: Sequence[CrystalGraph], batch_size: int, headroom: float = 1.15
+) -> tuple[int, int]:
+    """Pick one (node_cap, edge_cap) for a dataset so every shuffled batch
+    fits: batch_size * max-per-graph sizes would be safe but wasteful; use
+    mean + headroom over the largest observed, bucketed. Fine ladder floors
+    (16/128) keep small-graph buckets tight — a 64-node floor would cap
+    padding efficiency at ~60% for 8x5-atom batches."""
+    nodes = np.array([g.num_nodes for g in graphs])
+    edges = np.array([g.num_edges for g in graphs])
+    node_cap = round_to_bucket(
+        int(max(batch_size * nodes.mean() * headroom, nodes.max())), minimum=16
+    )
+    edge_cap = round_to_bucket(
+        int(max(batch_size * edges.mean() * headroom, edges.max())), minimum=128
+    )
+    return node_cap, edge_cap
+
+
+@dataclasses.dataclass
+class PaddingStats:
+    """Accumulates padding efficiency over an epoch of packed batches.
+
+    Efficiency = real slots / allocated slots; the figure the bucketing
+    policy optimizes (SURVEY.md §5 long-context analog, §7 hard parts #1).
+    """
+
+    real_nodes: int = 0
+    real_edges: int = 0
+    slot_nodes: int = 0
+    slot_edges: int = 0
+    batches: int = 0
+    shapes: set = dataclasses.field(default_factory=set)
+
+    def update(self, batch: GraphBatch) -> None:
+        self.real_nodes += int(np.asarray(batch.node_mask).sum())
+        self.real_edges += int(np.asarray(batch.edge_mask).sum())
+        self.slot_nodes += batch.node_capacity
+        self.slot_edges += batch.edge_capacity
+        self.batches += 1
+        self.shapes.add((batch.node_capacity, batch.edge_capacity))
+
+    @property
+    def node_efficiency(self) -> float:
+        return self.real_nodes / max(self.slot_nodes, 1)
+
+    @property
+    def edge_efficiency(self) -> float:
+        return self.real_edges / max(self.slot_edges, 1)
+
+    def wrap(self, iterator):
+        """Pass batches through while accumulating stats."""
+        for b in iterator:
+            self.update(b)
+            yield b
+
+    def summary(self) -> str:
+        return (
+            f"padding efficiency: nodes {self.node_efficiency:.1%}, "
+            f"edges {self.edge_efficiency:.1%} over {self.batches} batches, "
+            f"{len(self.shapes)} compiled shape(s)"
+        )
+
+
+def assign_size_buckets(
+    graphs: Sequence[CrystalGraph], n_buckets: int
+) -> np.ndarray:
+    """Bucket index per graph by node-count quantiles ([len(graphs)] int)."""
+    sizes = np.array([g.num_nodes for g in graphs])
+    if n_buckets <= 1:
+        return np.zeros(len(graphs), np.int64)
+    cuts = np.quantile(sizes, np.linspace(0, 1, n_buckets + 1)[1:-1])
+    return np.searchsorted(cuts, sizes, side="left")
+
+
+def bucketed_batch_iterator(
+    graphs: Sequence[CrystalGraph],
+    batch_size: int,
+    n_buckets: int,
+    shuffle: bool = False,
+    rng: np.random.Generator | None = None,
+    stats: PaddingStats | None = None,
+    headroom: float = 1.15,
+):
+    """Yield batches using per-size-class static capacities.
+
+    Graphs are partitioned into ``n_buckets`` size classes (node-count
+    quantiles); each class batches with its own (node_cap, edge_cap), so the
+    jitted step compiles at most ``n_buckets`` distinct shapes while padding
+    tracks each class's actual size distribution — the multi-bucket
+    "long-context" policy for mixed MP+OC20 datasets (SURVEY.md §5).
+    Batches from different classes interleave (weighted random under
+    ``shuffle``) to avoid size-ordered epochs.
+    """
+    rng = rng or np.random.default_rng()
+    bucket_of = assign_size_buckets(graphs, n_buckets)
+    iters, weights = [], []
+    for b in range(int(bucket_of.max()) + 1):
+        idxs = np.nonzero(bucket_of == b)[0]
+        if len(idxs) == 0:
+            continue
+        sub = [graphs[int(i)] for i in idxs]
+        nc, ec = capacities_for(sub, batch_size, headroom)
+        it = batch_iterator(sub, batch_size, nc, ec, shuffle=shuffle, rng=rng)
+        iters.append(stats.wrap(it) if stats is not None else it)
+        weights.append(float(len(idxs)))
+    active = list(range(len(iters)))
+    w = np.array(weights)
+    while active:
+        if shuffle and len(active) > 1:
+            p = w[active] / w[active].sum()
+            pick = int(rng.choice(active, p=p))
+        else:
+            pick = active[0]
+        try:
+            yield next(iters[pick])
+        except StopIteration:
+            active.remove(pick)
+
+
+def count_batches(
+    graphs: Sequence[CrystalGraph],
+    batch_size: int,
+    node_cap: int,
+    edge_cap: int,
+) -> int:
+    """Exact number of batches ``batch_iterator`` yields, without packing.
+
+    ``len(graphs) // batch_size`` undercounts because capacity-filled
+    batches split early; LR-milestone step conversion needs the real count.
+    """
+    count, in_bucket, nn, ne = 0, 0, 0, 0
+    for g in graphs:
+        if in_bucket and (
+            in_bucket == batch_size
+            or nn + g.num_nodes > node_cap
+            or ne + g.num_edges > edge_cap
+        ):
+            count += 1
+            in_bucket, nn, ne = 0, 0, 0
+        in_bucket += 1
+        nn += g.num_nodes
+        ne += g.num_edges
+    return count + (1 if in_bucket else 0)
+
+
 def batch_iterator(
     graphs: Sequence[CrystalGraph],
     batch_size: int,

@@ -210,11 +210,13 @@ def synthetic_oc20_dataset(
     rng = np.random.default_rng(seed)
     out = []
     for i in range(num_structures):
+        # 3x3x4+1 = 37 up to 6x6x7+3 = 255 atoms — the 50-200+ regime
+        # BASELINE config #4 calls "large catalyst-surface graphs"
         s = synthetic_slab(
             rng,
-            nx=int(rng.integers(2, 4)),
-            ny=int(rng.integers(2, 4)),
-            layers=int(rng.integers(3, 6)),
+            nx=int(rng.integers(3, 7)),
+            ny=int(rng.integers(3, 7)),
+            layers=int(rng.integers(4, 8)),
             adsorbate_atoms=int(rng.integers(1, 4)),
         )
         t = synthetic_target(s, noise=0.02, rng=rng)

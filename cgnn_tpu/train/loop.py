@@ -15,27 +15,18 @@ from typing import Callable, Iterable, Sequence
 import jax
 import numpy as np
 
-from cgnn_tpu.data.graph import CrystalGraph, GraphBatch, batch_iterator, round_to_bucket
+from cgnn_tpu.data.graph import (
+    CrystalGraph,
+    GraphBatch,
+    PaddingStats,
+    batch_iterator,
+    bucketed_batch_iterator,
+    capacities_for,  # re-exported; moved to data/graph.py
+    round_to_bucket,
+)
 from cgnn_tpu.train.metrics import AverageMeter
 from cgnn_tpu.train.state import TrainState
 from cgnn_tpu.train.step import make_eval_step, make_train_step
-
-
-def capacities_for(
-    graphs: Sequence[CrystalGraph], batch_size: int, headroom: float = 1.15
-) -> tuple[int, int]:
-    """Pick one (node_cap, edge_cap) for a dataset so every shuffled batch
-    fits: batch_size * max-per-graph sizes would be safe but wasteful; use
-    mean + headroom over the largest observed, bucketed."""
-    nodes = np.array([g.num_nodes for g in graphs])
-    edges = np.array([g.num_edges for g in graphs])
-    node_cap = round_to_bucket(
-        int(max(batch_size * nodes.mean() * headroom, nodes.max()))
-    )
-    edge_cap = round_to_bucket(
-        int(max(batch_size * edges.mean() * headroom, edges.max()))
-    )
-    return node_cap, edge_cap
 
 
 def run_epoch(
@@ -113,17 +104,38 @@ def fit(
     train_step_fn: Callable | None = None,
     eval_step_fn: Callable | None = None,
     best_metric: str | None = None,
+    buckets: int = 1,
 ) -> tuple[TrainState, dict]:
     """Reference ``main()`` loop: train/validate per epoch, track best.
 
     ``train_step_fn``/``eval_step_fn`` override the default task steps (the
     force task passes its composite-loss steps); ``best_metric`` overrides
     the model-selection metric key (lower-is-better unless classification).
+    ``buckets > 1`` batches with per-size-class capacities (at most
+    ``buckets`` compiled step shapes) instead of one global capacity.
     """
     if node_cap is None or edge_cap is None:
         nc, ec = capacities_for(train_graphs, batch_size)
         node_cap, edge_cap = node_cap or nc, edge_cap or ec
     from cgnn_tpu.data.loader import prefetch_to_device
+
+    def train_batches(rng):
+        if buckets > 1:
+            return bucketed_batch_iterator(
+                train_graphs, batch_size, buckets, shuffle=True, rng=rng,
+                stats=pad_stats,
+            )
+        return pad_stats.wrap(
+            batch_iterator(
+                train_graphs, batch_size, node_cap, edge_cap,
+                shuffle=True, rng=rng,
+            )
+        )
+
+    def val_batches():
+        if buckets > 1:
+            return bucketed_batch_iterator(val_graphs, batch_size, buckets)
+        return batch_iterator(val_graphs, batch_size, node_cap, edge_cap)
 
     train_step = jax.jit(
         train_step_fn or make_train_step(classification), donate_argnums=0
@@ -133,17 +145,13 @@ def fit(
     best = -np.inf if classification else np.inf
     history = []
     rng = np.random.default_rng(seed)
+    pad_stats = PaddingStats()
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         state, train_m = run_epoch(
             train_step,
             state,
-            prefetch_to_device(
-                batch_iterator(
-                    train_graphs, batch_size, node_cap, edge_cap,
-                    shuffle=True, rng=rng,
-                )
-            ),
+            prefetch_to_device(train_batches(rng)),
             train=True,
             print_freq=print_freq,
             epoch=epoch,
@@ -152,13 +160,13 @@ def fit(
         _, val_m = run_epoch(
             eval_step,
             state,
-            prefetch_to_device(
-                batch_iterator(val_graphs, batch_size, node_cap, edge_cap)
-            ),
+            prefetch_to_device(val_batches()),
             train=False,
             epoch=epoch,
             log_fn=log_fn,
         )
+        if epoch == start_epoch:
+            log_fn(pad_stats.summary())
         metric = val_m.get(best_key, np.nan)
         is_best = metric > best if classification else metric < best
         if is_best:

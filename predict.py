@@ -79,10 +79,9 @@ def main(argv=None) -> int:
         )
     node_cap, edge_cap = capacities_for(graphs, args.batch_size)
 
-    from cgnn_tpu.data.graph import pack_graphs
-
-    example = pack_graphs(graphs[: args.batch_size], node_cap, edge_cap,
-                          args.batch_size)
+    # take the example from the iterator (respects capacities; a direct
+    # pack_graphs of an oversize head batch would fail)
+    example = next(batch_iterator(graphs, args.batch_size, node_cap, edge_cap))
     state = create_train_state(
         model, example, make_optimizer(),
         Normalizer.identity(model_cfg.num_targets), rng=jax.random.key(0),

@@ -1,0 +1,103 @@
+"""Multi-bucket batching + OC20 large-graph regime (BASELINE config #4,
+SURVEY.md §5 long-context analog)."""
+
+import numpy as np
+
+from cgnn_tpu.data.dataset import (
+    FeaturizeConfig,
+    load_synthetic,
+    load_synthetic_oc20,
+)
+from cgnn_tpu.data.graph import (
+    PaddingStats,
+    batch_iterator,
+    bucketed_batch_iterator,
+    capacities_for,
+    count_batches,
+)
+
+CFG = FeaturizeConfig(radius=5.0, max_num_nbr=10)
+
+
+def _mixed_graphs():
+    """Bimodal size mix: small MP-like crystals + large OC20-like slabs."""
+    small = load_synthetic(24, CFG, seed=0, max_atoms=8)
+    big = load_synthetic_oc20(8, CFG, seed=1)
+    return small + big
+
+
+def test_oc20_graphs_are_large():
+    graphs = load_synthetic_oc20(8, CFG, seed=0)
+    sizes = [g.num_nodes for g in graphs]
+    assert min(sizes) >= 20
+    assert max(sizes) >= 50  # the large-graph regime config #4 targets
+
+
+def test_count_batches_matches_iterator():
+    graphs = _mixed_graphs()
+    nc, ec = capacities_for(graphs, 8)
+    n = sum(1 for _ in batch_iterator(graphs, 8, nc, ec))
+    assert count_batches(graphs, 8, nc, ec) == n
+    # and the naive len//batch_size estimate is indeed wrong here
+    assert n >= len(graphs) // 8
+
+
+def test_bucketed_iterator_yields_every_graph_once():
+    graphs = _mixed_graphs()
+    for shuffle in (False, True):
+        ids = []
+        for batch in bucketed_batch_iterator(
+            graphs, 8, 3, shuffle=shuffle, rng=np.random.default_rng(0)
+        ):
+            node_graph = np.asarray(batch.node_graph)
+            node_mask = np.asarray(batch.node_mask) > 0
+            for k in range(int(np.asarray(batch.graph_mask).sum())):
+                ids.append(int(((node_graph == k) & node_mask).sum()))
+        assert len(ids) == len(graphs)
+        assert sorted(ids) == sorted(g.num_nodes for g in graphs)
+
+
+def test_bucketed_iterator_bounds_compiled_shapes():
+    graphs = _mixed_graphs()
+    stats = PaddingStats()
+    for _ in bucketed_batch_iterator(graphs, 8, 3, stats=stats):
+        pass
+    assert 1 <= len(stats.shapes) <= 3
+
+
+def test_buckets_beat_single_capacity_on_bimodal_mix():
+    graphs = _mixed_graphs()
+    nc, ec = capacities_for(graphs, 8)
+    single = PaddingStats()
+    for b in single.wrap(batch_iterator(graphs, 8, nc, ec)):
+        pass
+    multi = PaddingStats()
+    for _ in bucketed_batch_iterator(graphs, 8, 3, stats=multi):
+        pass
+    assert multi.node_efficiency > single.node_efficiency
+
+
+def test_oc20_trains_end_to_end_with_buckets():
+    """Slab graphs pack, batch with buckets, and loss decreases."""
+    import jax
+
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.loop import fit
+
+    graphs = load_synthetic_oc20(32, CFG, seed=2)
+    train_g, val_g = graphs[:28], graphs[28:]
+    norm = Normalizer.fit(np.stack([g.target for g in train_g]))
+    model = CrystalGraphConvNet(atom_fea_len=32, n_conv=2, h_fea_len=32)
+    nc, ec = capacities_for(train_g, 8)
+    example = next(batch_iterator(train_g, 8, nc, ec))
+    state = create_train_state(
+        model, example, make_optimizer(optim="adam", lr=3e-3), norm,
+        rng=jax.random.key(0),
+    )
+    state, res = fit(
+        state, train_g, val_g, epochs=8, batch_size=8, buckets=2,
+        print_freq=0, log_fn=lambda *_: None,
+    )
+    losses = [h["train"]["loss"] for h in res["history"]]
+    assert losses[-1] < 0.5 * losses[0]

@@ -28,6 +28,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dataset dir: {id}.cif files + id_prop.csv")
     p.add_argument("--synthetic", type=int, default=0, metavar="N",
                    help="train on N synthetic crystals instead of root_dir")
+    p.add_argument("--synthetic-oc20", type=int, default=0, metavar="N",
+                   help="train on N synthetic OC20-like catalyst slabs "
+                        "(50-200+ atom graphs; BASELINE config #4)")
     p.add_argument("--task",
                    choices=["regression", "classification", "force"],
                    default="regression",
@@ -72,6 +75,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-dir", type=str, default="checkpoints")
     p.add_argument("--node-cap", type=int, default=0, help="0 = auto")
     p.add_argument("--edge-cap", type=int, default=0, help="0 = auto")
+    p.add_argument("--buckets", type=int, default=1,
+                   help="size-class buckets for batching (>1 compiles one "
+                        "step per bucket; better padding on mixed-size data)")
     # force task (BASELINE config #5)
     p.add_argument("--energy-weight", type=float, default=1.0,
                    help="w_e in L = w_e*MSE(E) + w_f*MSE(F)")
@@ -106,6 +112,7 @@ def main(argv=None) -> int:
     from cgnn_tpu.data.dataset import (
         load_cif_directory,
         load_synthetic,
+        load_synthetic_oc20,
         load_trajectory,
         train_val_test_split,
     )
@@ -135,6 +142,10 @@ def main(argv=None) -> int:
         graphs = load_graph_cache(args.cache)
         print(f"loaded {len(graphs)} graphs from {args.cache} "
               f"in {time.perf_counter() - t0:.1f}s")
+    elif args.synthetic_oc20:
+        graphs = load_synthetic_oc20(
+            args.synthetic_oc20, data_cfg.featurize_config(), seed=args.seed
+        )
     elif args.synthetic:
         if args.task == "force":
             graphs = load_trajectory(
@@ -202,17 +213,22 @@ def main(argv=None) -> int:
     node_cap, edge_cap = capacities_for(train_g, args.batch_size)
     node_cap = args.node_cap or node_cap
     edge_cap = args.edge_cap or edge_cap
-    steps_per_epoch = max(1, len(train_g) // args.batch_size)
+    # real batch count (capacity-filled batches split early, so
+    # len//batch_size undercounts and milestones would decay too early)
+    from cgnn_tpu.data.graph import batch_iterator, count_batches
+
+    steps_per_epoch = max(1, count_batches(
+        train_g, args.batch_size, node_cap, edge_cap
+    ))
     tx = make_optimizer(
         optim=args.optim.lower(), lr=args.lr, momentum=args.momentum,
         weight_decay=args.weight_decay,
         lr_milestones=[m * steps_per_epoch for m in args.lr_milestones],
     )
 
-    from cgnn_tpu.data.graph import pack_graphs
-
-    example = pack_graphs(train_g[: args.batch_size], node_cap, edge_cap,
-                          args.batch_size)
+    # the iterator respects capacities (direct pack_graphs of an oversize
+    # head batch would die with an opaque broadcast error)
+    example = next(batch_iterator(train_g, args.batch_size, node_cap, edge_cap))
     state = create_train_state(model, example, tx, normalizer,
                                rng=jax.random.key(args.seed))
 
@@ -274,7 +290,8 @@ def main(argv=None) -> int:
             state, train_g, val_g, epochs=args.epochs, batch_size=args.batch_size,
             node_cap=node_cap, edge_cap=edge_cap, classification=classification,
             seed=args.seed, print_freq=args.print_freq,
-            on_epoch_end=save_cb, start_epoch=start_epoch, **step_overrides,
+            on_epoch_end=save_cb, start_epoch=start_epoch,
+            buckets=args.buckets, **step_overrides,
         )
 
     test_m = evaluate(state, test_g, args.batch_size, node_cap, edge_cap,
