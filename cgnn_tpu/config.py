@@ -24,6 +24,9 @@ class ModelConfig:
     dropout: float = 0.0
     dtype: str = "float32"  # 'float32' | 'bfloat16'
     aggregation: str | None = None  # None -> global default
+    # config #3: per-task MLP stacks over the shared trunk instead of one
+    # shared fc_out with T outputs (models/heads.py MultiTaskHead)
+    multi_task_head: bool = False
 
     def to_meta(self) -> dict:
         return dataclasses.asdict(self) | {
@@ -35,6 +38,7 @@ class ModelConfig:
         fields = {f.name for f in dataclasses.fields(cls)}
         kw = {k: v for k, v in meta.items() if k in fields}
         kw["classification"] = bool(kw.get("classification", 0))
+        kw["multi_task_head"] = bool(kw.get("multi_task_head", 0))
         if kw.get("aggregation") in ("__none__", None):
             kw["aggregation"] = None
         return cls(**kw)
@@ -42,6 +46,15 @@ class ModelConfig:
     def build(self, head=None):
         from cgnn_tpu.models import CrystalGraphConvNet
 
+        if head is None and self.multi_task_head and not self.classification:
+            from cgnn_tpu.models.heads import MultiTaskHead
+
+            head = MultiTaskHead(
+                num_tasks=self.num_targets,
+                h_fea_len=self.h_fea_len,
+                n_h=self.n_h,
+                dtype=jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32,
+            )
         return CrystalGraphConvNet(
             atom_fea_len=self.atom_fea_len,
             n_conv=self.n_conv,

@@ -303,3 +303,41 @@ def parse_cif(text: str, occupancy_tol: float = 0.999) -> Structure:
 def parse_cif_file(path) -> Structure:
     with open(path, "r", encoding="utf-8", errors="replace") as f:
         return parse_cif(f.read())
+
+
+def structure_to_cif(structure: Structure, name: str = "structure") -> str:
+    """Minimal P1 CIF text for a Structure (round-trips through parse_cif).
+
+    Inverse of the parser for the subset the pipeline needs: P1 cells with
+    explicit sites (symmetry-expanded output, no symmetry operations).
+    """
+    from cgnn_tpu.data.elements import Z_TO_SYMBOL
+
+    a, b, c, alpha, beta, gamma = structure.lattice_parameters()
+    lines = [
+        f"data_{name}",
+        f"_cell_length_a {a:.6f}",
+        f"_cell_length_b {b:.6f}",
+        f"_cell_length_c {c:.6f}",
+        f"_cell_angle_alpha {alpha:.6f}",
+        f"_cell_angle_beta {beta:.6f}",
+        f"_cell_angle_gamma {gamma:.6f}",
+        "loop_",
+        "_atom_site_label",
+        "_atom_site_type_symbol",
+        "_atom_site_fract_x",
+        "_atom_site_fract_y",
+        "_atom_site_fract_z",
+    ]
+    fracs = structure.wrapped().frac_coords
+    for i, (z, f) in enumerate(zip(structure.numbers, fracs)):
+        sym = Z_TO_SYMBOL[int(z)]
+        lines.append(
+            f"{sym}{i + 1} {sym} {f[0]:.6f} {f[1]:.6f} {f[2]:.6f}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def write_cif_file(structure: Structure, path, name: str = "structure") -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(structure_to_cif(structure, name))

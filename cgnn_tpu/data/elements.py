@@ -138,6 +138,7 @@ ELEMENTS: dict[int, tuple] = {
 }
 
 SYMBOL_TO_Z: dict[str, int] = {v[0]: z for z, v in ELEMENTS.items()}
+Z_TO_SYMBOL: dict[int, str] = {z: v[0] for z, v in ELEMENTS.items()}
 
 MAX_Z = 100
 ATOM_FEA_DIM = 92

@@ -23,7 +23,12 @@ from cgnn_tpu.train.state import TrainState
 
 
 def regression_loss(out, batch: GraphBatch, normalizer):
-    """Masked MSE on normalized targets; metrics in original units."""
+    """Masked MSE on normalized targets; metrics in original units.
+
+    Multi-task outputs (T > 1, BASELINE config #3) additionally report one
+    MAE per task column, each averaged over its own label count (labels can
+    be missing per task via target_mask).
+    """
     t_norm = normalizer.norm(batch.targets)
     w = batch.target_mask * batch.graph_mask[:, None]
     se = (out - t_norm) ** 2 * w
@@ -31,6 +36,10 @@ def regression_loss(out, batch: GraphBatch, normalizer):
     loss = se.sum() / n
     ae = jnp.abs(normalizer.denorm(out) - batch.targets) * w
     metrics = {"loss_sum": se.sum(), "mae_sum": ae.sum(), "count": w.sum()}
+    if out.shape[-1] > 1:
+        for t in range(out.shape[-1]):
+            metrics[f"mae_task{t}_sum"] = ae[:, t].sum()
+            metrics[f"mae_task{t}_count"] = w[:, t].sum()
     return loss, metrics
 
 

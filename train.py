@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-h", type=int, default=1)
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--num-classes", type=int, default=2)
+    p.add_argument("--multi-task-head", action="store_true",
+                   help="per-task MLP heads over the shared trunk for "
+                        "multi-column targets (BASELINE config #3)")
     # featurization (reference names)
     p.add_argument("--max-num-nbr", type=int, default=12)
     p.add_argument("--radius", type=float, default=8.0)
@@ -195,7 +198,7 @@ def main(argv=None) -> int:
         h_fea_len=args.h_fea_len, n_h=args.n_h, num_targets=num_targets,
         classification=classification, num_classes=args.num_classes,
         dropout=args.dropout, dtype="bfloat16" if args.bf16 else "float32",
-        aggregation=args.aggregation,
+        aggregation=args.aggregation, multi_task_head=args.multi_task_head,
     )
     model = build_model(model_cfg, data_cfg, args.task)
 
@@ -300,6 +303,9 @@ def main(argv=None) -> int:
           f"(best val: {result['best']:.4f})")
     if force_task:
         print(f"** test energy mae: {test_m.get('mae', float('nan')):.4f}")
+    for t in range(num_targets):
+        if f"mae_task{t}" in test_m:
+            print(f"** test mae task {t}: {test_m[f'mae_task{t}']:.4f}")
     ckpt.close()
     return 0
 
