@@ -174,6 +174,7 @@ def fit_data_parallel(
     train_step_fn: Callable | None = None,
     eval_step_fn: Callable | None = None,
     best_metric: str | None = None,
+    on_epoch_metrics: Callable | None = None,
 ) -> tuple[TrainState, dict]:
     """DP twin of train.loop.fit; ``batch_size`` is per device.
 
@@ -243,6 +244,10 @@ def fit_data_parallel(
             f"  val {best_key} {metric:.4f}"
             f"{' *' if is_best else ''}  ({time.perf_counter() - t0:.1f}s)"
         )
+        if on_epoch_metrics is not None:
+            on_epoch_metrics(
+                epoch, {"loss": train_loss, "count": train_count}, val_m
+            )
         if on_epoch_end is not None:
             on_epoch_end(state, epoch, val_m, is_best)
     return state, {"best": best, "history": history}

@@ -105,6 +105,9 @@ def fit(
     eval_step_fn: Callable | None = None,
     best_metric: str | None = None,
     buckets: int = 1,
+    on_epoch_metrics: Callable | None = None,
+    profile_steps: int = 0,
+    profile_dir: str = "",
 ) -> tuple[TrainState, dict]:
     """Reference ``main()`` loop: train/validate per epoch, track best.
 
@@ -113,6 +116,10 @@ def fit(
     the model-selection metric key (lower-is-better unless classification).
     ``buckets > 1`` batches with per-size-class capacities (at most
     ``buckets`` compiled step shapes) instead of one global capacity.
+    ``on_epoch_metrics(epoch, train_m, val_m)`` fires after each epoch (the
+    machine-readable metrics hook); ``profile_steps > 0`` wraps that many
+    post-compile steps of the first epoch in ``jax.profiler.trace`` writing
+    to ``profile_dir``.
     """
     if node_cap is None or edge_cap is None:
         nc, ec = capacities_for(train_graphs, batch_size)
@@ -146,12 +153,36 @@ def fit(
     history = []
     rng = np.random.default_rng(seed)
     pad_stats = PaddingStats()
+
+    def _with_profile(iterator, epoch):
+        """Trace steps [1, 1+profile_steps) of the first epoch (step 0 is
+        the compile step; tracing it would swamp the timeline)."""
+        if not (profile_steps and epoch == start_epoch):
+            yield from iterator
+            return
+        import jax
+
+        tracing = False
+        try:
+            for i, b in enumerate(iterator):
+                if i == 1:
+                    jax.profiler.start_trace(profile_dir or "profile")
+                    tracing = True
+                yield b
+                if tracing and i >= profile_steps:
+                    jax.profiler.stop_trace()
+                    tracing = False
+                    log_fn(f"profiler trace written to {profile_dir}")
+        finally:
+            if tracing:
+                jax.profiler.stop_trace()
+
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         state, train_m = run_epoch(
             train_step,
             state,
-            prefetch_to_device(train_batches(rng)),
+            _with_profile(prefetch_to_device(train_batches(rng)), epoch),
             train=True,
             print_freq=print_freq,
             epoch=epoch,
@@ -177,6 +208,8 @@ def fit(
             f"  val {best_key} {metric:.4f}{' *' if is_best else ''}"
             f"  ({time.perf_counter() - t0:.1f}s)"
         )
+        if on_epoch_metrics is not None:
+            on_epoch_metrics(epoch, train_m, val_m)
         if on_epoch_end is not None:
             on_epoch_end(state, epoch, val_m, is_best)
     return state, {"best": best, "history": history}

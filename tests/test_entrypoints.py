@@ -36,6 +36,55 @@ def test_dryrun_multichip_survives_pinned_axon_platform():
     assert "step ok" in proc.stdout, proc.stdout
 
 
+def test_train_resume_predict_cycle(tmp_path):
+    """The reference workflow end to end, as subprocesses with a clean env:
+    train 2 epochs -> --resume 1 more -> predict.py -> CSV rows match.
+    Catches the platform/env regression class (VERDICT round 1 weak #1)."""
+    ckpt = str(tmp_path / "ckpt")
+    env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
+    base = [
+        sys.executable, "train.py", "--synthetic", "64", "--device", "cpu",
+        "--epochs", "2", "--optim", "Adam", "-b", "16", "--radius", "5",
+        "--ckpt-dir", ckpt, "--print-freq", "0",
+    ]
+    p1 = _run(base, env_overrides=env)
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    assert "Epoch 1:" in p1.stdout and "** test mae:" in p1.stdout
+
+    # machine-readable metrics were produced (SURVEY.md §5)
+    metrics_file = os.path.join(ckpt, "logs", "metrics.jsonl")
+    assert os.path.exists(metrics_file)
+    lines = open(metrics_file).read().strip().splitlines()
+    assert len(lines) >= 4  # train+val per epoch (+ test)
+    import json
+
+    rec = json.loads(lines[0])
+    assert "train/loss" in rec and rec["step"] == 0
+
+    assert base[6] == "--epochs"
+    p2 = _run(
+        base[:7] + ["3"] + base[8:] + ["--resume", ckpt],
+        env_overrides=env,
+    )
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    assert "resumed from" in p2.stdout and "at epoch 2" in p2.stdout
+    assert "Epoch 2:" in p2.stdout
+    assert "Epoch 0:" not in p2.stdout  # numbering continued, not restarted
+
+    out_csv = str(tmp_path / "preds.csv")
+    p3 = _run(
+        [sys.executable, "predict.py", ckpt, "unused", "--device", "cpu",
+         "--synthetic", "16", "-b", "16", "--out", out_csv],
+        env_overrides=env,
+    )
+    assert p3.returncode == 0, p3.stderr[-2000:]
+    rows = open(out_csv).read().strip().splitlines()
+    assert len(rows) == 16
+    cid, target, pred = rows[0].split(",")
+    float(target), float(pred)  # numeric columns
+    assert cid.startswith("synth-")
+
+
 def test_dryrun_multichip_child_guard_runs_inline():
     """With the child guard set, dryrun must execute inline (no recursion)."""
     code = (

@@ -76,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
     # runtime
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ckpt-dir", type=str, default="checkpoints")
+    # observability (SURVEY.md §5)
+    p.add_argument("--log-dir", type=str, default="",
+                   help="metrics dir (metrics.jsonl + TensorBoard when "
+                        "available); default: <ckpt-dir>/logs")
+    p.add_argument("--profile", type=int, default=0, metavar="N",
+                   help="trace N post-compile steps of the first epoch with "
+                        "jax.profiler (xprof/perfetto trace in the log dir)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="fail fast with a traceback at the first NaN")
     p.add_argument("--node-cap", type=int, default=0, help="0 = auto")
     p.add_argument("--edge-cap", type=int, default=0, help="0 = auto")
     p.add_argument("--buckets", type=int, default=1,
@@ -126,6 +135,11 @@ def main(argv=None) -> int:
         make_optimizer,
     )
     from cgnn_tpu.train.loop import capacities_for, evaluate, fit
+
+    if args.debug_nans:
+        from cgnn_tpu.train.observe import enable_debug_nans
+
+        enable_debug_nans()
 
     devices = jax.devices()
     if args.device == "tpu" and devices[0].platform not in ("tpu", "axon"):
@@ -252,6 +266,15 @@ def main(argv=None) -> int:
         s, dict(meta_base, epoch=e, best_mae=m.get(sel_key, -1.0)), is_best=b
     )
 
+    from cgnn_tpu.train.observe import MetricsLogger
+
+    log_dir = args.log_dir or os.path.join(args.ckpt_dir, "logs")
+    mlog = MetricsLogger(log_dir)
+
+    def log_epoch_metrics(epoch, train_m, val_m):
+        mlog.write(epoch, train_m, prefix="train")
+        mlog.write(epoch, val_m, prefix="val")
+
     step_overrides = {}
     eval_step_fn = None
     if force_task:
@@ -279,7 +302,8 @@ def main(argv=None) -> int:
             state, train_g, val_g, epochs=args.epochs, batch_size=args.batch_size,
             node_cap=node_cap, edge_cap=edge_cap, classification=classification,
             seed=args.seed, print_freq=args.print_freq,
-            on_epoch_end=save_cb, start_epoch=start_epoch, **step_overrides,
+            on_epoch_end=save_cb, start_epoch=start_epoch,
+            on_epoch_metrics=log_epoch_metrics, **step_overrides,
         )
     else:
         if force_task:
@@ -294,7 +318,9 @@ def main(argv=None) -> int:
             node_cap=node_cap, edge_cap=edge_cap, classification=classification,
             seed=args.seed, print_freq=args.print_freq,
             on_epoch_end=save_cb, start_epoch=start_epoch,
-            buckets=args.buckets, **step_overrides,
+            buckets=args.buckets, on_epoch_metrics=log_epoch_metrics,
+            profile_steps=args.profile, profile_dir=log_dir,
+            **step_overrides,
         )
 
     test_m = evaluate(state, test_g, args.batch_size, node_cap, edge_cap,
@@ -306,6 +332,32 @@ def main(argv=None) -> int:
     for t in range(num_targets):
         if f"mae_task{t}" in test_m:
             print(f"** test mae task {t}: {test_m[f'mae_task{t}']:.4f}")
+
+    if classification:
+        # full classification metric set (reference surfaces AUC/F1 too);
+        # needs raw per-structure scores, so run a predict pass on the host
+        from cgnn_tpu.data.graph import batch_iterator as _biter
+        from cgnn_tpu.train.metrics import class_eval
+        from cgnn_tpu.train.step import make_predict_step
+
+        pstep = jax.jit(make_predict_step())
+        scores, labels = [], []
+        idx = 0
+        for b in _biter(test_g, args.batch_size, node_cap, edge_cap):
+            out = np.asarray(jax.device_get(pstep(state, b)))
+            n_real = int(np.asarray(b.graph_mask).sum())
+            scores.append(out[:n_real])
+            labels.extend(
+                int(test_g[idx + k].target[0]) for k in range(n_real)
+            )
+            idx += n_real
+        cls = class_eval(np.concatenate(scores), np.array(labels))
+        test_m = dict(test_m, **cls)
+        print("** test " + "  ".join(
+            f"{k} {v:.4f}" for k, v in cls.items() if v == v))
+
+    mlog.write(args.epochs, test_m, prefix="test")
+    mlog.close()
     ckpt.close()
     return 0
 
