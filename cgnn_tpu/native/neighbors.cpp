@@ -2,11 +2,20 @@
 // preprocessor (SURVEY.md §2 native table: "pymatgen/spglib periodic
 // neighbor search" -> in-tree host kernel; §7 "hard parts" #2).
 //
+// Periodic CELL LIST in fractional space, O(n · density · r³) instead of
+// the O(n² · images) brute force: each axis is split into M_k bins
+// (M_k ≈ min(1/frac_range_k, ~cbrt(4n)) so bins stay populated); per center
+// atom only bins within the fractional search range are scanned. Scanned
+// bin indices may run past [0, M_k): the floor-division quotient IS the
+// periodic image offset of the atoms in that bin, so small cells (bin span
+// > one period) degrade gracefully into an image loop, matching the brute
+// force exactly.
+//
 // Same semantics as cgnn_tpu/data/neighbors.py::neighbor_list (the numpy
-// reference used in tests): fractional coords are wrapped into [0,1); the
-// image range per axis is ceil(radius / plane_spacing); self-pairs are
-// excluded only in the home image. Emits flat COO sorted by (center, order
-// of discovery) — the Python wrapper re-sorts by distance for knn anyway.
+// reference used in tests): fractional coords are wrapped into [0,1);
+// self-pairs are excluded only in the home image. Emits flat COO sorted by
+// (center, order of discovery) — the Python wrapper re-sorts by distance
+// for knn anyway.
 //
 // C ABI only (ctypes binding, no pybind11 in this image). Returns the pair
 // count, or -(needed_hint) when `cap` is too small so the caller can retry.
@@ -54,75 +63,113 @@ long long cgnn_neighbor_search(const double* lattice, const double* frac,
   double inv[9];
   if (!invert3(lattice, inv)) return -1;
 
-  // images per axis: ceil(radius * ||inv column k|| - eps)
-  int na[3];
+  // fractional search range per axis: any |v| <= radius has
+  // |frac_k| = |v . inv[:,k]| <= radius * ||inv column k||
+  double frange[3];
   for (int k = 0; k < 3; ++k) {
     const double norm = std::sqrt(inv[k] * inv[k] + inv[k + 3] * inv[k + 3] +
                                   inv[k + 6] * inv[k + 6]);
-    na[k] = static_cast<int>(std::ceil(radius * norm - 1e-12));
-    if (na[k] < 0) na[k] = 0;
+    frange[k] = radius * norm;
   }
 
-  // wrapped cartesian coordinates
+  // wrapped fractional + cartesian coordinates
+  std::vector<double> w(static_cast<size_t>(n) * 3);
   std::vector<double> cart(static_cast<size_t>(n) * 3);
   for (long long i = 0; i < n; ++i) {
-    double w[3];
     for (int k = 0; k < 3; ++k) {
       double fk = std::fmod(frac[i * 3 + k], 1.0);
       if (fk < 0) fk += 1.0;
-      w[k] = fk;
+      if (fk >= 1.0) fk = 0.0;  // tiny negatives wrap to exactly 1.0
+      w[i * 3 + k] = fk;
     }
     for (int k = 0; k < 3; ++k) {
-      cart[i * 3 + k] =
-          w[0] * lattice[0 + k] + w[1] * lattice[3 + k] + w[2] * lattice[6 + k];
+      cart[i * 3 + k] = w[i * 3] * lattice[0 + k] +
+                        w[i * 3 + 1] * lattice[3 + k] +
+                        w[i * 3 + 2] * lattice[6 + k];
     }
   }
 
-  // precompute image shift vectors
-  struct Shift {
-    double v[3];
-    int img[3];
+  // bins per axis: at most one bin per frange (so the scan stencil stays
+  // +-R with R small), capped near cbrt(4n) so bins stay populated
+  const int mcap =
+      std::max(1, static_cast<int>(std::cbrt(4.0 * static_cast<double>(n))) + 1);
+  int M[3], R[3];
+  for (int k = 0; k < 3; ++k) {
+    int m = frange[k] > 0 ? static_cast<int>(std::floor(1.0 / frange[k])) : mcap;
+    M[k] = std::max(1, std::min(m, mcap));
+    // stencil half-width: bin distance <= M*frange + 1 (floor rounding)
+    R[k] = static_cast<int>(std::floor(frange[k] * M[k])) + 1;
+  }
+  const long long nbins =
+      static_cast<long long>(M[0]) * M[1] * M[2];
+
+  // linked-list cell bins over wrapped fracs
+  std::vector<int32_t> head(static_cast<size_t>(nbins), -1);
+  std::vector<int32_t> nxt(static_cast<size_t>(n), -1);
+  std::vector<int32_t> bin_of(static_cast<size_t>(n) * 3);
+  for (long long i = 0; i < n; ++i) {
+    int b[3];
+    for (int k = 0; k < 3; ++k) {
+      b[k] = static_cast<int>(w[i * 3 + k] * M[k]);
+      if (b[k] >= M[k]) b[k] = M[k] - 1;  // w == 1.0-eps rounding guard
+      bin_of[i * 3 + k] = b[k];
+    }
+    const long long flat =
+        (static_cast<long long>(b[0]) * M[1] + b[1]) * M[2] + b[2];
+    nxt[i] = head[flat];
+    head[flat] = static_cast<int32_t>(i);
+  }
+
+  // Euclidean floor division: quotient -> image offset, remainder -> bin
+  const auto floordiv = [](int a, int m, int* rem) {
+    int q = a / m, r = a % m;
+    if (r < 0) {
+      r += m;
+      --q;
+    }
+    *rem = r;
+    return q;
   };
-  std::vector<Shift> shifts;
-  shifts.reserve(static_cast<size_t>(2 * na[0] + 1) * (2 * na[1] + 1) *
-                 (2 * na[2] + 1));
-  for (int ia = -na[0]; ia <= na[0]; ++ia)
-    for (int ib = -na[1]; ib <= na[1]; ++ib)
-      for (int ic = -na[2]; ic <= na[2]; ++ic) {
-        Shift s;
-        for (int k = 0; k < 3; ++k)
-          s.v[k] = ia * lattice[0 + k] + ib * lattice[3 + k] + ic * lattice[6 + k];
-        s.img[0] = ia;
-        s.img[1] = ib;
-        s.img[2] = ic;
-        shifts.push_back(s);
-      }
 
   const double r2 = radius * radius;
   long long count = 0;
   for (long long i = 0; i < n; ++i) {
     const double xi = cart[i * 3], yi = cart[i * 3 + 1], zi = cart[i * 3 + 2];
-    for (long long j = 0; j < n; ++j) {
-      const double dx0 = cart[j * 3] - xi;
-      const double dy0 = cart[j * 3 + 1] - yi;
-      const double dz0 = cart[j * 3 + 2] - zi;
-      for (const Shift& s : shifts) {
-        const bool home = s.img[0] == 0 && s.img[1] == 0 && s.img[2] == 0;
-        if (home && i == j) continue;
-        const double dx = dx0 + s.v[0];
-        const double dy = dy0 + s.v[1];
-        const double dz = dz0 + s.v[2];
-        const double d2 = dx * dx + dy * dy + dz * dz;
-        if (d2 <= r2) {
-          if (count < cap) {
-            centers[count] = static_cast<int32_t>(i);
-            neighbors[count] = static_cast<int32_t>(j);
-            dists[count] = static_cast<float>(std::sqrt(d2));
-            offsets[count * 3] = s.img[0];
-            offsets[count * 3 + 1] = s.img[1];
-            offsets[count * 3 + 2] = s.img[2];
+    const int bi0 = bin_of[i * 3], bi1 = bin_of[i * 3 + 1],
+              bi2 = bin_of[i * 3 + 2];
+    for (int da = -R[0]; da <= R[0]; ++da) {
+      int ba;
+      const int ma = floordiv(bi0 + da, M[0], &ba);
+      for (int db = -R[1]; db <= R[1]; ++db) {
+        int bb;
+        const int mb = floordiv(bi1 + db, M[1], &bb);
+        for (int dc = -R[2]; dc <= R[2]; ++dc) {
+          int bc;
+          const int mc = floordiv(bi2 + dc, M[2], &bc);
+          const double sx = ma * lattice[0] + mb * lattice[3] + mc * lattice[6];
+          const double sy = ma * lattice[1] + mb * lattice[4] + mc * lattice[7];
+          const double sz = ma * lattice[2] + mb * lattice[5] + mc * lattice[8];
+          const bool home = ma == 0 && mb == 0 && mc == 0;
+          const long long flat =
+              (static_cast<long long>(ba) * M[1] + bb) * M[2] + bc;
+          for (int32_t j = head[flat]; j >= 0; j = nxt[j]) {
+            if (home && j == i) continue;
+            const double dx = cart[j * 3] + sx - xi;
+            const double dy = cart[j * 3 + 1] + sy - yi;
+            const double dz = cart[j * 3 + 2] + sz - zi;
+            const double d2 = dx * dx + dy * dy + dz * dz;
+            if (d2 <= r2) {
+              if (count < cap) {
+                centers[count] = static_cast<int32_t>(i);
+                neighbors[count] = j;
+                dists[count] = static_cast<float>(std::sqrt(d2));
+                offsets[count * 3] = ma;
+                offsets[count * 3 + 1] = mb;
+                offsets[count * 3 + 2] = mc;
+              }
+              ++count;
+            }
           }
-          ++count;
         }
       }
     }
