@@ -1,14 +1,19 @@
 #!/usr/bin/env python
 """Benchmark: training throughput in structures/sec/chip (BASELINE.md).
 
-Measures steady-state jitted train-step throughput on the flagship CGCNN
-config (64-dim, 3 conv layers — BASELINE.json config #2 shape) over
-synthetic MP-like crystals, with ``jax.block_until_ready`` fencing and
-compile excluded (SURVEY.md §6 measurement protocol).
+Measures steady-state jitted train-step throughput of the flagship CGCNN
+config (64-dim, 3 conv layers — BASELINE.json config #2 shape), with
+``jax.block_until_ready`` fencing and compile excluded (SURVEY.md §6).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline is value / 10_000 (the driver's north-star target,
-BASELINE.json:5).
+The PRIMARY metric uses an MP-like size distribution (lognormal, ~30 atoms
+mean — Materials Project's actual regime), not tiny toy crystals; secondary
+numbers cover the OC20 slab distribution (config #4) and the legacy
+tiny-graph figure for cross-round comparability. Each workload reports
+padding efficiency and an analytic-FLOP MFU estimate (matmul FLOPs /
+measured time / chip peak).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
+where vs_baseline is value / 10_000 (BASELINE.json:5 north star).
 """
 
 from __future__ import annotations
@@ -16,30 +21,78 @@ from __future__ import annotations
 import json
 import time
 
+# bf16 matmul peak by device kind; conservative public numbers.
+_PEAK_FLOPS = {
+    "TPU v5 lite": 394e12,  # v5e
+    "TPU v5": 459e12,       # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # trillium
+}
+_DEFAULT_PEAK = 394e12
 
-def main() -> None:
+
+def _flops_per_batch(batch, atom_dim, gauss_dim, f, h, n_conv, n_h) -> float:
+    """Analytic matmul FLOPs for one fwd+bwd train step on real elements.
+
+    Counts the MXU work only (dense layers; fwd 2mnk, bwd ~2x fwd). Segment
+    ops / BN / elementwise are bandwidth-bound and excluded, as is padding
+    (so MFU reflects useful work, discounted by padding efficiency).
+    """
+    import numpy as np
+
+    n = float(np.asarray(batch.node_mask).sum())
+    e = float(np.asarray(batch.edge_mask).sum())
+    g = float(np.asarray(batch.graph_mask).sum())
+    fwd = (
+        2.0 * n * atom_dim * f                      # embedding
+        + n_conv * 2.0 * e * (2 * f + gauss_dim) * (2 * f)  # fc_full per conv
+        + 2.0 * g * f * h                           # conv_to_fc
+        + (n_h - 1) * 2.0 * g * h * h               # hidden fcs
+        + 2.0 * g * h                               # fc_out
+    )
+    return 3.0 * fwd  # fwd + ~2x bwd
+
+
+def _bench_workload(graphs, batch_size, *, buckets=1, n_timed=30, label=""):
+    """-> dict(structs_per_sec, mfu, node_eff, edge_eff, shapes)."""
     import jax
     import numpy as np
 
-    from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
-    from cgnn_tpu.data.graph import batch_iterator
+    from cgnn_tpu.data.graph import (
+        PaddingStats,
+        batch_iterator,
+        bucketed_batch_iterator,
+        capacities_for,
+    )
     from cgnn_tpu.models import CrystalGraphConvNet
     from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
-    from cgnn_tpu.train.loop import capacities_for
     from cgnn_tpu.train.step import make_train_step
 
-    batch_size = 512
-    n_structures = 4096
-    graphs = load_synthetic(
-        n_structures, FeaturizeConfig(radius=6.0, max_num_nbr=12), seed=0
-    )
-    node_cap, edge_cap = capacities_for(graphs, batch_size)
+    atom_dim = graphs[0].atom_fea.shape[1]
+    gauss_dim = graphs[0].edge_fea.shape[1]
+    f, h, n_conv, n_h = 64, 128, 3, 1
 
-    batches = list(batch_iterator(graphs, batch_size, node_cap, edge_cap))
+    stats = PaddingStats()
+    if buckets > 1:
+        batches = list(
+            bucketed_batch_iterator(
+                graphs, batch_size, buckets, stats=stats,
+                rng=np.random.default_rng(0),
+            )
+        )
+    else:
+        node_cap, edge_cap = capacities_for(graphs, batch_size)
+        batches = list(
+            stats.wrap(batch_iterator(graphs, batch_size, node_cap, edge_cap))
+        )
     real_per_batch = [float(np.asarray(b.graph_mask).sum()) for b in batches]
+    flops_per_batch = [
+        _flops_per_batch(b, atom_dim, gauss_dim, f, h, n_conv, n_h)
+        for b in batches
+    ]
 
     model = CrystalGraphConvNet(
-        atom_fea_len=64, n_conv=3, h_fea_len=128, dtype=jax.numpy.bfloat16
+        atom_fea_len=f, n_conv=n_conv, h_fea_len=h, dtype=jax.numpy.bfloat16
     )
     tx = make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10_000])
     normalizer = Normalizer.fit(np.stack([g.target for g in graphs]))
@@ -48,31 +101,85 @@ def main() -> None:
     train_step = jax.jit(make_train_step(), donate_argnums=0)
     device_batches = [jax.device_put(b) for b in batches]
 
-    # warmup: compile + 2 steps
+    # warmup: one step per distinct shape (compiles), then one more
+    seen = set()
+    for i, b in enumerate(device_batches):
+        shape = (b.node_capacity, b.edge_capacity)
+        if shape not in seen:
+            seen.add(shape)
+            state, _ = train_step(state, b)
     state, _ = train_step(state, device_batches[0])
-    state, _ = train_step(state, device_batches[1 % len(device_batches)])
     jax.block_until_ready(state.params)
 
     # timed steady state: best of 3 rounds (the tunnel to the chip has
     # transient degraded phases; the best round reflects device capability)
-    n_timed = 30
-    value = 0.0
+    best_rate, best_mfu = 0.0, 0.0
+    peak = _PEAK_FLOPS.get(jax.devices()[0].device_kind, _DEFAULT_PEAK)
     for _round in range(3):
-        structures = 0.0
+        structures = flops = 0.0
         t0 = time.perf_counter()
         for i in range(n_timed):
             k = i % len(device_batches)
             state, _ = train_step(state, device_batches[k])
             structures += real_per_batch[k]
+            flops += flops_per_batch[k]
         jax.block_until_ready(state.params)
-        value = max(value, structures / (time.perf_counter() - t0))
+        dt = time.perf_counter() - t0
+        if structures / dt > best_rate:
+            best_rate = structures / dt
+            best_mfu = flops / dt / peak
+    return {
+        f"{label}structs_per_sec": round(best_rate, 1),
+        f"{label}mfu": round(best_mfu, 4),
+        f"{label}node_eff": round(stats.node_efficiency, 3),
+        f"{label}edge_eff": round(stats.edge_efficiency, 3),
+        f"{label}shapes": len(stats.shapes),
+    }
+
+
+def main() -> None:
+    from cgnn_tpu.data.dataset import (
+        FeaturizeConfig,
+        load_synthetic,
+        load_synthetic_mp,
+        load_synthetic_oc20,
+    )
+
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+
+    # PRIMARY: MP-like size distribution (~30-atom lognormal), bucketed.
+    # Configs picked by measured sweep (batch 256/512, buckets 2/3): b512
+    # fills the MXU (50% MFU vs 32% at b256) and 6k structures amortize the
+    # per-bucket tail batches that dominated padding at 2k.
+    mp = _bench_workload(
+        load_synthetic_mp(6144, cfg, seed=0), batch_size=512, buckets=3,
+        n_timed=24,
+    )
+    # SECONDARY: OC20 slab distribution (config #4 large-graph regime)
+    oc20 = _bench_workload(
+        load_synthetic_oc20(512, cfg, seed=0), batch_size=128, buckets=2,
+        n_timed=16, label="oc20_",
+    )
+    # SECONDARY: legacy tiny-graph figure (round-1 comparability)
+    tiny = _bench_workload(
+        load_synthetic(2048, cfg, seed=0), batch_size=512, n_timed=20,
+        label="tiny_",
+    )
+
+    value = mp["structs_per_sec"]
     print(
         json.dumps(
             {
-                "metric": "train_structures_per_sec_per_chip",
-                "value": round(value, 1),
+                "metric": "train_structures_per_sec_per_chip_mp_distribution",
+                "value": value,
                 "unit": "structures/sec/chip",
                 "vs_baseline": round(value / 10_000.0, 4),
+                "mfu": mp["mfu"],
+                "padding_eff_nodes": mp["node_eff"],
+                "padding_eff_edges": mp["edge_eff"],
+                "compiled_shapes": mp["shapes"],
+                "oc20": oc20,
+                "tiny": tiny,
             }
         )
     )

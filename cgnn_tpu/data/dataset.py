@@ -140,6 +140,22 @@ def load_synthetic(
     ]
 
 
+def load_synthetic_mp(
+    num_structures: int,
+    cfg: FeaturizeConfig | None = None,
+    seed: int = 0,
+) -> list[CrystalGraph]:
+    """MP-like size distribution (lognormal ~30 atoms) for honest benching."""
+    from cgnn_tpu.data.synthetic import synthetic_mp_dataset
+
+    cfg = cfg or FeaturizeConfig()
+    gdf = cfg.gdf()
+    return [
+        featurize_structure(s, t, cfg, sid, gdf)
+        for sid, s, t in synthetic_mp_dataset(num_structures, seed)
+    ]
+
+
 def load_synthetic_oc20(
     num_structures: int,
     cfg: FeaturizeConfig | None = None,

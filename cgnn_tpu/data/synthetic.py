@@ -98,6 +98,34 @@ def synthetic_dataset(
     return out
 
 
+def synthetic_mp_dataset(
+    num_structures: int,
+    seed: int = 0,
+    mean_atoms: float = 30.0,
+    sigma: float = 0.55,
+    max_atoms: int = 120,
+) -> list[tuple[str, Structure, float]]:
+    """MP-like size distribution: lognormal cell sizes centered near 30 atoms.
+
+    Materials Project unit cells average ~30 atoms with a long right tail;
+    benchmarking on the tiny default synthetics (~7 atoms) overstates
+    structures/sec by the size ratio (VERDICT round 1 weak #3). Cell volume
+    scales with atom count at ~16 Å^3/atom so density stays physical.
+    """
+    rng = np.random.default_rng(seed)
+    mu = float(np.log(mean_atoms) - 0.5 * sigma**2)
+    out = []
+    for i in range(num_structures):
+        n = int(np.clip(np.round(rng.lognormal(mu, sigma)), 4, max_atoms))
+        a = float((n * 16.0) ** (1.0 / 3.0))
+        s = random_structure(
+            rng, n, n, a_range=(a * 0.9, a * 1.1), min_separation=1.6
+        )
+        t = synthetic_target(s, noise=0.01, rng=rng)
+        out.append((f"mp-{i:06d}", s, t))
+    return out
+
+
 def lj_energy_forces(
     structure: Structure, epsilon: float = 0.4, sigma: float = 2.2,
     cutoff: float = 6.0,
