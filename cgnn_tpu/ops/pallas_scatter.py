@@ -20,6 +20,18 @@ this kernel instead exploits the batcher's sorted-centers invariant
 Backward: aggregation is linear, so d_messages = d_out[centers] — a plain
 XLA gather (custom_vjp below). Exposed through
 ``aggregate_edge_messages(..., impl='pallas')`` (ops/segment.py).
+
+STATUS (round 2, measured): NOT the default. At full-train-step granularity
+on the real v5e chip — the only reliable measurement here; per-op
+microbenchmarks bottom out at a ~17 µs dispatch floor through the device
+tunnel regardless of shape — XLA's sorted-scatter wins at every bench
+workload: MP-distribution b512 1.60M vs 1.55M structs/s (-3%), OC20 slabs
+b128 460k vs 406k structs/s (-13%), bf16 flagship model, _TE∈{256,512,1024}
+indistinguishable. XLA fuses the scatter with the surrounding elementwise
+epilogue inside one program; the hand kernel forces a boundary. The kernel
+stays as a correct, tested, flag-selectable backend and as the scaffold for
+a future fused-epilogue variant (gate·softplus inside the chunk loop), which
+is where a win would have to come from. See scripts/sweep_pallas.py.
 """
 
 from __future__ import annotations
@@ -131,7 +143,7 @@ def _forward(messages, centers, num_nodes):
                 pl.BlockSpec(
                     (8, _TN), lambda i, ts: (i, 0), memory_space=pltpu.VMEM
                 ),
-                pl.BlockSpec(memory_space=pltpu.ANY),  # messages
+                pl.BlockSpec(memory_space=pl.ANY),  # messages
             ],
             out_specs=pl.BlockSpec(
                 (_TN, fp), lambda i, ts: (i, 0), memory_space=pltpu.VMEM
