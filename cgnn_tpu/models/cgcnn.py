@@ -47,6 +47,11 @@ class CGConv(nn.Module):
     # train mode that vanish under running stats at eval, so the learned
     # forces disagree between modes (measured: eval force MAE ~5x worse).
     use_batchnorm: bool = True
+    # edge-sharded graph parallelism (SURVEY.md §5 "long-context analog"):
+    # when the edge axis is sharded over this mesh axis, per-node partial
+    # aggregates are psum-ed back to full sums and edge-BN moments span all
+    # shards. Only valid inside shard_map with the axis bound.
+    edge_axis_name: str | None = None
 
     @nn.compact
     def __call__(
@@ -65,9 +70,9 @@ class CGConv(nn.Module):
         z = jnp.concatenate([v_i, v_j, edges.astype(nodes.dtype)], axis=-1)
         z = nn.Dense(2 * f, dtype=self.dtype, name="fc_full")(z)
         if self.use_batchnorm:
-            z = MaskedBatchNorm(dtype=self.dtype, name="bn1")(
-                z, mask=edge_mask, use_running_average=not train
-            )
+            z = MaskedBatchNorm(
+                dtype=self.dtype, name="bn1", axis_name=self.edge_axis_name
+            )(z, mask=edge_mask, use_running_average=not train)
         gate, core = jnp.split(z, 2, axis=-1)
         msg = nn.sigmoid(gate) * nn.softplus(core)
         msg = msg * edge_mask[:, None].astype(msg.dtype)
@@ -78,6 +83,9 @@ class CGConv(nn.Module):
             impl=self.aggregation_impl,
             indices_are_sorted=self.assume_sorted_edges,
         )
+        if self.edge_axis_name is not None:
+            # partial per-node sums from this edge shard -> full sums
+            agg = jax.lax.psum(agg, self.edge_axis_name)
         if self.use_batchnorm:
             agg = MaskedBatchNorm(dtype=self.dtype, name="bn2")(
                 agg, mask=node_mask, use_running_average=not train
@@ -106,6 +114,7 @@ class CrystalGraphConvNet(nn.Module):
     aggregation_impl: str | None = None
     assume_sorted_edges: bool = True
     head: nn.Module | None = None  # e.g. MultiTaskHead; replaces fc stack
+    edge_axis_name: str | None = None  # edge-sharded graph parallelism
 
     @nn.compact
     def __call__(
@@ -121,6 +130,7 @@ class CrystalGraphConvNet(nn.Module):
                 dtype=self.dtype,
                 aggregation_impl=self.aggregation_impl,
                 assume_sorted_edges=self.assume_sorted_edges,
+                edge_axis_name=self.edge_axis_name,
                 name=f"conv_{i}",
             )(
                 nodes,

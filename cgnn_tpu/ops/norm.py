@@ -32,6 +32,11 @@ class MaskedBatchNorm(nn.Module):
     # output dtype; statistics follow promote_types(input, float32), so
     # float64 activations keep float64 running stats (oracle parity)
     dtype: jnp.dtype | None = None
+    # when the row axis is sharded across a mesh axis (edge-sharded graph
+    # parallelism), moments must be computed over ALL shards: two psum
+    # passes (count+mean, then centered variance) keep the numerics of the
+    # single-device centered formula
+    axis_name: str | None = None
 
     @nn.compact
     def __call__(
@@ -58,13 +63,25 @@ class MaskedBatchNorm(nn.Module):
             if mask is not None:
                 m = mask.astype(stat_dtype)
                 n_real = m.sum()
-                n = jnp.maximum(n_real, 1.0)
-                mean = (xf * m[:, None]).sum(axis=0) / n
-                var = (((xf - mean) ** 2) * m[:, None]).sum(axis=0) / n
+                s1 = (xf * m[:, None]).sum(axis=0)
             else:
-                n_real = n = jnp.asarray(x.shape[0], stat_dtype)
-                mean = xf.mean(axis=0)
-                var = xf.var(axis=0)
+                m = None
+                n_real = jnp.asarray(x.shape[0], stat_dtype)
+                s1 = xf.sum(axis=0)
+            if self.axis_name is not None:
+                n_real = jax.lax.psum(n_real, self.axis_name)
+                s1 = jax.lax.psum(s1, self.axis_name)
+            n = jnp.maximum(n_real, 1.0)
+            mean = s1 / n
+            centered = (xf - mean) ** 2
+            ss = (
+                (centered * m[:, None]).sum(axis=0)
+                if m is not None
+                else centered.sum(axis=0)
+            )
+            if self.axis_name is not None:
+                ss = jax.lax.psum(ss, self.axis_name)
+            var = ss / n
             if not self.is_initializing():
                 # a fully-masked batch (all padding, e.g. an empty DP eval
                 # shard) must not decay the running stats toward (0, 0)

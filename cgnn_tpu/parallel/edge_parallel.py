@@ -1,0 +1,157 @@
+"""Edge-sharded (graph-parallel) message passing — the sequence-parallel
+analog for crystal graphs (SURVEY.md §5 "long-context analog").
+
+A crystal-graph model has no sequence axis; its scaling axis is the EDGE
+list. When a batch's edge work exceeds one chip (giant OC20 cells, or a
+single structure too large for HBM), shard the edge axis across a mesh
+axis ``'graph'``:
+
+- node features are replicated; each device gathers endpoints for ITS edge
+  shard only (contiguous chunks of the globally center-sorted edge list, so
+  the per-shard sortedness invariant holds);
+- the dominant FLOPs — the per-edge ``fc_full`` dense layer — split D ways;
+- per-node partial aggregates are ``psum``-ed back to full sums (one ICI
+  all-reduce per conv layer, the ring-attention-style collective);
+- edge-BatchNorm moments span all shards (two-psum masked moments in
+  MaskedBatchNorm.axis_name).
+
+Gradients: the step runs under ``shard_map`` with replication checking ON
+(``check_vma=True``), so JAX's transpose machinery inserts the psum that
+converts each shard's partial parameter cotangents into the full gradient
+— no manual pmean over 'graph' (which would be wrong: node-side parameter
+contributions are replicated-complete while edge-side ones are partial).
+This composes with data parallelism as a 2-D mesh ``('data', 'graph')``;
+grads/stats still pmean over 'data' explicitly as in plain DP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cgnn_tpu.data.graph import GraphBatch
+from cgnn_tpu.train.state import TrainState
+from cgnn_tpu.train.step import make_eval_step, make_train_step
+
+# GraphBatch leaves whose leading axis is the edge axis
+EDGE_FIELDS = ("edges", "centers", "neighbors", "edge_mask", "edge_offsets")
+_ALL_FIELDS = tuple(f.name for f in dataclasses.fields(GraphBatch))
+
+
+def pad_edges_divisible(batch: GraphBatch, n_shards: int) -> GraphBatch:
+    """Pad the edge axis so it splits evenly into ``n_shards`` (host-side).
+
+    Padding edges follow the pack_graphs convention: masked out, pointing
+    at the last node slot (preserves the sorted-centers invariant).
+    """
+    e = batch.edge_capacity
+    pad = -e % n_shards
+    if pad == 0:
+        return batch
+    ncap = batch.node_capacity
+
+    def pad_field(name, x):
+        if name not in EDGE_FIELDS:
+            return x
+        widths = [(0, pad)] + [(0, 0)] * (np.ndim(x) - 1)
+        fill = ncap - 1 if name in ("centers", "neighbors") else 0
+        return np.pad(np.asarray(x), widths, constant_values=fill)
+
+    return GraphBatch(
+        **{
+            name: pad_field(name, getattr(batch, name))
+            for name in _ALL_FIELDS
+        }
+    )
+
+
+def batch_specs(
+    graph_axis: str | None = "graph", data_axis: str | None = None
+) -> GraphBatch:
+    """GraphBatch of PartitionSpecs: edge leaves sharded over ``graph_axis``,
+    optional leading stacked-device axis over ``data_axis``."""
+    lead = (data_axis,) if data_axis else ()
+
+    def spec(name):
+        if name in EDGE_FIELDS and graph_axis:
+            return P(*lead, graph_axis)
+        return P(*lead)
+
+    return GraphBatch(**{name: spec(name) for name in _ALL_FIELDS})
+
+
+def shard_batch(batch: GraphBatch, mesh: Mesh, graph_axis: str = "graph"):
+    """device_put a (host) batch with edge leaves split over the graph axis."""
+    specs = batch_specs(graph_axis=graph_axis)
+
+    def put(x, s):
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    return jax.tree_util.tree_map(
+        put, batch, specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def make_edge_parallel_train_step(
+    mesh: Mesh,
+    classification: bool = False,
+    graph_axis: str = "graph",
+) -> Callable:
+    """(replicated state, edge-sharded batch) -> (state, metrics).
+
+    The model inside ``state.apply_fn`` must be built with
+    ``edge_axis_name=graph_axis``. Replication checking stays ON so the
+    parameter-gradient psum over the graph axis is inserted by transpose.
+    """
+    inner = make_train_step(classification)
+
+    smapped = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(), batch_specs(graph_axis=graph_axis)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(smapped, donate_argnums=0)
+
+
+def make_edge_parallel_eval_step(
+    mesh: Mesh,
+    classification: bool = False,
+    graph_axis: str = "graph",
+) -> Callable:
+    inner = make_eval_step(classification)
+    smapped = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(), batch_specs(graph_axis=graph_axis)),
+        out_specs=P(),
+    )
+    return jax.jit(smapped)
+
+
+def make_dp_edge_parallel_train_step(
+    mesh: Mesh,
+    classification: bool = False,
+    data_axis: str = "data",
+    graph_axis: str = "graph",
+) -> Callable:
+    """2-D mesh step: batches stacked over 'data', edges sharded over
+    'graph' within each data shard. Input leaves: [D, ...] with edge leaves
+    [D, E]; grads/stats pmean over 'data', metrics psum over 'data'."""
+    inner = make_train_step(classification, axis_name=data_axis)
+
+    def body(state: TrainState, stacked: GraphBatch):
+        local = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        return inner(state, local)
+
+    smapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), batch_specs(graph_axis=graph_axis, data_axis=data_axis)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(smapped, donate_argnums=0)

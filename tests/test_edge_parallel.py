@@ -1,0 +1,155 @@
+"""Edge-sharded graph parallelism (SP analog; SURVEY.md §5 long-context).
+
+All tests run on the 8 virtual CPU devices from conftest. The bar is exact
+agreement with the unsharded step — sharding is a layout change, not a
+numerics change.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+from cgnn_tpu.data.graph import batch_iterator, capacities_for
+from cgnn_tpu.models import CrystalGraphConvNet
+from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+from cgnn_tpu.train.step import make_train_step
+from cgnn_tpu.parallel.data_parallel import (
+    make_parallel_train_step,
+    shard_leading_axis,
+    stack_batches,
+)
+from cgnn_tpu.parallel.edge_parallel import (
+    batch_specs,
+    make_dp_edge_parallel_train_step,
+    make_edge_parallel_eval_step,
+    make_edge_parallel_train_step,
+    pad_edges_divisible,
+    shard_batch,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def _setup(batch_size=16, n_graphs=16):
+    graphs = load_synthetic(
+        n_graphs, FeaturizeConfig(radius=5.0, max_num_nbr=8), seed=0
+    )
+    nc, ec = capacities_for(graphs, batch_size)
+    batch = next(batch_iterator(graphs, batch_size, nc, ec))
+    targets = np.stack([g.target for g in graphs])
+    tx = make_optimizer(optim="sgd", lr=0.01, lr_milestones=[100])
+    return graphs, batch, targets, tx
+
+
+def _states(model_ref, model_gp, batch, targets, tx):
+    """Two identically-initialized states (no shared buffers — donation on
+    CPU aliases device_put, so shared leaves would be deleted)."""
+    a = create_train_state(
+        model_ref, batch, tx, Normalizer.fit(targets), rng=jax.random.key(0)
+    )
+    b = create_train_state(
+        model_ref, batch, tx, Normalizer.fit(targets), rng=jax.random.key(0)
+    ).replace(apply_fn=model_gp.apply)
+    return a, b
+
+
+def test_pad_edges_divisible_preserves_semantics():
+    _, batch, _, _ = _setup()
+    padded = pad_edges_divisible(batch, 8)
+    assert padded.edge_capacity % 8 == 0
+    e = batch.edge_capacity
+    np.testing.assert_array_equal(padded.edges[:e], batch.edges)
+    assert (np.asarray(padded.edge_mask[e:]) == 0).all()
+    assert (np.asarray(padded.centers[e:]) == batch.node_capacity - 1).all()
+    # sortedness invariant survives
+    assert (np.diff(np.asarray(padded.centers)) >= 0).all()
+
+
+def test_edge_parallel_train_step_matches_single_device():
+    _, batch, targets, tx = _setup()
+    batch = pad_edges_divisible(batch, 8)
+    model_ref = CrystalGraphConvNet(atom_fea_len=32, n_conv=2, h_fea_len=32)
+    model_gp = CrystalGraphConvNet(
+        atom_fea_len=32, n_conv=2, h_fea_len=32, edge_axis_name="graph"
+    )
+    state_ref, state_gp = _states(model_ref, model_gp, batch, targets, tx)
+
+    s1, m1 = jax.jit(make_train_step())(state_ref, batch)
+
+    mesh = Mesh(np.array(jax.devices()), ("graph",))
+    s2, m2 = make_edge_parallel_train_step(mesh)(
+        state_gp, shard_batch(batch, mesh)
+    )
+    assert float(m1["loss_sum"]) == pytest.approx(float(m2["loss_sum"]), abs=1e-4)
+    for a, b in zip(
+        jtu.tree_leaves(jax.device_get(s1.params)),
+        jtu.tree_leaves(jax.device_get(s2.params)),
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+    for a, b in zip(
+        jtu.tree_leaves(jax.device_get(s1.batch_stats)),
+        jtu.tree_leaves(jax.device_get(s2.batch_stats)),
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_edge_parallel_eval_matches_single_device():
+    _, batch, targets, tx = _setup()
+    batch = pad_edges_divisible(batch, 8)
+    model_ref = CrystalGraphConvNet(atom_fea_len=32, n_conv=2, h_fea_len=32)
+    model_gp = CrystalGraphConvNet(
+        atom_fea_len=32, n_conv=2, h_fea_len=32, edge_axis_name="graph"
+    )
+    state_ref, state_gp = _states(model_ref, model_gp, batch, targets, tx)
+    from cgnn_tpu.train.step import make_eval_step
+
+    m1 = jax.jit(make_eval_step())(state_ref, batch)
+    mesh = Mesh(np.array(jax.devices()), ("graph",))
+    m2 = make_edge_parallel_eval_step(mesh)(state_gp, shard_batch(batch, mesh))
+    assert float(m1["mae_sum"]) == pytest.approx(float(m2["mae_sum"]), rel=1e-5)
+
+
+def test_2d_data_x_graph_mesh_matches_plain_dp():
+    graphs, _, targets, tx = _setup(batch_size=8, n_graphs=32)
+    nc, ec = capacities_for(graphs, 8)
+    batches = [
+        pad_edges_divisible(b, 2)
+        for b in list(batch_iterator(graphs, 8, nc, ec))[:4]
+    ]
+    stacked = stack_batches(batches)
+    model_ref = CrystalGraphConvNet(atom_fea_len=32, n_conv=2, h_fea_len=32)
+    model_gp = CrystalGraphConvNet(
+        atom_fea_len=32, n_conv=2, h_fea_len=32, edge_axis_name="graph"
+    )
+    state_a, state_b = _states(model_ref, model_gp, batches[0], targets, tx)
+
+    mesh_dp = Mesh(np.array(jax.devices()[:4]), ("data",))
+    mesh2d = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "graph"))
+    state_a = jtu.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh_dp, P())), state_a
+    )
+    state_b = jtu.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh2d, P())), state_b
+    )
+
+    s1, m1 = make_parallel_train_step(mesh_dp)(
+        state_a, shard_leading_axis(stacked, mesh_dp)
+    )
+    specs = batch_specs(graph_axis="graph", data_axis="data")
+    sb = jtu.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh2d, s)),
+        stacked, specs, is_leaf=lambda x: isinstance(x, P),
+    )
+    s2, m2 = make_dp_edge_parallel_train_step(mesh2d)(state_b, sb)
+    assert float(m1["loss_sum"]) == pytest.approx(float(m2["loss_sum"]), abs=1e-3)
+    for a, b in zip(
+        jtu.tree_leaves(jax.device_get(s1.params)),
+        jtu.tree_leaves(jax.device_get(s2.params)),
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-5)
