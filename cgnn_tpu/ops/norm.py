@@ -69,8 +69,7 @@ class MaskedBatchNorm(nn.Module):
                 n_real = jnp.asarray(x.shape[0], stat_dtype)
                 s1 = xf.sum(axis=0)
             if self.axis_name is not None:
-                n_real = jax.lax.psum(n_real, self.axis_name)
-                s1 = jax.lax.psum(s1, self.axis_name)
+                n_real, s1 = jax.lax.psum((n_real, s1), self.axis_name)
             n = jnp.maximum(n_real, 1.0)
             mean = s1 / n
             centered = (xf - mean) ** 2

@@ -141,8 +141,22 @@ def make_dp_edge_parallel_train_step(
 ) -> Callable:
     """2-D mesh step: batches stacked over 'data', edges sharded over
     'graph' within each data shard. Input leaves: [D, ...] with edge leaves
-    [D, E]; grads/stats pmean over 'data', metrics psum over 'data'."""
-    inner = make_train_step(classification, axis_name=data_axis)
+    [D, E]; stats pmean over 'data', metrics psum over 'data'.
+
+    Gradients: replication checking is ON, so the shard_map transpose
+    psums parameter cotangents over BOTH mesh axes (over 'graph' that
+    completes the edge-partial grads; over 'data' it sums per-shard grads).
+    Scaling the loss by 1/n_data turns that data-axis sum into the DDP
+    mean — an explicit pmean here would be an identity on the already
+    reduced value (it arrives axis-invariant), silently leaving grads
+    n_data times too large.
+    """
+    inner = make_train_step(
+        classification,
+        axis_name=data_axis,
+        loss_scale=1.0 / mesh.shape[data_axis],
+        pmean_grads=False,
+    )
 
     def body(state: TrainState, stacked: GraphBatch):
         local = jax.tree_util.tree_map(lambda x: x[0], stacked)

@@ -59,11 +59,20 @@ def make_train_step(
     classification: bool = False,
     axis_name: str | None = None,
     loss_fn: Callable | None = None,
+    loss_scale: float = 1.0,
+    pmean_grads: bool = True,
 ) -> Callable:
     """Build the (state, batch) -> (state, metrics) step body.
 
     ``axis_name`` activates cross-device reductions; only set it when the
     step runs inside shard_map/vmap with that axis bound.
+
+    ``loss_scale`` multiplies the loss before differentiation (metrics are
+    unscaled) and ``pmean_grads=False`` skips the explicit grad allreduce —
+    both exist for steps running under shard_map with replication checking
+    ON, where the transpose already psums parameter cotangents over every
+    mesh axis: scaling by 1/axis_size turns that sum into the DDP mean
+    (cgnn_tpu.parallel.edge_parallel 2-D mesh step).
     """
     compute_loss = loss_fn or (classification_loss if classification else regression_loss)
 
@@ -79,7 +88,7 @@ def make_train_step(
                 rngs=rngs,
             )
             loss, metrics = compute_loss(out, batch, state.normalizer)
-            return loss, (metrics, mutated["batch_stats"])
+            return loss * loss_scale, (metrics, mutated["batch_stats"])
 
         (_, (metrics, new_stats)), grads = jax.value_and_grad(
             loss_with_aux, has_aux=True
@@ -88,7 +97,8 @@ def make_train_step(
             # DDP-equivalent: average grads across replicas; running stats are
             # also averaged (stronger than torch DDP, which keeps rank-0's);
             # metric sums add up exactly.
-            grads = lax.pmean(grads, axis_name)
+            if pmean_grads:
+                grads = lax.pmean(grads, axis_name)
             new_stats = lax.pmean(new_stats, axis_name)
             metrics = lax.psum(metrics, axis_name)
         return state.apply_gradients(grads, new_stats), metrics
