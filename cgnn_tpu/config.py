@@ -43,7 +43,12 @@ class ModelConfig:
             kw["aggregation"] = None
         return cls(**kw)
 
-    def build(self, head=None):
+    def build(self, head=None, edge_axis_name: str | None = None):
+        """``edge_axis_name`` activates edge-sharded graph parallelism
+        (psum over that mesh axis inside every conv). It is a runtime
+        parallelism choice, not model identity — deliberately NOT part of
+        ``to_meta()``, so checkpoints restore as plain single-device models
+        with identical parameters."""
         from cgnn_tpu.models import CrystalGraphConvNet
 
         if head is None and self.multi_task_head and not self.classification:
@@ -67,15 +72,21 @@ class ModelConfig:
             dtype=jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32,
             aggregation_impl=self.aggregation,
             head=head,
+            edge_axis_name=edge_axis_name,
         )
 
 
 def build_model(model_cfg: "ModelConfig", data_cfg: "DataConfig",
-                task: str = "regression"):
+                task: str = "regression",
+                edge_axis_name: str | None = None):
     """Build the model for a task; the force task needs the edge featurization
     hyperparameters in-model (distances are recomputed differentiably from
     positions — models/forcefield.py)."""
     if task == "force":
+        if edge_axis_name is not None:
+            raise NotImplementedError(
+                "graph sharding is not supported for the force task"
+            )
         from cgnn_tpu.models.forcefield import ForceFieldCGCNN
 
         return ForceFieldCGCNN(
@@ -88,7 +99,7 @@ def build_model(model_cfg: "ModelConfig", data_cfg: "DataConfig",
             dtype=jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32,
             aggregation_impl=model_cfg.aggregation,
         )
-    return model_cfg.build()
+    return model_cfg.build(edge_axis_name=edge_axis_name)
 
 
 @dataclasses.dataclass

@@ -181,24 +181,49 @@ def fit_data_parallel(
     ``train_step_fn``/``eval_step_fn`` override the step bodies (they must
     be built with ``axis_name='data'``); ``best_metric`` overrides the
     model-selection key.
+
+    A 2-D ``('data', 'graph')`` mesh (parallel.mesh.make_2d_mesh) activates
+    edge-sharded graph parallelism on top of DP: per-device batches keep
+    their 'data' row but their edge leaves are split over 'graph'. The
+    model in ``state.apply_fn`` must then be built with
+    ``edge_axis_name='graph'``.
     """
     from cgnn_tpu.parallel.mesh import make_mesh
 
     mesh = mesh or make_mesh()
-    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
-    train_step = make_parallel_train_step(
-        mesh, classification, inner_step=train_step_fn
-    )
-    eval_step = make_parallel_eval_step(
-        mesh, classification, inner_step=eval_step_fn
-    )
+    graph_shards = int(mesh.shape.get("graph", 1))
+    if graph_shards > 1:
+        from cgnn_tpu.parallel.edge_parallel import (
+            make_dp_edge_parallel_eval_step,
+            make_dp_edge_parallel_train_step,
+            shard_stacked_batch,
+        )
+
+        if train_step_fn is not None or eval_step_fn is not None:
+            raise NotImplementedError(
+                "custom step bodies are not supported with graph sharding"
+            )
+        # pack at a shard-divisible edge capacity up front (cheaper than
+        # re-padding every batch after the fact)
+        edge_cap = -(-edge_cap // graph_shards) * graph_shards
+        n_dev = int(mesh.shape["data"])
+        train_step = make_dp_edge_parallel_train_step(mesh, classification)
+        eval_step = make_dp_edge_parallel_eval_step(mesh, classification)
+        shard_put = lambda b: shard_stacked_batch(b, mesh)  # noqa: E731
+    else:
+        n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        train_step = make_parallel_train_step(
+            mesh, classification, inner_step=train_step_fn
+        )
+        eval_step = make_parallel_eval_step(
+            mesh, classification, inner_step=eval_step_fn
+        )
+        shard_put = lambda b: shard_leading_axis(b, mesh)  # noqa: E731
     state = replicate_state(state, mesh)
     best = -np.inf if classification else np.inf
     history = []
     rng = np.random.default_rng(seed)
     from cgnn_tpu.data.loader import prefetch_to_device
-
-    shard_put = lambda b: shard_leading_axis(b, mesh)  # noqa: E731
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         sums: dict[str, float] = {}
@@ -239,8 +264,11 @@ def fit_data_parallel(
         if is_best:
             best = metric
         history.append({"epoch": epoch, "train_loss": train_loss, "val": val_m})
+        tag = f"dp x{n_dev}" + (
+            f" * graph x{graph_shards}" if graph_shards > 1 else ""
+        )
         log_fn(
-            f"Epoch {epoch} [dp x{n_dev}]: train loss {train_loss:.4f}"
+            f"Epoch {epoch} [{tag}]: train loss {train_loss:.4f}"
             f"  val {best_key} {metric:.4f}"
             f"{' *' if is_best else ''}  ({time.perf_counter() - t0:.1f}s)"
         )

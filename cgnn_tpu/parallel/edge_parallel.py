@@ -84,9 +84,16 @@ def batch_specs(
     return GraphBatch(**{name: spec(name) for name in _ALL_FIELDS})
 
 
-def shard_batch(batch: GraphBatch, mesh: Mesh, graph_axis: str = "graph"):
-    """device_put a (host) batch with edge leaves split over the graph axis."""
-    specs = batch_specs(graph_axis=graph_axis)
+def shard_batch(
+    batch: GraphBatch,
+    mesh: Mesh,
+    graph_axis: str = "graph",
+    data_axis: str | None = None,
+):
+    """device_put a batch with edge leaves split over the graph axis (and,
+    when ``data_axis`` is given, every leaf's leading stacked-device axis
+    split over it)."""
+    specs = batch_specs(graph_axis=graph_axis, data_axis=data_axis)
 
     def put(x, s):
         return jax.device_put(x, NamedSharding(mesh, s))
@@ -151,6 +158,8 @@ def make_dp_edge_parallel_train_step(
     reduced value (it arrives axis-invariant), silently leaving grads
     n_data times too large.
     """
+    from cgnn_tpu.parallel.data_parallel import _squeeze0
+
     inner = make_train_step(
         classification,
         axis_name=data_axis,
@@ -159,8 +168,7 @@ def make_dp_edge_parallel_train_step(
     )
 
     def body(state: TrainState, stacked: GraphBatch):
-        local = jax.tree_util.tree_map(lambda x: x[0], stacked)
-        return inner(state, local)
+        return inner(state, _squeeze0(stacked))
 
     smapped = jax.shard_map(
         body,
@@ -169,3 +177,41 @@ def make_dp_edge_parallel_train_step(
         out_specs=(P(), P()),
     )
     return jax.jit(smapped, donate_argnums=0)
+
+
+def make_dp_edge_parallel_eval_step(
+    mesh: Mesh,
+    classification: bool = False,
+    loss_fn: Callable | None = None,
+    data_axis: str = "data",
+    graph_axis: str = "graph",
+) -> Callable:
+    """2-D mesh eval step: metrics psum over 'data' (each graph shard
+    computes identical metrics after the model's psum over 'graph')."""
+    from cgnn_tpu.parallel.data_parallel import _squeeze0
+
+    inner = make_eval_step(classification, axis_name=data_axis, loss_fn=loss_fn)
+
+    def body(state: TrainState, stacked: GraphBatch):
+        return inner(state, _squeeze0(stacked))
+
+    smapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), batch_specs(graph_axis=graph_axis, data_axis=data_axis)),
+        out_specs=P(),
+    )
+    return jax.jit(smapped)
+
+
+def shard_stacked_batch(
+    stacked: GraphBatch,
+    mesh: Mesh,
+    data_axis: str = "data",
+    graph_axis: str = "graph",
+):
+    """device_put a [D, ...]-stacked batch onto a 2-D mesh: leading axis over
+    'data', edge leaves additionally split over 'graph'."""
+    return shard_batch(
+        stacked, mesh, graph_axis=graph_axis, data_axis=data_axis
+    )

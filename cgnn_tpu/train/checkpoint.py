@@ -56,8 +56,14 @@ class CheckpointManager:
     def save(self, state: TrainState, meta: dict, is_best: bool = False):
         """Save 'latest' (and 'best' when ``is_best``); meta rides alongside
         as JSON (orbax pytrees are arrays-only; config strings go to JSON,
-        mirroring the reference's checkpoint-embedded ``args``)."""
-        tree = _state_pytree(state)
+        mirroring the reference's checkpoint-embedded ``args``).
+
+        The tree is host-localized (numpy) first so checkpoints carry no
+        device-mesh shardings: a state saved from an 8-device DP/graph-
+        sharded run must restore in a single-chip predict/resume process
+        (orbax would otherwise bake the save-time sharding into the
+        checkpoint and refuse topology-less restores)."""
+        tree = jax.device_get(_state_pytree(state))
         for tag in [_LATEST] + ([_BEST] if is_best else []):
             self._ckptr.save(self._path(tag), tree, force=True)
             with open(self._meta_path(tag), "w") as f:

@@ -115,6 +115,46 @@ def test_edge_parallel_eval_matches_single_device():
     assert float(m1["mae_sum"]) == pytest.approx(float(m2["mae_sum"]), rel=1e-5)
 
 
+def test_fit_data_parallel_2d_mesh_matches_plain_dp():
+    """Full fit loop through a ('data','graph') mesh == plain-DP fit:
+    same seed -> same batch order -> identical training trajectory."""
+    from cgnn_tpu.parallel.data_parallel import fit_data_parallel
+    from cgnn_tpu.parallel.mesh import make_2d_mesh, make_mesh
+
+    graphs = load_synthetic(
+        48, FeaturizeConfig(radius=5.0, max_num_nbr=8), seed=0
+    )
+    train_g, val_g = graphs[:32], graphs[32:]
+    targets = np.stack([g.target for g in train_g])
+    nc, ec = capacities_for(train_g, 4)
+    batch = next(batch_iterator(train_g, 4, nc, ec))
+    tx = make_optimizer(optim="sgd", lr=0.01, lr_milestones=[100])
+    model_ref = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=16)
+    model_gp = CrystalGraphConvNet(
+        atom_fea_len=16, n_conv=2, h_fea_len=16, edge_axis_name="graph"
+    )
+    state_a, state_b = _states(model_ref, model_gp, batch, targets, tx)
+
+    quiet = lambda *a, **k: None  # noqa: E731
+    s1, r1 = fit_data_parallel(
+        state_a, train_g, val_g, epochs=2, batch_size=4, node_cap=nc,
+        edge_cap=ec, seed=7, mesh=make_mesh(4), log_fn=quiet,
+    )
+    s2, r2 = fit_data_parallel(
+        state_b, train_g, val_g, epochs=2, batch_size=4, node_cap=nc,
+        edge_cap=ec, seed=7, mesh=make_2d_mesh(2, data_shards=4),
+        log_fn=quiet,
+    )
+    for e1, e2 in zip(r1["history"], r2["history"]):
+        assert e1["train_loss"] == pytest.approx(e2["train_loss"], rel=1e-4)
+        assert e1["val"]["mae"] == pytest.approx(e2["val"]["mae"], rel=1e-4)
+    for a, b in zip(
+        jtu.tree_leaves(jax.device_get(s1.params)),
+        jtu.tree_leaves(jax.device_get(s2.params)),
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
 def test_2d_data_x_graph_mesh_matches_plain_dp():
     graphs, _, targets, tx = _setup(batch_size=8, n_graphs=32)
     nc, ec = capacities_for(graphs, 8)

@@ -102,6 +102,11 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU-native additions
     p.add_argument("--data-parallel", action="store_true",
                    help="shard batches over all visible devices (DP over ICI)")
+    p.add_argument("--graph-shards", type=int, default=1, metavar="G",
+                   help="shard every batch's edge axis over a G-way 'graph' "
+                        "mesh axis (edge-sharded message passing — the "
+                        "long-context analog for graphs too large for one "
+                        "chip; composes with --data-parallel as a 2-D mesh)")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute on the MXU (f32 params/stats)")
     p.add_argument("--aggregation", choices=["xla", "sort", "pallas"],
@@ -214,6 +219,21 @@ def main(argv=None) -> int:
         dropout=args.dropout, dtype="bfloat16" if args.bf16 else "float32",
         aggregation=args.aggregation, multi_task_head=args.multi_task_head,
     )
+    graph_shards = max(1, args.graph_shards)
+    if graph_shards > 1:
+        if force_task:
+            print("--graph-shards is not supported for --task force",
+                  file=sys.stderr)
+            return 2
+        if len(devices) < graph_shards:
+            print(f"--graph-shards {graph_shards} requested but only "
+                  f"{len(devices)} device(s) visible", file=sys.stderr)
+            return 2
+        if args.data_parallel and len(devices) % graph_shards:
+            stranded = len(devices) % graph_shards
+            print(f"warning: {len(devices)} devices not divisible by "
+                  f"--graph-shards {graph_shards}; {stranded} device(s) "
+                  f"idle", file=sys.stderr)
     model = build_model(model_cfg, data_cfg, args.task)
 
     if classification:
@@ -286,9 +306,25 @@ def main(argv=None) -> int:
         eval_step_fn = make_force_eval_step(args.energy_weight, args.force_weight)
         step_overrides = {"best_metric": "force_mae"}
 
-    if args.data_parallel and len(devices) > 1:
+    if graph_shards > 1 or (args.data_parallel and len(devices) > 1):
         from cgnn_tpu.parallel import fit_data_parallel
+        from cgnn_tpu.parallel.mesh import make_2d_mesh
 
+        mesh = None
+        fit_state = state
+        if graph_shards > 1:
+            # edge-sharded model: same params, psum over 'graph' per conv;
+            # the plain `state` keeps the single-device apply_fn for the
+            # final test evaluation and checkpointing
+            sharded_model = build_model(
+                model_cfg, data_cfg, args.task, edge_axis_name="graph"
+            )
+            fit_state = state.replace(apply_fn=sharded_model.apply)
+            mesh = make_2d_mesh(
+                graph_shards,
+                data_shards=(len(devices) // graph_shards
+                             if args.data_parallel else 1),
+            )
         if force_task:
             step_overrides |= {
                 "train_step_fn": make_force_train_step(
@@ -298,13 +334,15 @@ def main(argv=None) -> int:
                     args.energy_weight, args.force_weight, axis_name="data"
                 ),
             }
-        state, result = fit_data_parallel(
-            state, train_g, val_g, epochs=args.epochs, batch_size=args.batch_size,
+        fit_state, result = fit_data_parallel(
+            fit_state, train_g, val_g, epochs=args.epochs,
+            batch_size=args.batch_size,
             node_cap=node_cap, edge_cap=edge_cap, classification=classification,
             seed=args.seed, print_freq=args.print_freq,
             on_epoch_end=save_cb, start_epoch=start_epoch,
-            on_epoch_metrics=log_epoch_metrics, **step_overrides,
+            on_epoch_metrics=log_epoch_metrics, mesh=mesh, **step_overrides,
         )
+        state = fit_state.replace(apply_fn=state.apply_fn)
     else:
         if force_task:
             step_overrides |= {
