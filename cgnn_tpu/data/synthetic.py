@@ -34,18 +34,21 @@ def random_structure(
     angles = rng.uniform(80.0, 100.0, size=3)
     lattice = lattice_from_parameters(*abc, *angles)
     # place atoms with a crude minimum-distance rejection (not physical, just
-    # avoids coincident sites which would create zero-distance edges)
+    # avoids coincident sites which would create zero-distance edges); the
+    # accept check is vectorized over placed atoms but the rng draw pattern
+    # is one candidate per attempt, so seeded datasets are unchanged
     fracs: list[np.ndarray] = []
+    placed = np.empty((0, 3))
     for _ in range(n):
         for _attempt in range(256):
             cand = rng.uniform(0, 1, size=3)
-            if all(
-                np.linalg.norm(((cand - f + 0.5) % 1.0 - 0.5) @ lattice)
-                > min_separation
-                for f in fracs
-            ):
+            d = ((cand - placed + 0.5) % 1.0 - 0.5) @ lattice
+            if len(placed) == 0 or float(
+                np.min(np.einsum("ij,ij->i", d, d))
+            ) > min_separation**2:
                 break
         fracs.append(cand)
+        placed = np.concatenate([placed, cand[None]])
     numbers = rng.choice(_SYNTH_ELEMENTS, size=n)
     return Structure(lattice, np.array(fracs), numbers)
 

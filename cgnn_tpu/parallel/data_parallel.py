@@ -88,15 +88,25 @@ def parallel_batches(
 
 
 def shard_leading_axis(tree, mesh: Mesh):
-    """device_put a stacked batch with its leading axis split over 'data'."""
+    """device_put a stacked batch: leading axis split over every replica
+    (non-'graph') mesh axis."""
+    axes = _replica_axes(mesh)
+
     def put(x):
         return jax.device_put(
-            x, NamedSharding(mesh, P("data", *([None] * (np.ndim(x) - 1)))))
+            x, NamedSharding(mesh, P(axes, *([None] * (np.ndim(x) - 1)))))
     return jax.tree_util.tree_map(put, tree)
 
 
 def _squeeze0(tree):
     return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def _replica_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Every mesh axis that carries data replicas ('graph' shards edges,
+    not batches). A multi-host ('dcn', 'data') mesh reduces over both axes —
+    XLA routes each partial reduction over the matching fabric."""
+    return tuple(a for a in mesh.axis_names if a != "graph")
 
 
 def make_parallel_train_step(
@@ -107,11 +117,21 @@ def make_parallel_train_step(
 ) -> Callable:
     """shard_map-wrapped train step: (replicated state, [D,...] batch).
 
+    The batch's leading device axis is split over every non-'graph' mesh
+    axis, so a 1-D ('data',) mesh and a hierarchical ('dcn', 'data')
+    multi-host mesh run the same step body.
+
     ``inner_step`` overrides the default step body entirely (it must already
-    be built with ``axis_name='data'`` — e.g. the force-task step).
+    be built with ``axis_name='data'`` — e.g. the force-task step; only
+    supported on 1-D data meshes).
     """
+    axes = _replica_axes(mesh)
+    if inner_step is not None and axes != ("data",):
+        raise NotImplementedError(
+            f"custom step bodies assume axis_name='data'; mesh has {axes}"
+        )
     inner = inner_step or make_train_step(
-        classification, axis_name="data", loss_fn=loss_fn
+        classification, axis_name=axes, loss_fn=loss_fn
     )
 
     def body(state: TrainState, stacked: GraphBatch):
@@ -120,7 +140,7 @@ def make_parallel_train_step(
     smapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), P("data")),
+        in_specs=(P(), P(axes)),
         out_specs=(P(), P()),
         check_vma=False,  # grads/stats are pmean-ed -> replicated outputs
     )
@@ -133,15 +153,20 @@ def make_parallel_eval_step(
     loss_fn: Callable | None = None,
     inner_step: Callable | None = None,
 ) -> Callable:
+    axes = _replica_axes(mesh)
+    if inner_step is not None and axes != ("data",):
+        raise NotImplementedError(
+            f"custom step bodies assume axis_name='data'; mesh has {axes}"
+        )
     inner = inner_step or make_eval_step(
-        classification, axis_name="data", loss_fn=loss_fn
+        classification, axis_name=axes, loss_fn=loss_fn
     )
 
     def body(state: TrainState, stacked: GraphBatch):
         return inner(state, _squeeze0(stacked))
 
     smapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
+        body, mesh=mesh, in_specs=(P(), P(axes)), out_specs=P(),
         check_vma=False,
     )
     return jax.jit(smapped)
@@ -175,6 +200,8 @@ def fit_data_parallel(
     eval_step_fn: Callable | None = None,
     best_metric: str | None = None,
     on_epoch_metrics: Callable | None = None,
+    pack_once: bool = False,
+    device_resident: bool = False,
 ) -> tuple[TrainState, dict]:
     """DP twin of train.loop.fit; ``batch_size`` is per device.
 
@@ -187,6 +214,10 @@ def fit_data_parallel(
     their 'data' row but their edge leaves are split over 'graph'. The
     model in ``state.apply_fn`` must then be built with
     ``edge_axis_name='graph'``.
+
+    ``pack_once`` / ``device_resident`` mirror train.loop.fit: pack (and,
+    for device_resident, mesh-shard into HBM) the stacked batches once,
+    reshuffling stacked-batch order across epochs.
     """
     from cgnn_tpu.parallel.mesh import make_mesh
 
@@ -224,33 +255,80 @@ def fit_data_parallel(
     history = []
     rng = np.random.default_rng(seed)
     from cgnn_tpu.data.loader import prefetch_to_device
+    from collections import deque
+
+    from cgnn_tpu.train.metrics import accumulate_on_device, fetch_device_sums
+
+    pack_once = pack_once or device_resident
+    packed_train: list | None = None
+    packed_val: list | None = None
+
+    def _drive(step, batches, is_train):
+        """Run one pass; device-side metric accumulation + a sliding
+        in-flight window for backpressure (see train.loop.run_epoch)."""
+        nonlocal state
+        dev_sums = None
+        inflight: deque = deque()
+        for stacked in batches:
+            if is_train:
+                state, metrics = step(state, stacked)
+            else:
+                metrics = step(state, stacked)
+            dev_sums = accumulate_on_device(dev_sums, metrics)
+            inflight.append(metrics)
+            if len(inflight) > 8:
+                jax.block_until_ready(inflight.popleft())
+        return fetch_device_sums(dev_sums)
+
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
-        sums: dict[str, float] = {}
-        for stacked in prefetch_to_device(
-            parallel_batches(
-                train_graphs, n_dev, batch_size, node_cap, edge_cap,
-                shuffle=True, rng=rng,
-            ),
-            device_put=shard_put,
-        ):
-            state, metrics = train_step(state, stacked)
-            for k, v in jax.device_get(metrics).items():
-                sums[k] = sums.get(k, 0.0) + float(v)
+        if pack_once:
+            if packed_train is None:
+                packed_train = list(
+                    parallel_batches(
+                        train_graphs, n_dev, batch_size, node_cap, edge_cap,
+                        shuffle=True, rng=rng,
+                    )
+                )
+                packed_val = list(
+                    parallel_batches(
+                        val_graphs, n_dev, batch_size, node_cap, edge_cap,
+                        pad_incomplete=True,
+                    )
+                )
+                if device_resident:
+                    packed_train = [shard_put(b) for b in packed_train]
+                    packed_val = [shard_put(b) for b in packed_val]
+                order = np.arange(len(packed_train))
+            else:
+                order = rng.permutation(len(packed_train))
+            epoch_train = (packed_train[i] for i in order)
+            epoch_val = iter(packed_val)
+            if device_resident:
+                train_it, val_it = epoch_train, epoch_val
+            else:
+                train_it = prefetch_to_device(epoch_train, device_put=shard_put)
+                val_it = prefetch_to_device(epoch_val, device_put=shard_put)
+        else:
+            train_it = prefetch_to_device(
+                parallel_batches(
+                    train_graphs, n_dev, batch_size, node_cap, edge_cap,
+                    shuffle=True, rng=rng,
+                ),
+                device_put=shard_put,
+            )
+            val_it = prefetch_to_device(
+                parallel_batches(
+                    val_graphs, n_dev, batch_size, node_cap, edge_cap,
+                    pad_incomplete=True,
+                ),
+                device_put=shard_put,
+            )
+        sums = _drive(train_step, train_it, is_train=True)
         train_count = max(sums.get("count", 1.0), 1.0)
         train_loss = sums.get("loss_sum", np.nan) / train_count
 
-        vsums: dict[str, float] = {}
-        for stacked in prefetch_to_device(
-            parallel_batches(
-                val_graphs, n_dev, batch_size, node_cap, edge_cap,
-                pad_incomplete=True,
-            ),
-            device_put=shard_put,
-        ):
-            metrics = eval_step(state, stacked)
-            for k, v in jax.device_get(metrics).items():
-                vsums[k] = vsums.get(k, 0.0) + float(v)
+        vsums = _drive(eval_step, val_it, is_train=False)
         vcount = max(vsums.get("count", 1.0), 1.0)
         val_m = {
             k[: -len("_sum")]: v / max(

@@ -10,6 +10,29 @@ from __future__ import annotations
 import numpy as np
 
 
+def accumulate_on_device(dev_sums: dict | None, metrics: dict) -> dict:
+    """Add a step's metric dict into device-side running sums.
+
+    The adds are dispatched asynchronously — no host<->device round trip
+    per step (which would dominate epoch time on remote/tunneled
+    accelerators and throttle dispatch pipelining everywhere). Tolerates
+    keys appearing mid-epoch (mixed step bodies)."""
+    if dev_sums is None:
+        return dict(metrics)
+    for k, v in metrics.items():
+        dev_sums[k] = dev_sums[k] + v if k in dev_sums else v
+    return dev_sums
+
+
+def fetch_device_sums(dev_sums: dict | None) -> dict:
+    """One blocking device_get of the accumulated sums -> python floats."""
+    import jax
+
+    if dev_sums is None:
+        return {}
+    return {k: float(v) for k, v in jax.device_get(dev_sums).items()}
+
+
 class AverageMeter:
     """Running (value, average) meter — the reference's training display."""
 

@@ -57,15 +57,17 @@ def classification_loss(out, batch: GraphBatch, normalizer):
 
 def make_train_step(
     classification: bool = False,
-    axis_name: str | None = None,
+    axis_name: str | tuple[str, ...] | None = None,
     loss_fn: Callable | None = None,
     loss_scale: float = 1.0,
     pmean_grads: bool = True,
 ) -> Callable:
     """Build the (state, batch) -> (state, metrics) step body.
 
-    ``axis_name`` activates cross-device reductions; only set it when the
-    step runs inside shard_map/vmap with that axis bound.
+    ``axis_name`` activates cross-device reductions (a tuple reduces over
+    several mesh axes at once — hierarchical multi-host DP over
+    ('dcn', 'data')); only set it when the step runs inside shard_map/vmap
+    with those axes bound.
 
     ``loss_scale`` multiplies the loss before differentiation (metrics are
     unscaled) and ``pmean_grads=False`` skips the explicit grad allreduce —
@@ -108,7 +110,7 @@ def make_train_step(
 
 def make_eval_step(
     classification: bool = False,
-    axis_name: str | None = None,
+    axis_name: str | tuple[str, ...] | None = None,
     loss_fn: Callable | None = None,
 ) -> Callable:
     """(state, batch) -> metrics, using running BatchNorm statistics."""

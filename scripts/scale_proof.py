@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""MP-146k-scale end-to-end proof (BASELINE config #2 at real scale).
+
+Real Materials Project data is unavailable offline, so this exercises the
+full pipeline at MP-146k SCALE with the synthetic MP-like distribution
+(lognormal ~30 atoms — the same distribution bench.py measures):
+
+  1. generate + featurize N structures (timed: host preprocessing rate)
+  2. save + mmap-reload the graph cache (timed; the offline-preprocess
+     artifact SURVEY.md §7 phase 4 prescribes)
+  3. train --epochs epochs of band-gap-style regression on the visible
+     device (timed per epoch: END-TO-END throughput including host packing
+     and prefetch, not just the jitted step bench.py isolates), with
+     --pack-once exercising the cached-dataset fast path
+
+Prints one JSON line with every stage's numbers.
+
+Usage: python scripts/scale_proof.py [--n 146210] [--epochs 3] [--pack-once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--n", type=int, default=146_210)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--buckets", type=int, default=3)
+    p.add_argument("--pack-once", action="store_true")
+    p.add_argument("--device-resident", action="store_true",
+                   help="stage packed batches into HBM once (implies "
+                        "--pack-once)")
+    p.add_argument("--cache", type=str, default="/tmp/mp146k_cache.npz")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--device", choices=["auto", "cpu"], default="auto")
+    args = p.parse_args(argv)
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from cgnn_tpu.data.cache import load_graph_cache, save_graph_cache
+    from cgnn_tpu.data.dataset import (
+        FeaturizeConfig,
+        load_synthetic_mp,
+        train_val_test_split,
+    )
+    from cgnn_tpu.data.graph import pack_graphs
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.loop import capacities_for, fit
+
+    out: dict = {"metric": "mp146k_scale_proof", "n_structures": args.n}
+
+    # 1. featurize (generation + neighbor search + Gaussian expansion)
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    if os.path.exists(args.cache):
+        t0 = time.perf_counter()
+        graphs = load_graph_cache(args.cache)[: args.n]
+        out["cache_load_s"] = round(time.perf_counter() - t0, 1)
+        print(f"loaded {len(graphs)} graphs from cache "
+              f"({out['cache_load_s']}s)", file=sys.stderr)
+    else:
+        t0 = time.perf_counter()
+        graphs = load_synthetic_mp(args.n, cfg, seed=args.seed)
+        dt = time.perf_counter() - t0
+        out["featurize_s"] = round(dt, 1)
+        out["featurize_structs_per_sec"] = round(args.n / dt, 1)
+        # 2. cache round trip
+        t0 = time.perf_counter()
+        save_graph_cache(graphs, args.cache)
+        out["cache_save_s"] = round(time.perf_counter() - t0, 1)
+        out["cache_mb"] = round(os.path.getsize(args.cache) / 1e6, 1)
+        t0 = time.perf_counter()
+        graphs = load_graph_cache(args.cache)
+        out["cache_load_s"] = round(time.perf_counter() - t0, 1)
+
+    # 3. end-to-end training
+    train_g, val_g, _test_g = train_val_test_split(graphs, 0.9, 0.05,
+                                                   seed=args.seed)
+    out["n_train"] = len(train_g)
+    model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
+                                dtype=jax.numpy.bfloat16)
+    tx = make_optimizer(optim="adam", lr=0.01, lr_milestones=[10**9])
+    normalizer = Normalizer.fit(np.stack([g.target for g in train_g]))
+    node_cap, edge_cap = capacities_for(train_g, args.batch_size)
+    example = pack_graphs(
+        sorted(train_g[: args.batch_size], key=lambda g: g.num_nodes),
+        node_cap, edge_cap, args.batch_size,
+    )
+    state = create_train_state(model, example, tx, normalizer,
+                               rng=jax.random.key(args.seed))
+
+    epoch_times: list[float] = []
+    last_t = [time.perf_counter()]
+
+    def on_epoch_metrics(_epoch, _train_m, _val_m):
+        now = time.perf_counter()
+        epoch_times.append(now - last_t[0])
+        last_t[0] = now
+
+    state, result = fit(
+        state, train_g, val_g, epochs=args.epochs,
+        batch_size=args.batch_size, node_cap=node_cap, edge_cap=edge_cap,
+        buckets=args.buckets, seed=args.seed, print_freq=0,
+        pack_once=args.pack_once, device_resident=args.device_resident,
+        on_epoch_metrics=on_epoch_metrics,
+        log_fn=lambda msg: print(msg, file=sys.stderr),
+    )
+    # steady state: exclude the first epoch (compiles + pack_once packing)
+    steady = epoch_times[1:] or epoch_times
+    out["epoch_s"] = [round(t, 1) for t in epoch_times]
+    out["steady_epoch_s"] = round(float(np.mean(steady)), 1)
+    out["end_to_end_structs_per_sec"] = round(
+        len(train_g) / float(np.mean(steady)), 1)
+    out["pack_once"] = bool(args.pack_once or args.device_resident)
+    out["device_resident"] = bool(args.device_resident)
+    out["final_val_mae"] = round(float(result["best"]), 5)
+    out["device"] = str(jax.devices()[0].device_kind)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,6 +105,72 @@ class TestDataParallel:
         stacked_drop = list(parallel_batches(graphs, 5, 2, node_cap, edge_cap))
         assert all(s.nodes.shape[0] == 5 for s in stacked_drop)
 
+    def test_hierarchical_dcn_mesh_matches_flat_dp(self, setup):
+        """A multi-host-style ('dcn', 'data') 2x4 mesh must produce exactly
+        the same step as a flat 8-device ('data',) mesh: the reductions span
+        both axes, XLA just routes them over different fabrics."""
+        import jax.tree_util as jtu
+        from jax.sharding import Mesh
+
+        graphs, batch, model, state, (node_cap, edge_cap) = setup
+        state2 = create_train_state(
+            model, batch, state.tx,
+            Normalizer.fit(np.stack([g.target for g in graphs])),
+        )
+        stacked = next(
+            parallel_batches(graphs, 8, 2, node_cap, edge_cap)
+        )
+
+        mesh_flat = make_mesh(N_DEV)
+        s1, m1 = make_parallel_train_step(mesh_flat)(
+            replicate_state(state, mesh_flat),
+            shard_leading_axis(stacked, mesh_flat),
+        )
+
+        mesh_dcn = Mesh(
+            np.array(jax.devices()[:8]).reshape(2, 4), ("dcn", "data")
+        )
+        s2, m2 = make_parallel_train_step(mesh_dcn)(
+            replicate_state(state2, mesh_dcn),
+            shard_leading_axis(stacked, mesh_dcn),
+        )
+        m1, m2 = jax.device_get((m1, m2))
+        assert float(m1["loss_sum"]) == pytest.approx(
+            float(m2["loss_sum"]), rel=1e-6)
+        for a, b in zip(
+            jtu.tree_leaves(jax.device_get(s1.params)),
+            jtu.tree_leaves(jax.device_get(s2.params)),
+        ):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_fit_dp_device_resident_matches_streaming(self, setup):
+        """DP fit with pack_once/device_resident: first epoch identical to
+        the streaming path (same seed), later epochs keep training."""
+        from cgnn_tpu.parallel import fit_data_parallel
+
+        graphs, batch, model, state, (node_cap, edge_cap) = setup
+        quiet = lambda *a, **k: None  # noqa: E731
+
+        def run(**kw):
+            s = create_train_state(
+                model, batch, state.tx,
+                Normalizer.fit(np.stack([g.target for g in graphs])),
+            )
+            _, result = fit_data_parallel(
+                s, graphs, graphs[:8], epochs=2, batch_size=2,
+                node_cap=node_cap, edge_cap=edge_cap, seed=5,
+                mesh=make_mesh(4), log_fn=quiet, **kw,
+            )
+            return result["history"]
+
+        h_stream = run()
+        h_dr = run(device_resident=True)
+        assert h_dr[0]["train_loss"] == pytest.approx(
+            h_stream[0]["train_loss"], rel=1e-6)
+        assert h_dr[0]["val"]["mae"] == pytest.approx(
+            h_stream[0]["val"]["mae"], rel=1e-6)
+        assert np.isfinite(h_dr[1]["train_loss"])
+
     def test_sharded_train_progresses(self, setup):
         """Distinct per-device batches: loss goes down over DP steps."""
         graphs, batch, model, state, (node_cap, edge_cap) = setup

@@ -107,6 +107,43 @@ class TestFit:
         mean_mae = float(np.mean([abs(float(g.target[0]) - mean_t) for g in val_g]))
         assert result["best"] < mean_mae
 
+    def test_pack_once_first_epoch_identical_then_trains(self, tiny_dataset):
+        """pack_once: epoch 0 is bit-identical to per-epoch packing (same
+        seed, same packing order); later epochs reshuffle batch order and
+        keep training on every structure."""
+        train_g, val_g, _ = tiny_dataset
+        node_cap, edge_cap = capacities_for(train_g, 16)
+
+        def run(pack_once, device_resident=False):
+            model = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=24)
+            tx = make_optimizer(optim="adam", lr=0.01)
+            normalizer = Normalizer.fit(np.stack([g.target for g in train_g]))
+            example = pack_graphs(train_g[:16], node_cap, edge_cap, 16)
+            state = create_train_state(model, example, tx, normalizer,
+                                       rng=jax.random.key(1))
+            _, result = fit(
+                state, train_g, val_g, epochs=3, batch_size=16,
+                node_cap=node_cap, edge_cap=edge_cap, print_freq=0,
+                seed=4, pack_once=pack_once,
+                device_resident=device_resident, log_fn=lambda *a: None,
+            )
+            return result["history"]
+
+        h_ref, h_po = run(False), run(True)
+        # device_resident implies pack_once and reuses HBM buffers; the
+        # trajectory must be identical to host-side pack_once
+        h_dr = run(False, device_resident=True)
+        assert h_po[0]["train"]["loss"] == pytest.approx(
+            h_ref[0]["train"]["loss"], rel=1e-6)
+        assert h_po[0]["val"]["mae"] == pytest.approx(
+            h_ref[0]["val"]["mae"], rel=1e-6)
+        for h, hd in zip(h_po, h_dr):
+            # every epoch still visits every training structure once
+            assert h["train"]["count"] == h_ref[0]["train"]["count"]
+            assert np.isfinite(h["train"]["loss"])
+            assert hd["train"]["loss"] == pytest.approx(
+                h["train"]["loss"], rel=1e-6)
+
     def test_checkpoint_round_trip(self, tiny_dataset, tmp_path):
         train_g, _, _ = tiny_dataset
         model = CrystalGraphConvNet(atom_fea_len=8, n_conv=1, h_fea_len=16)

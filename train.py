@@ -90,6 +90,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--buckets", type=int, default=1,
                    help="size-class buckets for batching (>1 compiles one "
                         "step per bucket; better padding on mixed-size data)")
+    p.add_argument("--pack-once", action="store_true",
+                   help="pack training batches once and shuffle batch order "
+                        "across epochs (large cached datasets: per-epoch "
+                        "host packing would starve the device)")
+    p.add_argument("--device-resident", action="store_true",
+                   help="stage packed batches into HBM once and reuse the "
+                        "device buffers every epoch (implies --pack-once; "
+                        "dataset batches must fit in HBM)")
     # force task (BASELINE config #5)
     p.add_argument("--energy-weight", type=float, default=1.0,
                    help="w_e in L = w_e*MSE(E) + w_f*MSE(F)")
@@ -340,7 +348,9 @@ def main(argv=None) -> int:
             node_cap=node_cap, edge_cap=edge_cap, classification=classification,
             seed=args.seed, print_freq=args.print_freq,
             on_epoch_end=save_cb, start_epoch=start_epoch,
-            on_epoch_metrics=log_epoch_metrics, mesh=mesh, **step_overrides,
+            on_epoch_metrics=log_epoch_metrics, mesh=mesh,
+            pack_once=args.pack_once, device_resident=args.device_resident,
+            **step_overrides,
         )
         state = fit_state.replace(apply_fn=state.apply_fn)
     else:
@@ -358,6 +368,7 @@ def main(argv=None) -> int:
             on_epoch_end=save_cb, start_epoch=start_epoch,
             buckets=args.buckets, on_epoch_metrics=log_epoch_metrics,
             profile_steps=args.profile, profile_dir=log_dir,
+            pack_once=args.pack_once, device_resident=args.device_resident,
             **step_overrides,
         )
 
