@@ -27,6 +27,10 @@ class ModelConfig:
     # config #3: per-task MLP stacks over the shared trunk instead of one
     # shared fc_out with T outputs (models/heads.py MultiTaskHead)
     multi_task_head: bool = False
+    # dense edge-slot layout (data/graph.py pack_graphs dense_m): scatter-
+    # free aggregation, ~2x faster train step on TPU; 0/None = flat COO.
+    # Serialized so predict.py packs batches the way the model expects.
+    dense_m: int = 0
 
     def to_meta(self) -> dict:
         return dataclasses.asdict(self) | {
@@ -39,6 +43,7 @@ class ModelConfig:
         kw = {k: v for k, v in meta.items() if k in fields}
         kw["classification"] = bool(kw.get("classification", 0))
         kw["multi_task_head"] = bool(kw.get("multi_task_head", 0))
+        kw["dense_m"] = int(kw.get("dense_m", 0))
         if kw.get("aggregation") in ("__none__", None):
             kw["aggregation"] = None
         return cls(**kw)
@@ -73,6 +78,7 @@ class ModelConfig:
             aggregation_impl=self.aggregation,
             head=head,
             edge_axis_name=edge_axis_name,
+            dense_m=self.dense_m or None,
         )
 
 

@@ -52,6 +52,13 @@ class CGConv(nn.Module):
     # aggregates are psum-ed back to full sums and edge-BN moments span all
     # shards. Only valid inside shard_map with the axis bound.
     edge_axis_name: str | None = None
+    # dense slot layout (pack_graphs dense_m): node n owns edge slots
+    # [n*M, (n+1)*M). Aggregation becomes a plain sum over M — no scatter
+    # in the forward, and its transpose is a broadcast — and the per-edge
+    # v_i gather becomes a broadcast. On v5e this path removes the XLA
+    # scatter that runs ~50x below HBM bandwidth (the CUDA atomicAdd
+    # analog of SURVEY.md §2 N2, solved the TPU way: layout, not atomics).
+    dense_m: int | None = None
 
     @nn.compact
     def __call__(
@@ -65,27 +72,51 @@ class CGConv(nn.Module):
         train: bool = False,
     ) -> jax.Array:
         f = self.features
-        v_i = gather(nodes, centers)
-        v_j = gather(nodes, neighbors)
-        z = jnp.concatenate([v_i, v_j, edges.astype(nodes.dtype)], axis=-1)
-        z = nn.Dense(2 * f, dtype=self.dtype, name="fc_full")(z)
-        if self.use_batchnorm:
-            z = MaskedBatchNorm(
-                dtype=self.dtype, name="bn1", axis_name=self.edge_axis_name
-            )(z, mask=edge_mask, use_running_average=not train)
-        gate, core = jnp.split(z, 2, axis=-1)
-        msg = nn.sigmoid(gate) * nn.softplus(core)
-        msg = msg * edge_mask[:, None].astype(msg.dtype)
-        agg = aggregate_edge_messages(
-            msg,
-            centers,
-            nodes.shape[0],
-            impl=self.aggregation_impl,
-            indices_are_sorted=self.assume_sorted_edges,
-        )
-        if self.edge_axis_name is not None:
-            # partial per-node sums from this edge shard -> full sums
-            agg = jax.lax.psum(agg, self.edge_axis_name)
+        if self.dense_m is not None and self.edge_axis_name is not None:
+            raise NotImplementedError(
+                "dense layout + edge-sharded parallelism: shard the flat "
+                "layout instead (aggregation_impl='xla')"
+            )
+        if self.dense_m is not None:
+            m = self.dense_m
+            n = nodes.shape[0]
+            fdim = nodes.shape[-1]
+            v_j = gather(nodes, neighbors).reshape(n, m, fdim)
+            v_i = jnp.broadcast_to(nodes[:, None, :], (n, m, fdim))
+            e = edges.astype(nodes.dtype).reshape(n, m, -1)
+            z = jnp.concatenate([v_i, v_j, e], axis=-1)
+            z = nn.Dense(2 * f, dtype=self.dtype, name="fc_full")(z)
+            if self.use_batchnorm:
+                z = MaskedBatchNorm(dtype=self.dtype, name="bn1")(
+                    z.reshape(n * m, 2 * f), mask=edge_mask,
+                    use_running_average=not train,
+                ).reshape(n, m, 2 * f)
+            gate, core = jnp.split(z, 2, axis=-1)
+            msg = nn.sigmoid(gate) * nn.softplus(core)
+            msg = msg * edge_mask.reshape(n, m, 1).astype(msg.dtype)
+            agg = msg.sum(axis=1)
+        else:
+            v_i = gather(nodes, centers)
+            v_j = gather(nodes, neighbors)
+            z = jnp.concatenate([v_i, v_j, edges.astype(nodes.dtype)], axis=-1)
+            z = nn.Dense(2 * f, dtype=self.dtype, name="fc_full")(z)
+            if self.use_batchnorm:
+                z = MaskedBatchNorm(
+                    dtype=self.dtype, name="bn1", axis_name=self.edge_axis_name
+                )(z, mask=edge_mask, use_running_average=not train)
+            gate, core = jnp.split(z, 2, axis=-1)
+            msg = nn.sigmoid(gate) * nn.softplus(core)
+            msg = msg * edge_mask[:, None].astype(msg.dtype)
+            agg = aggregate_edge_messages(
+                msg,
+                centers,
+                nodes.shape[0],
+                impl=self.aggregation_impl,
+                indices_are_sorted=self.assume_sorted_edges,
+            )
+            if self.edge_axis_name is not None:
+                # partial per-node sums from this edge shard -> full sums
+                agg = jax.lax.psum(agg, self.edge_axis_name)
         if self.use_batchnorm:
             agg = MaskedBatchNorm(dtype=self.dtype, name="bn2")(
                 agg, mask=node_mask, use_running_average=not train
@@ -115,6 +146,7 @@ class CrystalGraphConvNet(nn.Module):
     assume_sorted_edges: bool = True
     head: nn.Module | None = None  # e.g. MultiTaskHead; replaces fc stack
     edge_axis_name: str | None = None  # edge-sharded graph parallelism
+    dense_m: int | None = None  # dense slot layout (see CGConv.dense_m)
 
     @nn.compact
     def __call__(
@@ -131,6 +163,7 @@ class CrystalGraphConvNet(nn.Module):
                 aggregation_impl=self.aggregation_impl,
                 assume_sorted_edges=self.assume_sorted_edges,
                 edge_axis_name=self.edge_axis_name,
+                dense_m=self.dense_m,
                 name=f"conv_{i}",
             )(
                 nodes,

@@ -21,17 +21,19 @@ Backward: aggregation is linear, so d_messages = d_out[centers] — a plain
 XLA gather (custom_vjp below). Exposed through
 ``aggregate_edge_messages(..., impl='pallas')`` (ops/segment.py).
 
-STATUS (round 2, measured): NOT the default. At full-train-step granularity
-on the real v5e chip — the only reliable measurement here; per-op
-microbenchmarks bottom out at a ~17 µs dispatch floor through the device
-tunnel regardless of shape — XLA's sorted-scatter wins at every bench
-workload: MP-distribution b512 1.60M vs 1.55M structs/s (-3%), OC20 slabs
-b128 460k vs 406k structs/s (-13%), bf16 flagship model, _TE∈{256,512,1024}
-indistinguishable. XLA fuses the scatter with the surrounding elementwise
-epilogue inside one program; the hand kernel forces a boundary. The kernel
-stays as a correct, tested, flag-selectable backend and as the scaffold for
-a future fused-epilogue variant (gate·softplus inside the chunk loop), which
-is where a win would have to come from. See scripts/sweep_pallas.py.
+STATUS (round 3, measured with honest value-fetch fencing — the round-2
+numbers previously quoted here were polluted by ``block_until_ready``
+returning early under the tunneled runtime, see bench.py): NOT the default,
+and NOT the answer to the scatter problem. At E=567k/F=128/bf16 on the real
+v5e chip: XLA segment_sum 10.1 ms, this kernel 17.3 ms, cumsum+boundary-
+gather 21.3 ms — all ~50x below HBM bandwidth; scatter-shaped reductions
+are simply slow on this hardware. The production fix is STRUCTURAL: the
+dense edge-slot layout (data/graph.py pack_graphs dense_m) removes the
+segment-sum from the model entirely (aggregation becomes a dense reduce,
+measured 1.1 ms at the same shape, 2x faster end-to-end train step). The
+kernel stays as a correct, tested, flag-selectable backend for the flat
+layout and as the scaffold for a windowed one-hot GATHER kernel (the
+remaining neighbor-gather backward is now the dominant step cost).
 """
 
 from __future__ import annotations

@@ -17,7 +17,6 @@ import time
 from typing import Callable, Iterable, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -67,6 +66,7 @@ def parallel_batches(
     shuffle: bool = False,
     rng: np.random.Generator | None = None,
     pad_incomplete: bool = False,
+    dense_m: int | None = None,
 ) -> Iterable[GraphBatch]:
     """Yield device-stacked batches: leaves have leading axis [D, ...].
 
@@ -76,7 +76,8 @@ def parallel_batches(
     """
     group: list[GraphBatch] = []
     for b in batch_iterator(
-        graphs, batch_size, node_cap, edge_cap, shuffle=shuffle, rng=rng
+        graphs, batch_size, node_cap, edge_cap, shuffle=shuffle, rng=rng,
+        dense_m=dense_m,
     ):
         group.append(b)
         if len(group) == n_devices:
@@ -202,6 +203,7 @@ def fit_data_parallel(
     on_epoch_metrics: Callable | None = None,
     pack_once: bool = False,
     device_resident: bool = False,
+    dense_m: int | None = None,
 ) -> tuple[TrainState, dict]:
     """DP twin of train.loop.fit; ``batch_size`` is per device.
 
@@ -222,8 +224,15 @@ def fit_data_parallel(
     from cgnn_tpu.parallel.mesh import make_mesh
 
     mesh = mesh or make_mesh()
+    if dense_m is not None:
+        edge_cap = node_cap * dense_m
     graph_shards = int(mesh.shape.get("graph", 1))
     if graph_shards > 1:
+        if dense_m is not None:
+            raise NotImplementedError(
+                "dense layout + graph sharding: use the flat layout "
+                "(dense_m=None) with edge-sharded meshes"
+            )
         from cgnn_tpu.parallel.edge_parallel import (
             make_dp_edge_parallel_eval_step,
             make_dp_edge_parallel_train_step,
@@ -255,55 +264,31 @@ def fit_data_parallel(
     history = []
     rng = np.random.default_rng(seed)
     from cgnn_tpu.data.loader import prefetch_to_device
-    from collections import deque
-
-    from cgnn_tpu.train.metrics import accumulate_on_device, fetch_device_sums
+    from cgnn_tpu.train.loop import PackOncePlan, run_epoch
 
     pack_once = pack_once or device_resident
-    packed_train: list | None = None
-    packed_val: list | None = None
-
-    def _drive(step, batches, is_train):
-        """Run one pass; device-side metric accumulation + a sliding
-        in-flight window for backpressure (see train.loop.run_epoch)."""
-        nonlocal state
-        dev_sums = None
-        inflight: deque = deque()
-        for stacked in batches:
-            if is_train:
-                state, metrics = step(state, stacked)
-            else:
-                metrics = step(state, stacked)
-            dev_sums = accumulate_on_device(dev_sums, metrics)
-            inflight.append(metrics)
-            if len(inflight) > 8:
-                jax.block_until_ready(inflight.popleft())
-        return fetch_device_sums(dev_sums)
+    plan = (
+        PackOncePlan(
+            lambda: parallel_batches(
+                train_graphs, n_dev, batch_size, node_cap, edge_cap,
+                shuffle=True, rng=rng, dense_m=dense_m,
+            ),
+            lambda: parallel_batches(
+                val_graphs, n_dev, batch_size, node_cap, edge_cap,
+                pad_incomplete=True, dense_m=dense_m,
+            ),
+            rng,
+            device_resident=device_resident,
+            stage=shard_put,
+        )
+        if pack_once
+        else None
+    )
 
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
-        if pack_once:
-            if packed_train is None:
-                packed_train = list(
-                    parallel_batches(
-                        train_graphs, n_dev, batch_size, node_cap, edge_cap,
-                        shuffle=True, rng=rng,
-                    )
-                )
-                packed_val = list(
-                    parallel_batches(
-                        val_graphs, n_dev, batch_size, node_cap, edge_cap,
-                        pad_incomplete=True,
-                    )
-                )
-                if device_resident:
-                    packed_train = [shard_put(b) for b in packed_train]
-                    packed_val = [shard_put(b) for b in packed_val]
-                order = np.arange(len(packed_train))
-            else:
-                order = rng.permutation(len(packed_train))
-            epoch_train = (packed_train[i] for i in order)
-            epoch_val = iter(packed_val)
+        if plan is not None:
+            epoch_train, epoch_val = plan.epoch_iterators()
             if device_resident:
                 train_it, val_it = epoch_train, epoch_val
             else:
@@ -313,29 +298,35 @@ def fit_data_parallel(
             train_it = prefetch_to_device(
                 parallel_batches(
                     train_graphs, n_dev, batch_size, node_cap, edge_cap,
-                    shuffle=True, rng=rng,
+                    shuffle=True, rng=rng, dense_m=dense_m,
                 ),
                 device_put=shard_put,
             )
             val_it = prefetch_to_device(
                 parallel_batches(
                     val_graphs, n_dev, batch_size, node_cap, edge_cap,
-                    pad_incomplete=True,
+                    pad_incomplete=True, dense_m=dense_m,
                 ),
                 device_put=shard_put,
             )
-        sums = _drive(train_step, train_it, is_train=True)
-        train_count = max(sums.get("count", 1.0), 1.0)
-        train_loss = sums.get("loss_sum", np.nan) / train_count
-
-        vsums = _drive(eval_step, val_it, is_train=False)
-        vcount = max(vsums.get("count", 1.0), 1.0)
-        val_m = {
-            k[: -len("_sum")]: v / max(
-                vsums.get(k[: -len("_sum")] + "_count", vcount), 1.0
+        state, train_m = run_epoch(
+            train_step, state, train_it, train=True,
+            print_freq=print_freq, epoch=epoch, log_fn=log_fn,
+        )
+        if train_m["steps"] == 0:
+            # drop_last semantics silently discard every incomplete device
+            # group; a too-small dataset would otherwise "train" on nothing
+            raise ValueError(
+                f"no full device group: {len(train_graphs)} training graphs "
+                f"cannot fill {n_dev} devices x batch_size {batch_size}; "
+                f"reduce --batch-size or the device count"
             )
-            for k, v in vsums.items() if k.endswith("_sum")
-        }
+        train_count = max(train_m.get("count", 1.0), 1.0)
+        train_loss = train_m.get("loss", np.nan)
+
+        _, val_m = run_epoch(
+            eval_step, state, val_it, train=False, epoch=epoch, log_fn=log_fn,
+        )
         best_key = best_metric or ("correct" if classification else "mae")
         metric = val_m.get(best_key, np.nan)
         is_best = metric > best if classification else metric < best

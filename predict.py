@@ -77,11 +77,16 @@ def main(argv=None) -> int:
             args.root_dir, data_cfg.featurize_config(),
             keep_geometry=force_task,
         )
-    node_cap, edge_cap = capacities_for(graphs, args.batch_size)
+    # pack the way the model expects (dense slot layout rides in the
+    # checkpoint meta; see data/graph.py pack_graphs)
+    layout_m = model_cfg.dense_m or None
+    node_cap, edge_cap = capacities_for(graphs, args.batch_size,
+                                        dense_m=layout_m)
 
     # take the example from the iterator (respects capacities; a direct
     # pack_graphs of an oversize head batch would fail)
-    example = next(batch_iterator(graphs, args.batch_size, node_cap, edge_cap))
+    example = next(batch_iterator(graphs, args.batch_size, node_cap, edge_cap,
+                                  dense_m=layout_m))
     state = create_train_state(
         model, example, make_optimizer(),
         Normalizer.identity(model_cfg.num_targets), rng=jax.random.key(0),
@@ -98,7 +103,8 @@ def main(argv=None) -> int:
     force_ids: list[str] = []
     force_arrays: list[np.ndarray] = []
     idx = 0
-    for batch in batch_iterator(graphs, args.batch_size, node_cap, edge_cap):
+    for batch in batch_iterator(graphs, args.batch_size, node_cap, edge_cap,
+                                dense_m=layout_m):
         out = jax.device_get(predict_step(state, batch))
         if force_task:
             energies, forces = (np.asarray(out[0]), np.asarray(out[1]))

@@ -42,6 +42,7 @@ def main(argv=None) -> int:
     p.add_argument("--cache", type=str, default="/tmp/mp146k_cache.npz")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--device", choices=["auto", "cpu"], default="auto")
+    p.add_argument("--layout", choices=["dense", "coo"], default="dense")
     args = p.parse_args(argv)
     if args.device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -70,6 +71,13 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         graphs = load_graph_cache(args.cache)[: args.n]
         out["cache_load_s"] = round(time.perf_counter() - t0, 1)
+        if len(graphs) < args.n:
+            print(f"cache {args.cache} holds only {len(graphs)} graphs "
+                  f"(< --n {args.n}); delete it to regenerate",
+                  file=sys.stderr)
+            return 1
+        # report what was actually used, not what was requested
+        out["n_structures"] = len(graphs)
         print(f"loaded {len(graphs)} graphs from cache "
               f"({out['cache_load_s']}s)", file=sys.stderr)
     else:
@@ -91,14 +99,16 @@ def main(argv=None) -> int:
     train_g, val_g, _test_g = train_val_test_split(graphs, 0.9, 0.05,
                                                    seed=args.seed)
     out["n_train"] = len(train_g)
+    layout_m = cfg.max_num_nbr if args.layout == "dense" else None
     model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
-                                dtype=jax.numpy.bfloat16)
+                                dtype=jax.numpy.bfloat16, dense_m=layout_m)
     tx = make_optimizer(optim="adam", lr=0.01, lr_milestones=[10**9])
     normalizer = Normalizer.fit(np.stack([g.target for g in train_g]))
-    node_cap, edge_cap = capacities_for(train_g, args.batch_size)
+    node_cap, edge_cap = capacities_for(train_g, args.batch_size,
+                                        dense_m=layout_m)
     example = pack_graphs(
         sorted(train_g[: args.batch_size], key=lambda g: g.num_nodes),
-        node_cap, edge_cap, args.batch_size,
+        node_cap, edge_cap, args.batch_size, dense_m=layout_m,
     )
     state = create_train_state(model, example, tx, normalizer,
                                rng=jax.random.key(args.seed))
@@ -116,7 +126,7 @@ def main(argv=None) -> int:
         batch_size=args.batch_size, node_cap=node_cap, edge_cap=edge_cap,
         buckets=args.buckets, seed=args.seed, print_freq=0,
         pack_once=args.pack_once, device_resident=args.device_resident,
-        on_epoch_metrics=on_epoch_metrics,
+        dense_m=layout_m, on_epoch_metrics=on_epoch_metrics,
         log_fn=lambda msg: print(msg, file=sys.stderr),
     )
     # steady state: exclude the first epoch (compiles + pack_once packing)
@@ -127,6 +137,7 @@ def main(argv=None) -> int:
         len(train_g) / float(np.mean(steady)), 1)
     out["pack_once"] = bool(args.pack_once or args.device_resident)
     out["device_resident"] = bool(args.device_resident)
+    out["layout"] = args.layout
     out["final_val_mae"] = round(float(result["best"]), 5)
     out["device"] = str(jax.devices()[0].device_kind)
     print(json.dumps(out))

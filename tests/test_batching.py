@@ -26,6 +26,78 @@ def _mixed_graphs():
     return small + big
 
 
+def test_dense_layout_preserves_edge_set_and_invariants():
+    """Dense slot packing: node n owns slots [n*M, (n+1)*M); the flat-COO
+    invariants (sorted centers, masked padding) still hold, and the
+    (center, neighbor, feature) edge multiset is exactly the flat one's."""
+    graphs = _mixed_graphs()
+    m = CFG.max_num_nbr
+    nc, ec = capacities_for(graphs, 8, dense_m=m)
+    assert ec == nc * m
+    # same node_cap and non-binding flat edge_cap -> identical batch splits
+    flat = list(batch_iterator(graphs, 8, nc, nc * m))
+    dense = list(batch_iterator(graphs, 8, nc, ec, dense_m=m))
+    assert len(flat) == len(dense)
+    for fb, db in zip(flat, dense):
+        c = np.asarray(db.centers)
+        assert (np.diff(c) >= 0).all()  # sortedness invariant
+        assert (c == np.arange(ec) // m).all()  # dense slot ownership
+        mask = np.asarray(db.edge_mask) > 0
+        # real edges per node never exceed M, and the edge multiset matches
+        def key(b, sel):
+            return sorted(
+                zip(
+                    np.asarray(b.centers)[sel].tolist(),
+                    np.asarray(b.neighbors)[sel].tolist(),
+                    np.asarray(b.edges)[sel].sum(axis=1).round(5).tolist(),
+                )
+            )
+        assert key(db, mask) == key(fb, np.asarray(fb.edge_mask) > 0)
+        # masked padding slots are self-loops on their owning node
+        assert (np.asarray(db.neighbors)[~mask] == c[~mask]).all()
+
+
+def test_dense_model_matches_flat_model():
+    """Same graphs, same params: the dense-layout model must reproduce the
+    flat-COO model's outputs and gradients (layout is not semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cgnn_tpu.models import CrystalGraphConvNet
+
+    graphs = load_synthetic(12, CFG, seed=3)
+    m = CFG.max_num_nbr
+    fnc, fec = capacities_for(graphs, 12)
+    dnc, dec = capacities_for(graphs, 12, dense_m=m)
+    fb = next(batch_iterator(graphs, 12, fnc, fec))
+    db = next(batch_iterator(graphs, 12, dnc, dec, dense_m=m))
+    flat_model = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=24)
+    dense_model = CrystalGraphConvNet(
+        atom_fea_len=16, n_conv=2, h_fea_len=24, dense_m=m
+    )
+    variables = flat_model.init(jax.random.key(0), fb)
+
+    out_f = flat_model.apply(variables, fb)
+    out_d = dense_model.apply(variables, db)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_d), rtol=1e-5, atol=1e-5
+    )
+
+    def loss(params, model, batch):
+        out, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            batch, train=True, mutable=["batch_stats"],
+        )
+        return jnp.sum(out ** 2)
+
+    gf = jax.grad(loss)(variables["params"], flat_model, fb)
+    gd = jax.grad(loss)(variables["params"], dense_model, db)
+    for a, b in zip(jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gd)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
 def test_oc20_graphs_are_large():
     graphs = load_synthetic_oc20(8, CFG, seed=0)
     sizes = [g.num_nodes for g in graphs]
