@@ -33,6 +33,22 @@ def fetch_device_sums(dev_sums: dict | None) -> dict:
     return {k: float(v) for k, v in jax.device_get(dev_sums).items()}
 
 
+def means_from_sums(sums: dict, steps: int) -> dict:
+    """Epoch metric means from '<name>_sum' totals: each sum averages by
+    its matching '<name>_count' when present (e.g. force MAE counts atom
+    components, not graphs), else by the global 'count'."""
+    count = max(sums.get("count", 1.0), 1.0)
+    out = {
+        k[: -len("_sum")]: v
+        / max(sums.get(k[: -len("_sum")] + "_count", count), 1.0)
+        for k, v in sums.items()
+        if k.endswith("_sum")
+    }
+    out["count"] = sums.get("count", 0.0)
+    out["steps"] = steps
+    return out
+
+
 class AverageMeter:
     """Running (value, average) meter — the reference's training display."""
 

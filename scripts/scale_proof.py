@@ -39,6 +39,9 @@ def main(argv=None) -> int:
     p.add_argument("--device-resident", action="store_true",
                    help="stage packed batches into HBM once (implies "
                         "--pack-once)")
+    p.add_argument("--scan-epochs", action="store_true",
+                   help="one lax.scan dispatch per bucket shape per epoch "
+                        "(implies --device-resident)")
     p.add_argument("--cache", type=str, default="/tmp/mp146k_cache.npz")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--device", choices=["auto", "cpu"], default="auto")
@@ -126,6 +129,7 @@ def main(argv=None) -> int:
         batch_size=args.batch_size, node_cap=node_cap, edge_cap=edge_cap,
         buckets=args.buckets, seed=args.seed, print_freq=0,
         pack_once=args.pack_once, device_resident=args.device_resident,
+        scan_epochs=args.scan_epochs,
         dense_m=layout_m, on_epoch_metrics=on_epoch_metrics,
         log_fn=lambda msg: print(msg, file=sys.stderr),
     )
@@ -135,8 +139,11 @@ def main(argv=None) -> int:
     out["steady_epoch_s"] = round(float(np.mean(steady)), 1)
     out["end_to_end_structs_per_sec"] = round(
         len(train_g) / float(np.mean(steady)), 1)
-    out["pack_once"] = bool(args.pack_once or args.device_resident)
-    out["device_resident"] = bool(args.device_resident)
+    out["pack_once"] = bool(
+        args.pack_once or args.device_resident or args.scan_epochs
+    )
+    out["device_resident"] = bool(args.device_resident or args.scan_epochs)
+    out["scan_epochs"] = bool(args.scan_epochs)
     out["layout"] = args.layout
     out["final_val_mae"] = round(float(result["best"]), 5)
     out["device"] = str(jax.devices()[0].device_kind)

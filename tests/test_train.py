@@ -114,7 +114,7 @@ class TestFit:
         train_g, val_g, _ = tiny_dataset
         node_cap, edge_cap = capacities_for(train_g, 16)
 
-        def run(pack_once, device_resident=False):
+        def run(pack_once, device_resident=False, scan_epochs=False):
             model = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=24)
             tx = make_optimizer(optim="adam", lr=0.01)
             normalizer = Normalizer.fit(np.stack([g.target for g in train_g]))
@@ -125,7 +125,8 @@ class TestFit:
                 state, train_g, val_g, epochs=3, batch_size=16,
                 node_cap=node_cap, edge_cap=edge_cap, print_freq=0,
                 seed=4, pack_once=pack_once,
-                device_resident=device_resident, log_fn=lambda *a: None,
+                device_resident=device_resident, scan_epochs=scan_epochs,
+                log_fn=lambda *a: None,
             )
             return result["history"]
 
@@ -133,6 +134,14 @@ class TestFit:
         # device_resident implies pack_once and reuses HBM buffers; the
         # trajectory must be identical to host-side pack_once
         h_dr = run(False, device_resident=True)
+        # single bucket -> one scan group in packing/permutation order: the
+        # whole-epoch-scan trajectory must match the loop exactly too
+        h_scan = run(False, scan_epochs=True)
+        for h, hs in zip(h_po, h_scan):
+            assert hs["train"]["loss"] == pytest.approx(
+                h["train"]["loss"], rel=1e-5)
+            assert hs["val"]["mae"] == pytest.approx(
+                h["val"]["mae"], rel=1e-5)
         assert h_po[0]["train"]["loss"] == pytest.approx(
             h_ref[0]["train"]["loss"], rel=1e-6)
         assert h_po[0]["val"]["mae"] == pytest.approx(
@@ -143,6 +152,25 @@ class TestFit:
             assert np.isfinite(h["train"]["loss"])
             assert hd["train"]["loss"] == pytest.approx(
                 h["train"]["loss"], rel=1e-6)
+
+    def test_scan_epochs_multibucket(self, tiny_dataset):
+        """scan_epochs + buckets>1: one scan per bucket shape still
+        visits every structure every epoch and trains to finite losses."""
+        train_g, val_g, _ = tiny_dataset
+        model = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=24)
+        tx = make_optimizer(optim="adam", lr=0.01)
+        normalizer = Normalizer.fit(np.stack([g.target for g in train_g]))
+        node_cap, edge_cap = capacities_for(train_g, 8)
+        example = pack_graphs(train_g[:8], node_cap, edge_cap, 8)
+        state = create_train_state(model, example, tx, normalizer)
+        _, result = fit(
+            state, train_g, val_g, epochs=2, batch_size=8, buckets=2,
+            print_freq=0, scan_epochs=True, log_fn=lambda *a: None,
+        )
+        for h in result["history"]:
+            assert h["train"]["count"] == len(train_g)
+            assert np.isfinite(h["train"]["loss"])
+            assert np.isfinite(h["val"]["mae"])
 
     def test_checkpoint_round_trip(self, tiny_dataset, tmp_path):
         train_g, _, _ = tiny_dataset

@@ -98,6 +98,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stage packed batches into HBM once and reuse the "
                         "device buffers every epoch (implies --pack-once; "
                         "dataset batches must fit in HBM)")
+    p.add_argument("--scan-epochs", action="store_true",
+                   help="fold each epoch into one lax.scan dispatch per "
+                        "bucket shape (implies --device-resident; maximal "
+                        "throughput on high-latency links — see the fit() "
+                        "docstring for the multi-bucket ordering caveat)")
     # force task (BASELINE config #5)
     p.add_argument("--energy-weight", type=float, default=1.0,
                    help="w_e in L = w_e*MSE(E) + w_f*MSE(F)")
@@ -398,7 +403,7 @@ def main(argv=None) -> int:
             buckets=args.buckets, on_epoch_metrics=log_epoch_metrics,
             profile_steps=args.profile, profile_dir=log_dir,
             pack_once=args.pack_once, device_resident=args.device_resident,
-            dense_m=layout_m,
+            dense_m=layout_m, scan_epochs=args.scan_epochs,
             **step_overrides,
         )
 
