@@ -76,6 +76,12 @@ class GraphBatch(struct.PyTreeNode):
     lattices: Any  # [Gcap, 3, 3] f32
     edge_offsets: Any  # [Ecap, 3] f32
     node_targets: Any  # [Ncap, 3] f32 per-atom force labels; zeros when unused
+    # transpose of the neighbor gather (dense layout only, else None):
+    # row j lists the edge slots e with neighbors[e] == j, so the gather's
+    # backward becomes gather(ct, in_slots) + masked sum — a dense reduce —
+    # instead of an XLA scatter-add (ops/segment.py gather_transpose)
+    in_slots: Any = None  # [Ncap, In] i32 edge-slot indices
+    in_mask: Any = None  # [Ncap, In] u8 (1 = real incoming edge)
 
     @property
     def node_capacity(self) -> int:
@@ -91,6 +97,34 @@ class GraphBatch(struct.PyTreeNode):
 
     def num_real_graphs(self) -> Any:
         return self.graph_mask.sum()
+
+
+def max_in_degree(graphs: Sequence[CrystalGraph]) -> int:
+    """Largest per-node incoming-edge count over ``graphs`` (memoized).
+
+    In-degree (how many other atoms list atom j among their ``max_num_nbr``
+    nearest) is not bounded by ``max_num_nbr``: a central atom in an open
+    cell can be "nearest" to many. The transpose-slot capacity must cover
+    the observed maximum; compute it once per dataset (results are cached
+    on each CrystalGraph) and round up for sublane alignment.
+    """
+    worst = 0
+    for g in graphs:
+        d = getattr(g, "_max_in_degree", None)
+        if d is None:
+            d = (
+                int(np.bincount(g.neighbors, minlength=g.num_nodes).max())
+                if g.num_edges
+                else 0
+            )
+            g._max_in_degree = d
+        worst = max(worst, d)
+    return worst
+
+
+def in_degree_cap(graphs: Sequence[CrystalGraph]) -> int:
+    """Transpose-slot capacity for a dataset: max in-degree, 8-aligned."""
+    return max(8, -(-max_in_degree(graphs) // 8) * 8)
 
 
 def round_to_bucket(n: int, minimum: int = 64, growth: float = 1.3) -> int:
@@ -112,6 +146,7 @@ def pack_graphs(
     graph_cap: int,
     num_targets: int | None = None,
     dense_m: int | None = None,
+    in_cap: int | None = None,
 ) -> GraphBatch:
     """Concatenate graphs into one fixed-capacity GraphBatch (numpy).
 
@@ -125,6 +160,11 @@ def pack_graphs(
     segment ops runs ~50x below HBM bandwidth, while a dense reduction is
     a fused full-speed reduce, and the per-edge v_i gather becomes a
     broadcast (measured: see models/cgcnn.py).
+
+    ``in_cap`` (dense layout only) additionally fills ``in_slots``/
+    ``in_mask`` — the transpose of the neighbor gather, sized for a maximum
+    per-node in-degree of ``in_cap`` (see ``in_degree_cap``) — making the
+    gather's *backward* scatter-free too (ops/segment.py gather_transpose).
     """
     if not graphs:
         raise ValueError("cannot pack an empty graph list")
@@ -224,6 +264,32 @@ def pack_graphs(
         node_off += nn
         edge_off += ne
 
+    in_slots = in_mask = None
+    if in_cap is not None:
+        if dense_m is None:
+            raise ValueError("in_cap requires the dense layout (dense_m)")
+        # transpose the real edges: group flat slot ids by neighbor node.
+        # Stable-sorting by neighbor + a cumcount gives each real edge its
+        # row-local position; padding entries stay masked at slot 0.
+        real = np.nonzero(edge_mask > 0)[0]
+        nb = neighbors[real]
+        counts = np.bincount(nb, minlength=node_cap)
+        if len(real) and counts.max() > in_cap:
+            raise ValueError(
+                f"a node has in-degree {counts.max()} > in_cap={in_cap}; "
+                f"size in_cap with in_degree_cap(graphs)"
+            )
+        order = np.argsort(nb, kind="stable")
+        within = np.arange(len(real)) - np.repeat(
+            np.cumsum(counts) - counts, counts
+        )
+        in_slots = np.zeros((node_cap, in_cap), np.int32)
+        # uint8: the mask is only ever cast to the compute dtype on device,
+        # and at MP-146k scale a f32 mask would stage ~0.5 GB of HBM
+        in_mask = np.zeros((node_cap, in_cap), np.uint8)
+        in_slots[nb[order], within] = real[order]
+        in_mask[nb[order], within] = 1
+
     return GraphBatch(
         nodes=nodes,
         edges=edges,
@@ -239,6 +305,8 @@ def pack_graphs(
         lattices=lattices,
         edge_offsets=edge_offsets,
         node_targets=node_targets,
+        in_slots=in_slots,
+        in_mask=in_mask,
     )
 
 
@@ -351,6 +419,7 @@ def bucketed_batch_iterator(
     stats: PaddingStats | None = None,
     headroom: float = 1.15,
     dense_m: int | None = None,
+    in_cap: int | None = None,
 ):
     """Yield batches using per-size-class static capacities.
 
@@ -364,6 +433,10 @@ def bucketed_batch_iterator(
     """
     rng = rng or np.random.default_rng()
     bucket_of = assign_size_buckets(graphs, n_buckets)
+    # one dataset-wide transpose capacity (not per bucket): keeps in_slots
+    # shape uniform, so bucket shapes differ only in (node_cap, edge_cap)
+    if dense_m is not None and in_cap is None:
+        in_cap = in_degree_cap(graphs)
     iters, weights = [], []
     for b in range(int(bucket_of.max()) + 1):
         idxs = np.nonzero(bucket_of == b)[0]
@@ -372,7 +445,7 @@ def bucketed_batch_iterator(
         sub = [graphs[int(i)] for i in idxs]
         nc, ec = capacities_for(sub, batch_size, headroom, dense_m=dense_m)
         it = batch_iterator(sub, batch_size, nc, ec, shuffle=shuffle, rng=rng,
-                            dense_m=dense_m)
+                            dense_m=dense_m, in_cap=in_cap)
         iters.append(stats.wrap(it) if stats is not None else it)
         weights.append(float(len(idxs)))
     active = list(range(len(iters)))
@@ -424,14 +497,19 @@ def batch_iterator(
     rng: np.random.Generator | None = None,
     drop_last: bool = False,
     dense_m: int | None = None,
+    in_cap: int | None = None,
 ):
     """Yield fixed-shape GraphBatches of ``batch_size`` graphs each.
 
     All batches share one (node_cap, edge_cap, graph_cap) shape so the jitted
     train step compiles exactly once. Oversize batches (rare tail events) are
     split greedily rather than dropped. ``dense_m`` selects the dense slot
-    layout (see pack_graphs).
+    layout (see pack_graphs); transpose slots are sized automatically
+    (``in_degree_cap``) unless ``in_cap`` is given.
     """
+    if dense_m is not None and in_cap is None:
+        in_cap = in_degree_cap(graphs)
+    in_cap = in_cap or None  # 0 disables (eval-only batches: no backward)
     order = np.arange(len(graphs))
     if shuffle:
         (rng or np.random.default_rng()).shuffle(order)
@@ -451,7 +529,7 @@ def batch_iterator(
             or ne + g.num_edges > edge_cap
         ):
             yield pack_graphs(bucket, node_cap, edge_cap, batch_size,
-                              dense_m=dense_m)
+                              dense_m=dense_m, in_cap=in_cap)
             bucket, nn, ne = [], 0, 0
         bucket.append(g)
         nn += g.num_nodes
@@ -459,4 +537,4 @@ def batch_iterator(
     # drop_last drops only an *incomplete* tail (standard loader semantics)
     if bucket and (not drop_last or len(bucket) == batch_size):
         yield pack_graphs(bucket, node_cap, edge_cap, batch_size,
-                          dense_m=dense_m)
+                          dense_m=dense_m, in_cap=in_cap)

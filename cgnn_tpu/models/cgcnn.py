@@ -31,7 +31,12 @@ from flax import linen as nn
 
 from cgnn_tpu.data.graph import GraphBatch
 from cgnn_tpu.ops.norm import MaskedBatchNorm
-from cgnn_tpu.ops.segment import aggregate_edge_messages, gather, segment_mean
+from cgnn_tpu.ops.segment import (
+    aggregate_edge_messages,
+    gather,
+    gather_transpose,
+    segment_mean,
+)
 
 
 class CGConv(nn.Module):
@@ -70,6 +75,8 @@ class CGConv(nn.Module):
         edge_mask: jax.Array,  # [E]
         node_mask: jax.Array,  # [N]
         train: bool = False,
+        in_slots: jax.Array | None = None,  # [N, In] transpose of neighbors
+        in_mask: jax.Array | None = None,  # [N, In]
     ) -> jax.Array:
         f = self.features
         if self.dense_m is not None and self.edge_axis_name is not None:
@@ -81,7 +88,12 @@ class CGConv(nn.Module):
             m = self.dense_m
             n = nodes.shape[0]
             fdim = nodes.shape[-1]
-            v_j = gather(nodes, neighbors).reshape(n, m, fdim)
+            if in_slots is not None:
+                # scatter-free backward via the packed transpose mapping
+                v_j = gather_transpose(nodes, neighbors, in_slots, in_mask)
+            else:
+                v_j = gather(nodes, neighbors)
+            v_j = v_j.reshape(n, m, fdim)
             v_i = jnp.broadcast_to(nodes[:, None, :], (n, m, fdim))
             e = edges.astype(nodes.dtype).reshape(n, m, -1)
             z = jnp.concatenate([v_i, v_j, e], axis=-1)
@@ -173,6 +185,8 @@ class CrystalGraphConvNet(nn.Module):
                 batch.edge_mask,
                 batch.node_mask,
                 train=train,
+                in_slots=batch.in_slots,
+                in_mask=batch.in_mask,
             )
         # per-crystal masked mean pooling (reference `pooling`)
         crys = segment_mean(

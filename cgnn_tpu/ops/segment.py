@@ -39,6 +39,51 @@ def gather(values: jax.Array, indices: jax.Array) -> jax.Array:
     return jnp.take(values, indices, axis=0)
 
 
+def gather_transpose(
+    nodes: jax.Array,  # [N, F]
+    neighbors: jax.Array,  # [E] i32
+    in_slots: jax.Array,  # [N, In] i32 — edge slots e with neighbors[e] == j
+    in_mask: jax.Array,  # [N, In] — 1 where the slot entry is a real edge
+) -> jax.Array:
+    """``nodes[neighbors]`` with a SCATTER-FREE backward.
+
+    The forward is the plain neighbor gather. Its autodiff backward is a
+    scatter-add of the [E, F] cotangent into [N, F] — the same XLA scatter
+    the dense edge-slot layout removed from the forward aggregation (it
+    runs ~50x below HBM bandwidth on TPU). Given the host-precomputed
+    transpose mapping ``in_slots`` (pack_graphs ``in_cap``), the backward
+    becomes gather(ct, in_slots) + masked sum over the in-degree axis —
+    a row gather plus a dense reduction, both full-bandwidth ops.
+
+    Equivalence to the plain gather's VJP requires the cotangent to be
+    zero on edge slots missing from ``in_slots`` (padding slots). CGConv
+    guarantees this: messages are multiplied by ``edge_mask`` and masked
+    BatchNorm statistics exclude padding, so no gradient path reaches a
+    padded slot's ``v_j``.
+    """
+
+    @jax.custom_vjp
+    def g(n):
+        return jnp.take(n, neighbors, axis=0)
+
+    def g_fwd(n):
+        return g(n), None
+
+    def g_bwd(_, ct):  # ct: [E, F]
+        contrib = jnp.take(ct, in_slots.reshape(-1), axis=0).reshape(
+            *in_slots.shape, ct.shape[-1]
+        )
+        # accumulate in the cotangent dtype: matches the scatter-add's
+        # accumulation precision, and an f32 upcast doubles the [N, In, F]
+        # intermediate's bytes for no measured accuracy gain (full-step
+        # bf16: 16.0 ms vs f32-acc 17.5 ms vs scatter 18.8 ms)
+        grad = (contrib * in_mask[..., None].astype(ct.dtype)).sum(axis=1)
+        return (grad,)
+
+    g.defvjp(g_fwd, g_bwd)
+    return g(nodes)
+
+
 def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
     """Sum ``data`` rows into ``num_segments`` buckets (deterministic on TPU)."""
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)

@@ -54,6 +54,8 @@ def empty_batch_like(batch: GraphBatch) -> GraphBatch:
         lattices=np.zeros_like(batch.lattices),
         edge_offsets=np.zeros_like(batch.edge_offsets),
         node_targets=np.zeros_like(batch.node_targets),
+        in_slots=None if batch.in_slots is None else np.zeros_like(batch.in_slots),
+        in_mask=None if batch.in_mask is None else np.zeros_like(batch.in_mask),
     )
 
 
@@ -67,6 +69,7 @@ def parallel_batches(
     rng: np.random.Generator | None = None,
     pad_incomplete: bool = False,
     dense_m: int | None = None,
+    in_cap: int | None = None,
 ) -> Iterable[GraphBatch]:
     """Yield device-stacked batches: leaves have leading axis [D, ...].
 
@@ -77,7 +80,7 @@ def parallel_batches(
     group: list[GraphBatch] = []
     for b in batch_iterator(
         graphs, batch_size, node_cap, edge_cap, shuffle=shuffle, rng=rng,
-        dense_m=dense_m,
+        dense_m=dense_m, in_cap=in_cap,
     ):
         group.append(b)
         if len(group) == n_devices:
@@ -275,7 +278,7 @@ def fit_data_parallel(
             ),
             lambda: parallel_batches(
                 val_graphs, n_dev, batch_size, node_cap, edge_cap,
-                pad_incomplete=True, dense_m=dense_m,
+                pad_incomplete=True, dense_m=dense_m, in_cap=0,
             ),
             rng,
             device_resident=device_resident,
@@ -305,7 +308,7 @@ def fit_data_parallel(
             val_it = prefetch_to_device(
                 parallel_batches(
                     val_graphs, n_dev, batch_size, node_cap, edge_cap,
-                    pad_incomplete=True, dense_m=dense_m,
+                    pad_incomplete=True, dense_m=dense_m, in_cap=0,
                 ),
                 device_put=shard_put,
             )

@@ -39,6 +39,10 @@ from cgnn_tpu.train.step import make_eval_step, make_train_step
 
 # GraphBatch leaves whose leading axis is the edge axis
 EDGE_FIELDS = ("edges", "centers", "neighbors", "edge_mask", "edge_offsets")
+# transpose-slot fields exist only in the dense layout, which edge sharding
+# rejects; specs carry None so the pytrees match COO batches (where they
+# are None)
+_DENSE_ONLY_FIELDS = ("in_slots", "in_mask")
 _ALL_FIELDS = tuple(f.name for f in dataclasses.fields(GraphBatch))
 
 
@@ -77,6 +81,8 @@ def batch_specs(
     lead = (data_axis,) if data_axis else ()
 
     def spec(name):
+        if name in _DENSE_ONLY_FIELDS:
+            return None
         if name in EDGE_FIELDS and graph_axis:
             return P(*lead, graph_axis)
         return P(*lead)

@@ -189,7 +189,12 @@ class ScanEpochDriver:
         """Group same-shape batches, stack on a leading axis, stage to HBM."""
         groups: dict = {}
         for b in batches:
-            groups.setdefault((b.node_capacity, b.edge_capacity), []).append(b)
+            key = (
+                b.node_capacity,
+                b.edge_capacity,
+                None if b.in_slots is None else b.in_slots.shape,
+            )
+            groups.setdefault(key, []).append(b)
         return {
             k: jax.device_put(
                 jax.tree_util.tree_map(lambda *xs: np.stack(xs), *bs)
@@ -382,12 +387,14 @@ def fit(
         )
 
     def val_batches():
+        # in_cap=0: eval has no backward, so skip transpose-slot packing
         if buckets > 1:
             return bucketed_batch_iterator(
-                val_graphs, batch_size, buckets, dense_m=dense_m
+                val_graphs, batch_size, buckets, dense_m=dense_m, in_cap=0
             )
         return batch_iterator(
-            val_graphs, batch_size, node_cap, edge_cap, dense_m=dense_m
+            val_graphs, batch_size, node_cap, edge_cap, dense_m=dense_m,
+            in_cap=0,
         )
 
     train_step = jax.jit(
@@ -517,7 +524,7 @@ def evaluate(
         eval_step,
         state,
         batch_iterator(graphs, batch_size, node_cap, edge_cap,
-                       dense_m=dense_m),
+                       dense_m=dense_m, in_cap=0),
         train=False,
     )
     return metrics

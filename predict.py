@@ -86,7 +86,7 @@ def main(argv=None) -> int:
     # take the example from the iterator (respects capacities; a direct
     # pack_graphs of an oversize head batch would fail)
     example = next(batch_iterator(graphs, args.batch_size, node_cap, edge_cap,
-                                  dense_m=layout_m))
+                                  dense_m=layout_m, in_cap=0))
     state = create_train_state(
         model, example, make_optimizer(),
         Normalizer.identity(model_cfg.num_targets), rng=jax.random.key(0),
@@ -103,8 +103,9 @@ def main(argv=None) -> int:
     force_ids: list[str] = []
     force_arrays: list[np.ndarray] = []
     idx = 0
+    # in_cap=0: inference has no backward; skip transpose-slot packing
     for batch in batch_iterator(graphs, args.batch_size, node_cap, edge_cap,
-                                dense_m=layout_m):
+                                dense_m=layout_m, in_cap=0):
         out = jax.device_get(predict_step(state, batch))
         if force_task:
             energies, forces = (np.asarray(out[0]), np.asarray(out[1]))

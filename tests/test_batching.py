@@ -98,6 +98,75 @@ def test_dense_model_matches_flat_model():
         )
 
 
+def test_transpose_slots_invariants():
+    """in_slots is an exact transpose of the neighbor gather: every real
+    edge slot appears exactly once, in the row of the node it references;
+    padding entries are masked."""
+    graphs = _mixed_graphs()
+    m = CFG.max_num_nbr
+    nc, ec = capacities_for(graphs, 8, dense_m=m)
+    for b in batch_iterator(graphs, 8, nc, ec, dense_m=m):
+        assert b.in_slots is not None and b.in_mask is not None
+        assert b.in_slots.shape == b.in_mask.shape
+        assert b.in_slots.shape[0] == nc and b.in_slots.shape[1] % 8 == 0
+        real = np.nonzero(np.asarray(b.edge_mask) > 0)[0]
+        listed = np.asarray(b.in_slots)[np.asarray(b.in_mask) > 0]
+        assert sorted(listed.tolist()) == sorted(real.tolist())
+        rows, _ = np.nonzero(np.asarray(b.in_mask) > 0)
+        np.testing.assert_array_equal(
+            np.asarray(b.neighbors)[listed], rows
+        )
+
+
+def test_transpose_backward_matches_plain_gather():
+    """The scatter-free gather backward (gather_transpose) must produce the
+    same gradients as autodiff through the plain gather."""
+    import jax
+    import jax.numpy as jnp
+
+    from cgnn_tpu.models import CrystalGraphConvNet
+
+    graphs = load_synthetic(12, CFG, seed=5)
+    m = CFG.max_num_nbr
+    nc, ec = capacities_for(graphs, 12, dense_m=m)
+    db = next(batch_iterator(graphs, 12, nc, ec, dense_m=m))
+    stripped = db.replace(in_slots=None, in_mask=None)
+    model = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=24,
+                                dense_m=m)
+    variables = model.init(jax.random.key(0), stripped)
+
+    def loss(params, batch):
+        out, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            batch, train=True, mutable=["batch_stats"],
+        )
+        return jnp.sum(out ** 2)
+
+    g_plain = jax.grad(loss)(variables["params"], stripped)
+    g_transpose = jax.grad(loss)(variables["params"], db)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_plain),
+        jax.tree_util.tree_leaves(g_transpose),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_transpose_in_cap_overflow_raises():
+    from cgnn_tpu.data.graph import pack_graphs
+
+    graphs = load_synthetic(4, CFG, seed=0, max_atoms=8)
+    m = CFG.max_num_nbr
+    nc, ec = capacities_for(graphs, 4, dense_m=m)
+    try:
+        pack_graphs(graphs, nc, ec, 4, dense_m=m, in_cap=1)
+    except ValueError as e:
+        assert "in-degree" in str(e)
+    else:
+        raise AssertionError("expected in_cap overflow to raise")
+
+
 def test_oc20_graphs_are_large():
     graphs = load_synthetic_oc20(8, CFG, seed=0)
     sizes = [g.num_nodes for g in graphs]
