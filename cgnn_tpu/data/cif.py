@@ -1,11 +1,14 @@
 """In-tree CIF parser (pymatgen unavailable — SURVEY.md §7 phase 0).
 
 Supports the subset the pipeline needs: cell parameters, atom-site loops
-(type symbol or label), fractional coordinates, and symmetry expansion via
+(type symbol or label), fractional coordinates, mmCIF-style dotted tags
+(folded to underscores), and symmetry expansion via
 ``_symmetry_equiv_pos_as_xyz`` / ``_space_group_symop_operation_xyz`` loops
 (affine x,y,z expression strings applied and deduplicated). There is no
-space-group-symbol engine: files carrying only a Hermann-Mauguin symbol and no
-explicit operator loop are treated as P1.
+space-group-symbol engine: files declaring a non-P1 Hermann-Mauguin symbol
+or IT number WITHOUT an explicit operator loop are REFUSED loudly (reading
+only the asymmetric unit as P1 would silently drop atoms). Hostile-corpus
+fixtures: tests/fixtures/cif/.
 
 Out of scope (errors loudly, per SURVEY.md §7 "hard parts" #6): partial
 occupancies < 1, disordered sites.
@@ -110,6 +113,12 @@ def _symbol_from_label(label: str) -> str:
     raise CIFError(f"unknown element in site label {label!r}")
 
 
+def _norm_tag(tag: str) -> str:
+    """Lowercase a data name and fold mmCIF's category.item dots to
+    underscores: '_atom_site.fract_x' -> '_atom_site_fract_x'."""
+    return tag.lower().replace(".", "_")
+
+
 def _parse_blocks(tokens: list[str]) -> dict:
     """First data_ block -> {tag: value} plus loops as (headers, rows)."""
     items: dict[str, str] = {}
@@ -129,7 +138,7 @@ def _parse_blocks(tokens: list[str]) -> dict:
             i += 1
             headers = []
             while i < n and tokens[i].startswith("_"):
-                headers.append(tokens[i].lower())
+                headers.append(_norm_tag(tokens[i]))
                 i += 1
             values = []
             while i < n and not tokens[i].startswith("_") and \
@@ -149,10 +158,10 @@ def _parse_blocks(tokens: list[str]) -> dict:
         elif tok.startswith("_"):
             if i + 1 < n and not tokens[i + 1].startswith("_") and \
                     not tokens[i + 1].lower().startswith(("loop_", "data_")):
-                items[low] = tokens[i + 1]
+                items[_norm_tag(tok)] = tokens[i + 1]
                 i += 2
             else:
-                items[low] = ""
+                items[_norm_tag(tok)] = ""
                 i += 1
         else:
             i += 1
@@ -232,6 +241,15 @@ def parse_cif(text: str, occupancy_tol: float = 0.999) -> Structure:
             site_loop = (headers, rows)
             break
     if site_loop is None:
+        if any(
+            h.startswith("_atom_site_cartn")
+            for headers, _ in loops for h in headers
+        ):
+            raise CIFError(
+                "atom sites give only Cartesian (_atom_site_Cartn_*) "
+                "coordinates (mmCIF convention); fractional coordinates "
+                "are required"
+            )
         raise CIFError("no _atom_site_ loop with fractional coordinates")
     headers, rows = site_loop
 
@@ -275,6 +293,42 @@ def parse_cif(text: str, occupancy_tol: float = 0.999) -> Structure:
         if not ops and tag in items and items[tag]:
             ops = [parse_symmetry_op(items[tag])]
     if not ops:
+        # No explicit operators: refuse files that DECLARE a non-P1 space
+        # group by Hermann-Mauguin symbol or IT number — silently reading
+        # them as P1 would drop all but the asymmetric unit's atoms
+        # (SURVEY.md §7 hard parts #6: error loudly, no HM engine).
+        hm = next(
+            (
+                items[t]
+                for t in (
+                    "_symmetry_space_group_name_h-m",
+                    "_space_group_name_h-m_alt",
+                )
+                if items.get(t)
+            ),
+            "",
+        )
+        it_number = items.get(
+            "_space_group_it_number",
+            items.get("_symmetry_int_tables_number", ""),
+        )
+        hm_flat = hm.replace(" ", "").replace("_", "").upper()
+        # '.'/'?' are CIF placeholders for inapplicable/unknown, not a
+        # declared space group — fall through to the IT-number check
+        hm_declared = hm and hm_flat not in (".", "?")
+        if hm_declared and hm_flat != "P1":
+            raise CIFError(
+                f"space group {hm!r} declared without an explicit symmetry-"
+                f"operator loop ({'/'.join(_SYMOP_TAGS)}); this parser has "
+                f"no Hermann-Mauguin engine — re-export the file with "
+                f"explicit operators or symmetry-expanded (P1) sites"
+            )
+        if not hm_declared and it_number and it_number not in ("1", ".", "?"):
+            raise CIFError(
+                f"space group IT number {it_number} declared without an "
+                f"explicit symmetry-operator loop; cannot expand (no "
+                f"space-group table in this parser)"
+            )
         ops = [(np.eye(3), np.zeros(3))]
 
     # Expand and deduplicate (wrap to [0,1), merge within tolerance).

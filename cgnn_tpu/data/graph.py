@@ -30,6 +30,8 @@ from typing import Any, Sequence
 import numpy as np
 from flax import struct
 
+from cgnn_tpu.data import invariants
+
 
 @dataclasses.dataclass
 class CrystalGraph:
@@ -82,6 +84,15 @@ class GraphBatch(struct.PyTreeNode):
     # instead of an XLA scatter-add (ops/segment.py gather_transpose)
     in_slots: Any = None  # [Ncap, In] i32 edge-slot indices
     in_mask: Any = None  # [Ncap, In] u8 (1 = real incoming edge)
+    # two-tier transpose overflow (pack_graphs over_cap): when in_slots is
+    # sized [Ncap, M] (tier 1 = first M incoming edges; mean in-degree == M
+    # but max can be ~2M), the ~7% of edges beyond rank M land here as a
+    # node-sorted COO list consumed by a small sorted segment-sum in the
+    # backward — so tier 1 moves no padding bytes (measured: the [N, 2M]
+    # single-tier gather was the largest op of the whole step, half padding)
+    over_slots: Any = None  # [Ocap] i32 edge-slot indices
+    over_nodes: Any = None  # [Ocap] i32 neighbor node (non-decreasing)
+    over_mask: Any = None  # [Ocap] u8
 
     @property
     def node_capacity(self) -> int:
@@ -97,6 +108,46 @@ class GraphBatch(struct.PyTreeNode):
 
     def num_real_graphs(self) -> Any:
         return self.graph_mask.sum()
+
+
+def dense_neighbor_views(
+    g: CrystalGraph, m: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Flat COO graph -> the lineage's dense per-node neighbor arrays:
+    (nbr_fea [N, M, G], nbr_idx [N, M] int64, mask [N, M] f32).
+
+    Padding slots are masked self-loops. This is the ONE definition of the
+    dense-slot assignment (k-th edge of center c -> slot (c, k), edges in
+    center-sorted order) shared by the torch-oracle parity harness and
+    tests — pack_graphs' dense layout uses the same rule batch-wide.
+    """
+    n = g.num_nodes
+    counts = np.bincount(g.centers, minlength=n)
+    if counts.max(initial=0) > m:
+        raise ValueError(f"a node has {counts.max()} edges > M={m}")
+    within = np.arange(g.num_edges) - np.repeat(
+        np.cumsum(counts) - counts, counts
+    )
+    nbr = np.zeros((n, m, g.edge_fea.shape[1]), np.float32)
+    idx = np.tile(np.arange(n, dtype=np.int64)[:, None], (1, m))
+    mask = np.zeros((n, m), np.float32)
+    nbr[g.centers, within] = g.edge_fea
+    idx[g.centers, within] = g.neighbors
+    mask[g.centers, within] = 1.0
+    return nbr, idx, mask
+
+
+def batch_shape_key(batch: GraphBatch) -> tuple:
+    """Hashable key identifying a batch's full compiled shape — the ONE
+    definition shared by every shape-grouping consumer (ScanEpochDriver,
+    parallel_batches); a new shape-bearing GraphBatch field belongs here,
+    not in per-caller copies."""
+    return (
+        np.shape(batch.nodes),
+        np.shape(batch.edges),
+        None if batch.in_slots is None else np.shape(batch.in_slots),
+        None if batch.over_slots is None else np.shape(batch.over_slots),
+    )
 
 
 def max_in_degree(graphs: Sequence[CrystalGraph]) -> int:
@@ -127,6 +178,41 @@ def in_degree_cap(graphs: Sequence[CrystalGraph]) -> int:
     return max(8, -(-max_in_degree(graphs) // 8) * 8)
 
 
+def overflow_cap(
+    graphs: Sequence[CrystalGraph], graph_cap: int, dense_m: int
+) -> int:
+    """Static capacity for the two-tier transpose overflow list.
+
+    Overflow per graph = sum over nodes of max(in_degree - M, 0), cached
+    per graph. A batch of up to ``graph_cap`` graphs needs about
+    graph_cap * mean; 3 sigma * sqrt(graph_cap) covers shuffle composition
+    variance and the per-graph max guards small batches. Exceeding this at
+    pack time raises loudly (pack_graphs), never truncates.
+    """
+    per_graph = []
+    for g in graphs:
+        o = getattr(g, "_overflow_" + str(dense_m), None)
+        if o is None:
+            o = (
+                int(
+                    np.maximum(
+                        np.bincount(g.neighbors, minlength=g.num_nodes)
+                        - dense_m,
+                        0,
+                    ).sum()
+                )
+                if g.num_edges
+                else 0
+            )
+            setattr(g, "_overflow_" + str(dense_m), o)
+        per_graph.append(o)
+    per_graph = np.asarray(per_graph, np.float64)
+    need = graph_cap * per_graph.mean() + 3.0 * per_graph.std() * np.sqrt(
+        graph_cap
+    )
+    return _align8(int(max(need, per_graph.max(), 8)))
+
+
 def round_to_bucket(n: int, minimum: int = 64, growth: float = 1.3) -> int:
     """Smallest capacity in the geometric bucket ladder that fits ``n``.
 
@@ -147,6 +233,7 @@ def pack_graphs(
     num_targets: int | None = None,
     dense_m: int | None = None,
     in_cap: int | None = None,
+    over_cap: int | None = None,
 ) -> GraphBatch:
     """Concatenate graphs into one fixed-capacity GraphBatch (numpy).
 
@@ -165,6 +252,13 @@ def pack_graphs(
     ``in_mask`` — the transpose of the neighbor gather, sized for a maximum
     per-node in-degree of ``in_cap`` (see ``in_degree_cap``) — making the
     gather's *backward* scatter-free too (ops/segment.py gather_transpose).
+
+    ``over_cap`` selects the TWO-TIER transpose instead (exclusive with
+    ``in_cap``): tier 1 is ``in_slots`` at width ``dense_m`` (each node's
+    first M incoming edges — zero padding bytes at mean in-degree M), and
+    the ~7% of edges with within-neighbor rank >= M go to the node-sorted
+    ``over_slots``/``over_nodes`` COO overflow (capacity ``over_cap``, see
+    ``overflow_cap``; overflowing it raises, never truncates).
     """
     if not graphs:
         raise ValueError("cannot pack an empty graph list")
@@ -265,30 +359,53 @@ def pack_graphs(
         edge_off += ne
 
     in_slots = in_mask = None
-    if in_cap is not None:
+    over_slots = over_nodes = over_mask = None
+    if in_cap is not None and over_cap is not None:
+        raise ValueError("in_cap (single-tier) and over_cap (two-tier) are "
+                         "mutually exclusive")
+    if in_cap is not None or over_cap is not None:
         if dense_m is None:
-            raise ValueError("in_cap requires the dense layout (dense_m)")
+            raise ValueError("transpose slots require the dense layout "
+                             "(dense_m)")
         # transpose the real edges: group flat slot ids by neighbor node.
         # Stable-sorting by neighbor + a cumcount gives each real edge its
         # row-local position; padding entries stay masked at slot 0.
         real = np.nonzero(edge_mask > 0)[0]
         nb = neighbors[real]
         counts = np.bincount(nb, minlength=node_cap)
-        if len(real) and counts.max() > in_cap:
-            raise ValueError(
-                f"a node has in-degree {counts.max()} > in_cap={in_cap}; "
-                f"size in_cap with in_degree_cap(graphs)"
-            )
         order = np.argsort(nb, kind="stable")
         within = np.arange(len(real)) - np.repeat(
             np.cumsum(counts) - counts, counts
         )
-        in_slots = np.zeros((node_cap, in_cap), np.int32)
+        tier = dense_m if over_cap is not None else in_cap
+        if over_cap is None and len(real) and counts.max() > tier:
+            raise ValueError(
+                f"a node has in-degree {counts.max()} > in_cap={in_cap}; "
+                f"size in_cap with in_degree_cap(graphs)"
+            )
+        sel1 = within < tier
+        in_slots = np.zeros((node_cap, tier), np.int32)
         # uint8: the mask is only ever cast to the compute dtype on device,
         # and at MP-146k scale a f32 mask would stage ~0.5 GB of HBM
-        in_mask = np.zeros((node_cap, in_cap), np.uint8)
-        in_slots[nb[order], within] = real[order]
-        in_mask[nb[order], within] = 1
+        in_mask = np.zeros((node_cap, tier), np.uint8)
+        in_slots[nb[order][sel1], within[sel1]] = real[order][sel1]
+        in_mask[nb[order][sel1], within[sel1]] = 1
+        if over_cap is not None:
+            sel2 = ~sel1
+            k = int(sel2.sum())
+            if k > over_cap:
+                raise ValueError(
+                    f"batch has {k} transpose-overflow edges > over_cap="
+                    f"{over_cap}; size over_cap with overflow_cap(graphs)"
+                )
+            # padding targets the LAST node slot so over_nodes stays
+            # non-decreasing (the sorted-scatter promise; masked zero rows)
+            over_slots = np.zeros(over_cap, np.int32)
+            over_nodes = np.full(over_cap, node_cap - 1, np.int32)
+            over_mask = np.zeros(over_cap, np.uint8)
+            over_slots[:k] = real[order][sel2]
+            over_nodes[:k] = nb[order][sel2]
+            over_mask[:k] = 1
 
     return GraphBatch(
         nodes=nodes,
@@ -307,6 +424,9 @@ def pack_graphs(
         node_targets=node_targets,
         in_slots=in_slots,
         in_mask=in_mask,
+        over_slots=over_slots,
+        over_nodes=over_nodes,
+        over_mask=over_mask,
     )
 
 
@@ -332,6 +452,7 @@ def capacities_for(
     batch_size: int,
     headroom: float = 1.15,
     dense_m: int | None = None,
+    snug: bool = False,
 ) -> tuple[int, int]:
     """Pick one (node_cap, edge_cap) for a dataset so every shuffled batch
     fits: batch_size * max-per-graph sizes would be safe but wasteful; use
@@ -339,9 +460,39 @@ def capacities_for(
     (16/128) keep small-graph buckets tight — a 64-node floor would cap
     padding efficiency at ~60% for 8x5-atom batches.
 
+    ``snug=True`` returns exact 8-aligned capacities at ``batch_size *
+    mean`` with NO headroom and NO ladder rounding — for the
+    fill-to-capacity packing mode (``batch_iterator(snug=True)``), where
+    batches close on capacity rather than on graph count, so headroom
+    would only manufacture padding. The number of compiled shapes is
+    unchanged (one per call / per bucket); only cross-dataset shape reuse
+    is given up. Measured on the MP-like distribution this lifts padding
+    efficiency from ~0.69 (1 / (1.15 headroom x ~1.3 ladder step)) to
+    >=0.97.
+
     With ``dense_m`` the edge capacity is exactly ``node_cap * dense_m``
     (the dense slot layout, pack_graphs)."""
     nodes = np.array([g.num_nodes for g in graphs])
+    if snug:
+        # balance capacity to the BATCH COUNT: with B = ceil(n/batch_size)
+        # batches, the best possible efficiency is total/(B*cap), so size
+        # cap at total/B plus a packing margin (greedy fill wastes ~mean/2
+        # per batch; mean+std covers it with room for shuffle variance)
+        # instead of batch_size*mean — otherwise the last batch per epoch
+        # is fractionally full and costs ~1/(2B) efficiency by itself.
+        b_count = max(1, math.ceil(len(graphs) / batch_size))
+        margin = nodes.mean() + nodes.std()
+        node_cap = _align8(
+            int(max(nodes.sum() / b_count + margin, nodes.max()))
+        )
+        if dense_m is not None:
+            return node_cap, node_cap * dense_m
+        edges = np.array([g.num_edges for g in graphs])
+        margin_e = edges.mean() + edges.std()
+        edge_cap = _align8(
+            int(max(edges.sum() / b_count + margin_e, edges.max()))
+        )
+        return node_cap, edge_cap
     node_cap = round_to_bucket(
         int(max(batch_size * nodes.mean() * headroom, nodes.max())), minimum=16
     )
@@ -352,6 +503,19 @@ def capacities_for(
         int(max(batch_size * edges.mean() * headroom, edges.max())), minimum=128
     )
     return node_cap, edge_cap
+
+
+def _align8(n: int) -> int:
+    """Round up to a multiple of 8 (TPU sublane alignment)."""
+    return max(8, -(-n // 8) * 8)
+
+
+def graph_cap_for(batch_size: int) -> int:
+    """Graph-slot capacity for fill-to-capacity packing: ``batch_size``
+    plus ~12% slack (8-aligned) so node/edge capacity — not the graph
+    count — is what closes a typical batch. Graph slots are cheap
+    ([G, T] targets + [G, 3, 3] lattices); node/edge slots are not."""
+    return batch_size + _align8(max(8, batch_size // 8))
 
 
 @dataclasses.dataclass
@@ -420,6 +584,8 @@ def bucketed_batch_iterator(
     headroom: float = 1.15,
     dense_m: int | None = None,
     in_cap: int | None = None,
+    snug: bool = False,
+    per_bucket_in_cap: bool = False,
 ):
     """Yield batches using per-size-class static capacities.
 
@@ -430,22 +596,52 @@ def bucketed_batch_iterator(
     "long-context" policy for mixed MP+OC20 datasets (SURVEY.md §5).
     Batches from different classes interleave (weighted random under
     ``shuffle``) to avoid size-ordered epochs.
+
+    ``snug`` selects fill-to-capacity packing per bucket (see
+    ``batch_iterator``). ``per_bucket_in_cap`` sizes the transpose-slot
+    capacity from each bucket's own worst in-degree instead of the
+    dataset-wide maximum — one skewed graph (an adsorbate nearest to dozens
+    of slab atoms, the OC20 geometry) then inflates only its own bucket's
+    ``in_slots`` bytes, at the cost of no extra compiles (bucket shapes
+    already differ).
     """
     rng = rng or np.random.default_rng()
     bucket_of = assign_size_buckets(graphs, n_buckets)
-    # one dataset-wide transpose capacity (not per bucket): keeps in_slots
-    # shape uniform, so bucket shapes differ only in (node_cap, edge_cap)
-    if dense_m is not None and in_cap is None:
-        in_cap = in_degree_cap(graphs)
+    # transpose slots default to the two-tier layout with ONE dataset-wide
+    # overflow capacity: per-bucket over_caps would split otherwise-equal
+    # bucket shapes into distinct compiled shapes (and strand DP device
+    # groups — two buckets of small graphs often share (node_cap, edge_cap)
+    # after alignment). per_bucket_in_cap forces legacy single-tier slots
+    # sized by each bucket's own worst in-degree.
+    over_cap = None
+    if dense_m is not None and in_cap is None and not per_bucket_in_cap:
+        # one uniform capacity sized by the WORST bucket: a large-graph
+        # bucket's batches carry far more overflow than the dataset mean
+        # (bimodal mixes), and per-bucket caps would split otherwise-equal
+        # bucket shapes; the waste is a few KB of i32 per batch
+        gcap = graph_cap_for(batch_size) if snug else batch_size
+        over_cap = max(
+            overflow_cap(
+                [graphs[int(i)] for i in np.nonzero(bucket_of == b)[0]],
+                gcap, dense_m,
+            )
+            for b in range(int(bucket_of.max()) + 1)
+            if np.any(bucket_of == b)
+        )
     iters, weights = [], []
     for b in range(int(bucket_of.max()) + 1):
         idxs = np.nonzero(bucket_of == b)[0]
         if len(idxs) == 0:
             continue
         sub = [graphs[int(i)] for i in idxs]
-        nc, ec = capacities_for(sub, batch_size, headroom, dense_m=dense_m)
+        nc, ec = capacities_for(sub, batch_size, headroom, dense_m=dense_m,
+                                snug=snug)
+        b_in_cap = in_cap
+        if dense_m is not None and b_in_cap is None and per_bucket_in_cap:
+            b_in_cap = in_degree_cap(sub)
         it = batch_iterator(sub, batch_size, nc, ec, shuffle=shuffle, rng=rng,
-                            dense_m=dense_m, in_cap=in_cap)
+                            dense_m=dense_m, in_cap=b_in_cap, snug=snug,
+                            over_cap=over_cap)
         iters.append(stats.wrap(it) if stats is not None else it)
         weights.append(float(len(idxs)))
     active = list(range(len(iters)))
@@ -467,16 +663,20 @@ def count_batches(
     batch_size: int,
     node_cap: int,
     edge_cap: int,
+    snug: bool = False,
 ) -> int:
     """Exact number of batches ``batch_iterator`` yields, without packing.
 
     ``len(graphs) // batch_size`` undercounts because capacity-filled
     batches split early; LR-milestone step conversion needs the real count.
+    Must mirror ``batch_iterator``'s close condition exactly (incl. the
+    ``snug`` graph-cap slack).
     """
+    graph_cap = graph_cap_for(batch_size) if snug else batch_size
     count, in_bucket, nn, ne = 0, 0, 0, 0
     for g in graphs:
         if in_bucket and (
-            in_bucket == batch_size
+            in_bucket == graph_cap
             or nn + g.num_nodes > node_cap
             or ne + g.num_edges > edge_cap
         ):
@@ -498,6 +698,8 @@ def batch_iterator(
     drop_last: bool = False,
     dense_m: int | None = None,
     in_cap: int | None = None,
+    snug: bool = False,
+    over_cap: int | None = None,
 ):
     """Yield fixed-shape GraphBatches of ``batch_size`` graphs each.
 
@@ -506,9 +708,26 @@ def batch_iterator(
     split greedily rather than dropped. ``dense_m`` selects the dense slot
     layout (see pack_graphs); transpose slots are sized automatically
     (``in_degree_cap``) unless ``in_cap`` is given.
+
+    ``snug=True`` switches to FILL-TO-CAPACITY packing: a batch closes when
+    the next graph would overflow node/edge capacity (use with the snug
+    capacities from ``capacities_for(snug=True)``), not when it holds
+    ``batch_size`` graphs; graph slots get ~12% slack (``graph_cap_for``)
+    so capacity is the binding constraint. Padding efficiency becomes
+    1 - O(mean_graph / 2 / cap) per batch instead of 1/(headroom x ladder
+    step) — measured 0.69 -> >=0.97 on the MP-like distribution.
+
+    Transpose slots (dense layout): ``in_cap=None`` (default) packs the
+    TWO-TIER transpose — tier-1 width ``dense_m`` + overflow COO sized by
+    ``overflow_cap`` — for the scatter-free backward with no in-degree
+    padding bytes; ``in_cap>0`` forces the legacy single-tier layout;
+    ``in_cap=0`` disables transpose packing (eval-only batches).
     """
-    if dense_m is not None and in_cap is None:
-        in_cap = in_degree_cap(graphs)
+    graph_cap = graph_cap_for(batch_size) if snug else batch_size
+    if dense_m is not None and in_cap is None and over_cap is None:
+        over_cap = overflow_cap(graphs, graph_cap, dense_m)
+    if in_cap is not None:
+        over_cap = None  # explicit single-tier (or in_cap=0: disabled)
     in_cap = in_cap or None  # 0 disables (eval-only batches: no backward)
     order = np.arange(len(graphs))
     if shuffle:
@@ -524,17 +743,24 @@ def batch_iterator(
                 f"increase caps or filter the dataset"
             )
         if bucket and (
-            len(bucket) == batch_size
+            len(bucket) == graph_cap
             or nn + g.num_nodes > node_cap
             or ne + g.num_edges > edge_cap
         ):
-            yield pack_graphs(bucket, node_cap, edge_cap, batch_size,
-                              dense_m=dense_m, in_cap=in_cap)
+            yield invariants.maybe_check(
+                pack_graphs(bucket, node_cap, edge_cap, graph_cap,
+                            dense_m=dense_m, in_cap=in_cap,
+                            over_cap=over_cap),
+                dense_m,
+            )
             bucket, nn, ne = [], 0, 0
         bucket.append(g)
         nn += g.num_nodes
         ne += g.num_edges
     # drop_last drops only an *incomplete* tail (standard loader semantics)
-    if bucket and (not drop_last or len(bucket) == batch_size):
-        yield pack_graphs(bucket, node_cap, edge_cap, batch_size,
-                          dense_m=dense_m, in_cap=in_cap)
+    if bucket and (not drop_last or len(bucket) == graph_cap):
+        yield invariants.maybe_check(
+            pack_graphs(bucket, node_cap, edge_cap, graph_cap,
+                        dense_m=dense_m, in_cap=in_cap, over_cap=over_cap),
+            dense_m,
+        )

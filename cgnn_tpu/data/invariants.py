@@ -1,0 +1,154 @@
+"""GraphBatch invariant checks (SURVEY.md §5 race-detection/sanitizers).
+
+The jitted step trusts several non-local data-plane invariants that are
+established at pack time and never re-checked (``indices_are_sorted`` is an
+UNCHECKED promise to XLA's TPU scatter; ``gather_transpose``'s custom VJP is
+only correct when the transpose mapping is complete). A corrupted batch —
+a bug in a new iterator, a bad cache file, a miswired shard — would train
+silently wrong. This module is the loud path: ``--check-invariants``
+(train.py) enables validation of every packed batch at iterator exit;
+``check_batch`` can also be called directly (tests, debugging).
+
+Checks are host-side (numpy + chex static assertions) so they add zero
+device work; cost is one pass over each batch's index arrays.
+"""
+
+from __future__ import annotations
+
+import chex
+import numpy as np
+
+_ENABLED = False
+
+
+def enable(on: bool = True) -> None:
+    """Globally enable per-batch validation (the --check-invariants flag)."""
+    global _ENABLED
+    _ENABLED = on
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+class BatchInvariantError(AssertionError):
+    pass
+
+
+def _fail(msg: str):
+    raise BatchInvariantError(msg)
+
+
+def check_batch(batch, dense_m: int | None = None):
+    """Validate one host-side GraphBatch; raises BatchInvariantError.
+
+    Invariants (data/graph.py module docstring + pack_graphs):
+    - shape/dtype consistency across leaves (chex);
+    - masks are exactly {0, 1};
+    - ``centers`` is non-decreasing (the sorted-scatter promise) and every
+      real edge's endpoints are real, in-range node slots;
+    - padding edges carry zero mask AND zero features;
+    - ``node_graph`` is non-decreasing with real nodes pointing at real
+      graph slots;
+    - dense layout (``dense_m``): slot ownership centers[k] == k // M;
+    - transpose slots: ``in_slots``/``in_mask`` list every real edge slot
+      exactly once under its neighbor node — the completeness property
+      gather_transpose's scatter-free backward silently relies on.
+    """
+    nodes = np.asarray(batch.nodes)
+    edges = np.asarray(batch.edges)
+    centers = np.asarray(batch.centers)
+    neighbors = np.asarray(batch.neighbors)
+    node_graph = np.asarray(batch.node_graph)
+    node_mask = np.asarray(batch.node_mask)
+    edge_mask = np.asarray(batch.edge_mask)
+    graph_mask = np.asarray(batch.graph_mask)
+
+    ncap, ecap = nodes.shape[0], edges.shape[0]
+    chex.assert_shape(centers, (ecap,))
+    chex.assert_shape(neighbors, (ecap,))
+    chex.assert_shape(edge_mask, (ecap,))
+    chex.assert_shape(node_graph, (ncap,))
+    chex.assert_shape(node_mask, (ncap,))
+    chex.assert_type([centers, neighbors, node_graph], np.integer)
+
+    for name, m in (("node_mask", node_mask), ("edge_mask", edge_mask),
+                    ("graph_mask", graph_mask)):
+        if not np.isin(m, (0.0, 1.0)).all():
+            _fail(f"{name} contains values outside {{0, 1}}")
+
+    if np.any(np.diff(centers) < 0):
+        _fail("centers is not non-decreasing (sorted-scatter promise broken)")
+    if centers.min(initial=0) < 0 or centers.max(initial=0) >= ncap:
+        _fail("centers out of node-slot range")
+    if neighbors.min(initial=0) < 0 or neighbors.max(initial=0) >= ncap:
+        _fail("neighbors out of node-slot range")
+
+    real_e = edge_mask > 0
+    if real_e.any():
+        if not node_mask[centers[real_e]].all():
+            _fail("a real edge's center is a padding node")
+        if not node_mask[neighbors[real_e]].all():
+            _fail("a real edge's neighbor is a padding node")
+    if np.any(np.abs(edges[~real_e]) > 0):
+        _fail("padding edge slots carry nonzero features")
+
+    real_n = node_mask > 0
+    if not np.all(np.diff(node_mask) <= 0):
+        _fail("real nodes are not a contiguous prefix of the node slots")
+    if np.any(np.diff(node_graph[real_n]) < 0):
+        _fail("node_graph is not non-decreasing over real nodes")
+    if np.any(node_graph[~real_n] != 0):
+        _fail("padding nodes must belong to graph slot 0")
+    if real_n.any() and not graph_mask[node_graph[real_n]].all():
+        _fail("a real node belongs to a padding graph slot")
+
+    if dense_m is not None:
+        owner = np.arange(ecap) // dense_m
+        if not np.array_equal(centers, owner.astype(centers.dtype)):
+            _fail(f"dense slot ownership broken: centers != slot//{dense_m}")
+
+    if batch.in_slots is not None:
+        in_slots = np.asarray(batch.in_slots)
+        in_mask = np.asarray(batch.in_mask)
+        chex.assert_shape(in_mask, in_slots.shape)
+        if in_slots.shape[0] != ncap:
+            _fail("in_slots row count != node capacity")
+        listed = in_slots[in_mask > 0]
+        rows = np.repeat(np.arange(ncap), (in_mask > 0).sum(axis=1))
+        if batch.over_slots is not None:
+            over_slots = np.asarray(batch.over_slots)
+            over_nodes = np.asarray(batch.over_nodes)
+            over_mask = np.asarray(batch.over_mask)
+            chex.assert_shape(over_nodes, over_slots.shape)
+            chex.assert_shape(over_mask, over_slots.shape)
+            if np.any(np.diff(over_nodes) < 0):
+                _fail("over_nodes is not non-decreasing (sorted-scatter "
+                      "promise broken)")
+            listed = np.concatenate([listed, over_slots[over_mask > 0]])
+            rows = np.concatenate([rows, over_nodes[over_mask > 0]])
+        if listed.size != int(real_e.sum()):
+            _fail(
+                f"transpose mapping lists {listed.size} edges but the batch "
+                f"has {int(real_e.sum())} real edges (gather_transpose "
+                f"backward would drop/duplicate gradient)"
+            )
+        if listed.size:
+            if np.unique(listed).size != listed.size:
+                _fail("transpose mapping lists an edge slot twice")
+            if not real_e[listed].all():
+                _fail("transpose mapping lists a padding edge slot")
+            if not np.array_equal(
+                np.sort(listed), np.sort(np.nonzero(real_e)[0])
+            ):
+                _fail("transpose mapping misses a real edge slot")
+            if not np.array_equal(neighbors[listed], rows):
+                _fail("a transpose row lists an edge of a different neighbor")
+    return batch
+
+
+def maybe_check(batch, dense_m: int | None = None):
+    """check_batch when globally enabled, else pass-through."""
+    if _ENABLED:
+        check_batch(batch, dense_m)
+    return batch

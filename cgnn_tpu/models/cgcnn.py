@@ -39,6 +39,41 @@ from cgnn_tpu.ops.segment import (
 )
 
 
+class _SplitFcFull(nn.Module):
+    """``fc_full`` (Linear 2F+G -> 2F) computed as three sliced matmuls.
+
+    Parameter shapes/names are EXACTLY nn.Dense(2F) on the concatenated
+    [v_i, v_j, e] input — checkpoints and oracle weight transplants are
+    unchanged — but the [N, M, 2F+G] concat is never materialized and the
+    v_i slice contracts per NODE ([N,F]@[F,2F], then broadcasts over M):
+    M-fold fewer FLOPs and bytes for that term. Measured: the concat write
+    + read was the largest single HBM cost of the step (trace r3, PERF.md).
+    """
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, v_i, v_j, e):  # [N,F], [N,M,F], [N,M,G]
+        f, g = v_i.shape[-1], e.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (2 * f + g, self.features),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        k = kernel.astype(self.dtype)
+        z = (
+            (v_i.astype(self.dtype) @ k[:f])[:, None, :]
+            + v_j.astype(self.dtype) @ k[f : 2 * f]
+            + e.astype(self.dtype) @ k[2 * f :]
+        )
+        return z + bias.astype(self.dtype)
+
+
 class CGConv(nn.Module):
     """One edge-gated crystal-graph convolution (reference ``ConvLayer``)."""
 
@@ -77,6 +112,9 @@ class CGConv(nn.Module):
         train: bool = False,
         in_slots: jax.Array | None = None,  # [N, In] transpose of neighbors
         in_mask: jax.Array | None = None,  # [N, In]
+        over_slots: jax.Array | None = None,  # [O] two-tier overflow
+        over_nodes: jax.Array | None = None,  # [O]
+        over_mask: jax.Array | None = None,  # [O]
     ) -> jax.Array:
         f = self.features
         if self.dense_m is not None and self.edge_axis_name is not None:
@@ -90,19 +128,31 @@ class CGConv(nn.Module):
             fdim = nodes.shape[-1]
             if in_slots is not None:
                 # scatter-free backward via the packed transpose mapping
-                v_j = gather_transpose(nodes, neighbors, in_slots, in_mask)
+                # (two-tier when the batch carries overflow slots). NOTE:
+                # a slot-space variant (2-D index gathers keeping both
+                # directions in [N, M, F]) was tried to kill the relayout
+                # copies and measured 19% SLOWER end-to-end (17.2 vs 14.5
+                # ms/step, r3 trace5) — multi-dim gather lowering costs
+                # more than the copies it saves; keep the flat form.
+                v_j = gather_transpose(
+                    nodes, neighbors, in_slots, in_mask,
+                    over_slots=over_slots, over_nodes=over_nodes,
+                    over_mask=over_mask,
+                ).reshape(n, m, fdim)
             else:
-                v_j = gather(nodes, neighbors)
-            v_j = v_j.reshape(n, m, fdim)
-            v_i = jnp.broadcast_to(nodes[:, None, :], (n, m, fdim))
+                v_j = gather(nodes, neighbors).reshape(n, m, fdim)
             e = edges.astype(nodes.dtype).reshape(n, m, -1)
-            z = jnp.concatenate([v_i, v_j, e], axis=-1)
-            z = nn.Dense(2 * f, dtype=self.dtype, name="fc_full")(z)
+            # sliced matmuls: no [N, M, 2F+G] concat, v_i term per-node
+            z = _SplitFcFull(2 * f, dtype=self.dtype, name="fc_full")(
+                nodes, v_j, e
+            )
             if self.use_batchnorm:
+                # 3-D BN: statistics over the (N, M) slot axes directly —
+                # flattening to [N*M, 2F] costs a real layout-change copy
                 z = MaskedBatchNorm(dtype=self.dtype, name="bn1")(
-                    z.reshape(n * m, 2 * f), mask=edge_mask,
+                    z, mask=edge_mask.reshape(n, m),
                     use_running_average=not train,
-                ).reshape(n, m, 2 * f)
+                )
             gate, core = jnp.split(z, 2, axis=-1)
             msg = nn.sigmoid(gate) * nn.softplus(core)
             msg = msg * edge_mask.reshape(n, m, 1).astype(msg.dtype)
@@ -187,6 +237,9 @@ class CrystalGraphConvNet(nn.Module):
                 train=train,
                 in_slots=batch.in_slots,
                 in_mask=batch.in_mask,
+                over_slots=batch.over_slots,
+                over_nodes=batch.over_nodes,
+                over_mask=batch.over_mask,
             )
         # per-crystal masked mean pooling (reference `pooling`)
         crys = segment_mean(

@@ -44,19 +44,30 @@ def gather_transpose(
     neighbors: jax.Array,  # [E] i32
     in_slots: jax.Array,  # [N, In] i32 — edge slots e with neighbors[e] == j
     in_mask: jax.Array,  # [N, In] — 1 where the slot entry is a real edge
+    over_slots: jax.Array | None = None,  # [O] i32 overflow edge slots
+    over_nodes: jax.Array | None = None,  # [O] i32 (non-decreasing)
+    over_mask: jax.Array | None = None,  # [O]
 ) -> jax.Array:
-    """``nodes[neighbors]`` with a SCATTER-FREE backward.
+    """``nodes[neighbors]`` with a SCATTER-FREE (or scatter-light) backward.
 
     The forward is the plain neighbor gather. Its autodiff backward is a
     scatter-add of the [E, F] cotangent into [N, F] — the same XLA scatter
     the dense edge-slot layout removed from the forward aggregation (it
     runs ~50x below HBM bandwidth on TPU). Given the host-precomputed
-    transpose mapping ``in_slots`` (pack_graphs ``in_cap``), the backward
-    becomes gather(ct, in_slots) + masked sum over the in-degree axis —
-    a row gather plus a dense reduction, both full-bandwidth ops.
+    transpose mapping ``in_slots`` (pack_graphs ``in_cap``/``over_cap``),
+    the backward becomes gather(ct, in_slots) + masked sum over the
+    in-degree axis — a row gather plus a dense reduction, both
+    full-bandwidth ops.
+
+    TWO-TIER mode (``over_*`` given; pack_graphs ``over_cap``): tier 1 is
+    [N, M] (no in-degree padding — the [N, 2M] single-tier gather was the
+    step's largest single op at mean in-degree M, half padding bytes), and
+    the ~7% of edges with rank >= M arrive via a node-sorted segment-sum
+    over the small overflow list — a scatter 15x smaller than the one this
+    path replaces.
 
     Equivalence to the plain gather's VJP requires the cotangent to be
-    zero on edge slots missing from ``in_slots`` (padding slots). CGConv
+    zero on edge slots missing from the mapping (padding slots). CGConv
     guarantees this: messages are multiplied by ``edge_mask`` and masked
     BatchNorm statistics exclude padding, so no gradient path reaches a
     padded slot's ``v_j``.
@@ -78,6 +89,13 @@ def gather_transpose(
         # intermediate's bytes for no measured accuracy gain (full-step
         # bf16: 16.0 ms vs f32-acc 17.5 ms vs scatter 18.8 ms)
         grad = (contrib * in_mask[..., None].astype(ct.dtype)).sum(axis=1)
+        if over_slots is not None:
+            rows = jnp.take(ct, over_slots, axis=0)
+            rows = rows * over_mask[:, None].astype(ct.dtype)
+            grad = grad + jax.ops.segment_sum(
+                rows, over_nodes, num_segments=nodes.shape[0],
+                indices_are_sorted=True,
+            )
         return (grad,)
 
     g.defvjp(g_fwd, g_bwd)

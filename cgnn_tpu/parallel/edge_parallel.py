@@ -42,7 +42,9 @@ EDGE_FIELDS = ("edges", "centers", "neighbors", "edge_mask", "edge_offsets")
 # transpose-slot fields exist only in the dense layout, which edge sharding
 # rejects; specs carry None so the pytrees match COO batches (where they
 # are None)
-_DENSE_ONLY_FIELDS = ("in_slots", "in_mask")
+_DENSE_ONLY_FIELDS = (
+    "in_slots", "in_mask", "over_slots", "over_nodes", "over_mask",
+)
 _ALL_FIELDS = tuple(f.name for f in dataclasses.fields(GraphBatch))
 
 

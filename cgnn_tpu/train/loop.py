@@ -20,6 +20,7 @@ from cgnn_tpu.data.graph import (
     GraphBatch,
     PaddingStats,
     batch_iterator,
+    batch_shape_key,
     bucketed_batch_iterator,
     capacities_for,  # re-exported; moved to data/graph.py
     round_to_bucket,
@@ -123,6 +124,30 @@ def run_epoch(
     return state, means_from_sums(sums, it + 1)
 
 
+def profile_wrap(iterator, profile_steps: int, profile_dir: str,
+                 log_fn: Callable = print):
+    """Trace steps [1, 1+profile_steps) of ``iterator`` (step 0 is the
+    compile step; tracing it would swamp the timeline). Shared by the
+    single-device and data-parallel epoch loops."""
+    if not profile_steps:
+        yield from iterator
+        return
+    tracing = False
+    try:
+        for i, b in enumerate(iterator):
+            if i == 1:
+                jax.profiler.start_trace(profile_dir or "profile")
+                tracing = True
+            yield b
+            if tracing and i >= profile_steps:
+                jax.profiler.stop_trace()
+                tracing = False
+                log_fn(f"profiler trace written to {profile_dir}")
+    finally:
+        if tracing:
+            jax.profiler.stop_trace()
+
+
 class PackOncePlan:
     """pack_once / device_resident epoch staging, shared by ``fit`` and
     ``parallel.fit_data_parallel``: pack every batch on the first epoch,
@@ -176,27 +201,30 @@ class ScanEpochDriver:
 
     def __init__(self, train_body: Callable, eval_body: Callable,
                  train_batches: list, val_batches: list,
-                 rng: np.random.Generator):
+                 rng: np.random.Generator, stage: Callable | None = None):
+        """``stage`` places each stacked group on device (default
+        ``jax.device_put``); data-parallel callers pass a mesh-sharding
+        stage so the per-step device axis (axis 1 of the stack) lands
+        split over the mesh."""
         self._rng = rng
+        self._stage = stage if stage is not None else jax.device_put
         self._train_groups = self._stack_groups(train_batches)
         self._val_groups = self._stack_groups(val_batches)
         self._train_body, self._eval_body = train_body, eval_body
         self._train_scans: dict = {}
         self._eval_scans: dict = {}
 
-    @staticmethod
-    def _stack_groups(batches: list) -> dict:
-        """Group same-shape batches, stack on a leading axis, stage to HBM."""
+    def _stack_groups(self, batches: list) -> dict:
+        """Group same-shape batches, stack on a leading axis, stage to HBM.
+
+        Keys on the full (nodes, edges, in_slots) shapes — not the
+        capacity scalars — so already-device-stacked DP batches (leading
+        device axis) group correctly too."""
         groups: dict = {}
         for b in batches:
-            key = (
-                b.node_capacity,
-                b.edge_capacity,
-                None if b.in_slots is None else b.in_slots.shape,
-            )
-            groups.setdefault(key, []).append(b)
+            groups.setdefault(batch_shape_key(b), []).append(b)
         return {
-            k: jax.device_put(
+            k: self._stage(
                 jax.tree_util.tree_map(lambda *xs: np.stack(xs), *bs)
             )
             for k, bs in groups.items()
@@ -329,6 +357,7 @@ def fit(
     device_resident: bool = False,
     dense_m: int | None = None,
     scan_epochs: bool = False,
+    snug: bool = False,
 ) -> tuple[TrainState, dict]:
     """Reference ``main()`` loop: train/validate per epoch, track best.
 
@@ -367,7 +396,8 @@ def fit(
     device_resident = device_resident or scan_epochs
     pack_once = pack_once or device_resident
     if node_cap is None or edge_cap is None:
-        nc, ec = capacities_for(train_graphs, batch_size, dense_m=dense_m)
+        nc, ec = capacities_for(train_graphs, batch_size, dense_m=dense_m,
+                                snug=snug)
         node_cap, edge_cap = node_cap or nc, edge_cap or ec
     if dense_m is not None:
         edge_cap = node_cap * dense_m
@@ -377,12 +407,12 @@ def fit(
         if buckets > 1:
             return bucketed_batch_iterator(
                 train_graphs, batch_size, buckets, shuffle=True, rng=rng,
-                stats=pad_stats, dense_m=dense_m,
+                stats=pad_stats, dense_m=dense_m, snug=snug,
             )
         return pad_stats.wrap(
             batch_iterator(
                 train_graphs, batch_size, node_cap, edge_cap,
-                shuffle=True, rng=rng, dense_m=dense_m,
+                shuffle=True, rng=rng, dense_m=dense_m, snug=snug,
             )
         )
 
@@ -390,11 +420,12 @@ def fit(
         # in_cap=0: eval has no backward, so skip transpose-slot packing
         if buckets > 1:
             return bucketed_batch_iterator(
-                val_graphs, batch_size, buckets, dense_m=dense_m, in_cap=0
+                val_graphs, batch_size, buckets, dense_m=dense_m, in_cap=0,
+                snug=snug,
             )
         return batch_iterator(
             val_graphs, batch_size, node_cap, edge_cap, dense_m=dense_m,
-            in_cap=0,
+            in_cap=0, snug=snug,
         )
 
     train_step = jax.jit(
@@ -408,27 +439,11 @@ def fit(
     pad_stats = PaddingStats()
 
     def _with_profile(iterator, epoch):
-        """Trace steps [1, 1+profile_steps) of the first epoch (step 0 is
-        the compile step; tracing it would swamp the timeline)."""
-        if not (profile_steps and epoch == start_epoch):
-            yield from iterator
-            return
-        import jax
-
-        tracing = False
-        try:
-            for i, b in enumerate(iterator):
-                if i == 1:
-                    jax.profiler.start_trace(profile_dir or "profile")
-                    tracing = True
-                yield b
-                if tracing and i >= profile_steps:
-                    jax.profiler.stop_trace()
-                    tracing = False
-                    log_fn(f"profiler trace written to {profile_dir}")
-        finally:
-            if tracing:
-                jax.profiler.stop_trace()
+        return profile_wrap(
+            iterator,
+            profile_steps if epoch == start_epoch else 0,
+            profile_dir, log_fn,
+        )
 
     driver: ScanEpochDriver | None = None
     if scan_epochs and (profile_steps or print_freq):
@@ -516,6 +531,7 @@ def evaluate(
     classification: bool = False,
     eval_step_fn: Callable | None = None,
     dense_m: int | None = None,
+    snug: bool = False,
 ) -> dict:
     if dense_m is not None:
         edge_cap = node_cap * dense_m
@@ -524,7 +540,7 @@ def evaluate(
         eval_step,
         state,
         batch_iterator(graphs, batch_size, node_cap, edge_cap,
-                       dense_m=dense_m, in_cap=0),
+                       dense_m=dense_m, in_cap=0, snug=snug),
         train=False,
     )
     return metrics

@@ -8,9 +8,12 @@ in-tree torch oracle (tests/oracle/torch_cgcnn.py — the lineage
 architecture, SURVEY.md §4.3) and the JAX model on the SAME dataset with
 the SAME hyperparameters, from independent inits, and comparing test MAE.
 
-Structures are fully coordinated (small cells, radius 8, max_num_nbr 12)
-so the oracle's dense [N, M] layout and our flat COO layout describe the
-same edge set — the same precondition tests/test_parity.py enforces.
+Two datasets: ``--dataset tiny`` (8-atom fully-coordinated cells, the
+round-2 harness) and ``--dataset mp`` (the MP-like ~30-atom lognormal
+distribution INCLUDING under-coordinated structures — the oracle masks
+its dense [N, M] padding slots with the exact semantics of the
+framework's packing, pinned by tests/test_parity.py
+TestMaskedOracleParity at 1e-8).
 
 Prints one JSON line:
   {"torch_oracle_test_mae", "jax_test_mae", "ratio", ...}
@@ -31,7 +34,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def torch_train_eval(graphs, split, *, epochs, batch_size, lr, seed):
+def torch_train_eval(graphs, split, *, epochs, batch_size, lr, seed,
+                     max_num_nbr):
     """Train the oracle on (train, val, test) index lists -> test MAE."""
     import numpy as np
     import torch
@@ -39,17 +43,33 @@ def torch_train_eval(graphs, split, *, epochs, batch_size, lr, seed):
     from tests.oracle.torch_cgcnn import TorchCGCNN
 
     train_g, val_g, test_g = split
-    m = graphs[0].neighbors.size // graphs[0].num_nodes
+    m = max_num_nbr
+    gdim = graphs[0].edge_fea.shape[1]
+
+    from cgnn_tpu.data.graph import dense_neighbor_views
+
+    def dense_views(g):
+        """dense_neighbor_views, cached on the graph: under-coordinated
+        nodes (real MP ~30-atom cells) have < M neighbors; their padding
+        slots carry mask 0 and are excluded from BN statistics and the
+        message sum by the masked oracle — the EXACT semantics of the
+        framework's packing."""
+        cached = getattr(g, "_dense_views", None)
+        if cached is None:
+            cached = g._dense_views = dense_neighbor_views(g, m)
+        return cached
 
     def collate(batch_graphs):
         """Lineage-style collate: concat nodes, offset dense [N, M] idx."""
-        atom, nbr, idx, ranges, ys = [], [], [], [], []
+        atom, nbr, idx, masks, ranges, ys = [], [], [], [], [], []
         off = 0
         for g in batch_graphs:
             n = g.num_nodes
+            dn, di, dm = dense_views(g)
             atom.append(np.asarray(g.atom_fea, np.float32))
-            nbr.append(np.asarray(g.edge_fea, np.float32).reshape(n, m, -1))
-            idx.append(np.asarray(g.neighbors).reshape(n, m) + off)
+            nbr.append(dn)
+            idx.append(di + off)
+            masks.append(dm)
             ranges.append(torch.arange(off, off + n))
             ys.append(float(g.target[0]))
             off += n
@@ -57,6 +77,7 @@ def torch_train_eval(graphs, split, *, epochs, batch_size, lr, seed):
             torch.from_numpy(np.concatenate(atom)),
             torch.from_numpy(np.concatenate(nbr)),
             torch.from_numpy(np.concatenate(idx)).long(),
+            torch.from_numpy(np.concatenate(masks)),
             ranges,
             torch.tensor(ys, dtype=torch.float32),
         )
@@ -85,8 +106,8 @@ def torch_train_eval(graphs, split, *, epochs, batch_size, lr, seed):
         ae_sum = count = 0.0
         for i in range(0, len(order), batch_size):
             bg = [split_graphs[j] for j in order[i:i + batch_size]]
-            atom, nbr, idx, ranges, y = collate(bg)
-            out = model(atom, nbr, idx, ranges)[:, 0]
+            atom, nbr, idx, mask, ranges, y = collate(bg)
+            out = model(atom, nbr, idx, ranges, nbr_mask=mask)[:, 0]
             if train:
                 loss = torch.nn.functional.mse_loss(out, (y - t_mean) / t_std)
                 opt.zero_grad()
@@ -163,6 +184,13 @@ def main(argv=None) -> int:
     p.add_argument("--device", choices=["auto", "cpu"], default="auto")
     p.add_argument("--tolerance", type=float, default=0.05,
                    help="max allowed (jax_mae / torch_mae - 1)")
+    p.add_argument("--dataset", choices=["tiny", "mp"], default="tiny",
+                   help="'mp': the realistic MP-like lognormal ~30-atom "
+                        "distribution (radius 6), UNDER-COORDINATED "
+                        "structures included — the oracle masks its dense "
+                        "padding slots so the comparison is exact "
+                        "(VERDICT r2 #4). 'tiny': 8-atom fully-coordinated "
+                        "cells (radius 8), the round-2 harness")
     args = p.parse_args(argv)
     if args.device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -175,21 +203,29 @@ def main(argv=None) -> int:
     from cgnn_tpu.data.dataset import (
         FeaturizeConfig,
         load_synthetic,
+        load_synthetic_mp,
         train_val_test_split,
     )
 
-    cfg = FeaturizeConfig(radius=8.0, max_num_nbr=12)
-    graphs = load_synthetic(args.n, cfg, seed=11, max_atoms=8)
-    # oracle precondition: dense [N, M] layout == flat COO edge set
-    full = [
-        g for g in graphs
-        if np.all(np.bincount(g.centers, minlength=g.num_nodes)
-                  == cfg.max_num_nbr)
-    ]
-    if len(full) < args.n * 0.9:
-        print(f"only {len(full)}/{args.n} fully-coordinated structures",
-              file=sys.stderr)
-        return 1
+    if args.dataset == "mp":
+        # radius 4.5: ~9% of atoms under-coordinated (radius 6 saturates
+        # max_num_nbr on this distribution and would mask nothing)
+        cfg = FeaturizeConfig(radius=4.5, max_num_nbr=12)
+        full = load_synthetic_mp(args.n, cfg, seed=11)
+    else:
+        cfg = FeaturizeConfig(radius=8.0, max_num_nbr=12)
+        graphs = load_synthetic(args.n, cfg, seed=11, max_atoms=8)
+        # round-2 precondition: dense [N, M] layout == flat COO edge set
+        # (the masked oracle no longer needs it, kept for comparability)
+        full = [
+            g for g in graphs
+            if np.all(np.bincount(g.centers, minlength=g.num_nodes)
+                      == cfg.max_num_nbr)
+        ]
+        if len(full) < args.n * 0.9:
+            print(f"only {len(full)}/{args.n} fully-coordinated structures",
+                  file=sys.stderr)
+            return 1
     runs = []
     t_torch = t_jax = 0.0
     for seed in range(args.seed, args.seed + args.repeats):
@@ -197,7 +233,7 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         torch_mae, torch_val = torch_train_eval(
             full, split, epochs=args.epochs, batch_size=args.batch_size,
-            lr=args.lr, seed=seed,
+            lr=args.lr, seed=seed, max_num_nbr=cfg.max_num_nbr,
         )
         t_torch += time.perf_counter() - t0
         t0 = time.perf_counter()
@@ -217,6 +253,7 @@ def main(argv=None) -> int:
     ratio = mean_jax / mean_torch
     print(json.dumps({
         "metric": "formation_energy_mae_parity",
+        "dataset": args.dataset,
         "torch_oracle_test_mae": round(mean_torch, 5),
         "jax_test_mae": round(mean_jax, 5),
         "ratio": round(ratio, 4),

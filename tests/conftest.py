@@ -26,3 +26,9 @@ import jax  # noqa: E402
 if os.environ.get("JAX_ENABLE_X64", "1").lower() not in ("0", "false"):
     jax.config.update("jax_enable_x64", True)
 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+# validate every iterator-produced GraphBatch in the whole suite
+# (SURVEY.md §5 sanitizers; the --check-invariants flag, forced on here)
+from cgnn_tpu.data import invariants  # noqa: E402
+
+invariants.enable()

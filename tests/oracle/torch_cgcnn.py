@@ -24,7 +24,30 @@ class ConvLayer(nn.Module):
         self.bn1 = nn.BatchNorm1d(2 * atom_fea_len)
         self.bn2 = nn.BatchNorm1d(atom_fea_len)
 
-    def forward(self, atom_in_fea, nbr_fea, nbr_fea_idx):
+    def _masked_bn1(self, flat, mask_flat):
+        """BatchNorm1d over only the rows with mask 1 — the semantics of
+        the framework's MaskedBatchNorm (biased batch var for
+        normalization, unbiased for the running update, momentum 0.1), so
+        under-coordinated structures compare EXACTLY: a dense [N, M]
+        padding slot must not pollute the batch statistics."""
+        bn = self.bn1
+        if self.training:
+            rows = flat[mask_flat > 0]
+            mean = rows.mean(dim=0)
+            var = rows.var(dim=0, unbiased=False)
+            with torch.no_grad():
+                cnt = rows.shape[0]
+                unbiased = var * cnt / max(cnt - 1, 1)
+                bn.running_mean.mul_(1 - bn.momentum).add_(
+                    bn.momentum * mean.detach())
+                bn.running_var.mul_(1 - bn.momentum).add_(
+                    bn.momentum * unbiased.detach())
+        else:
+            mean, var = bn.running_mean, bn.running_var
+        y = (flat - mean) * torch.rsqrt(var + bn.eps)
+        return y * bn.weight + bn.bias
+
+    def forward(self, atom_in_fea, nbr_fea, nbr_fea_idx, nbr_mask=None):
         n, m = nbr_fea_idx.shape
         atom_nbr_fea = atom_in_fea[nbr_fea_idx, :]  # [N, M, F] gather
         total_fea = torch.cat(
@@ -36,13 +59,17 @@ class ConvLayer(nn.Module):
             dim=2,
         )
         gated = self.fc_full(total_fea)
-        gated = self.bn1(gated.view(-1, 2 * self.atom_fea_len)).view(
-            n, m, 2 * self.atom_fea_len
-        )
+        flat = gated.view(-1, 2 * self.atom_fea_len)
+        if nbr_mask is None:
+            flat = self.bn1(flat)
+        else:
+            flat = self._masked_bn1(flat, nbr_mask.reshape(-1))
+        gated = flat.view(n, m, 2 * self.atom_fea_len)
         nbr_filter, nbr_core = gated.chunk(2, dim=2)
-        nbr_sumed = torch.sum(
-            torch.sigmoid(nbr_filter) * nn.functional.softplus(nbr_core), dim=1
-        )
+        msg = torch.sigmoid(nbr_filter) * nn.functional.softplus(nbr_core)
+        if nbr_mask is not None:
+            msg = msg * nbr_mask.unsqueeze(-1)
+        nbr_sumed = torch.sum(msg, dim=1)
         nbr_sumed = self.bn2(nbr_sumed)
         return nn.functional.softplus(atom_in_fea + nbr_sumed)
 
@@ -71,10 +98,11 @@ class TorchCGCNN(nn.Module):
         )
         self.fc_out = nn.Linear(h_fea_len, num_targets)
 
-    def forward(self, atom_fea, nbr_fea, nbr_fea_idx, crystal_atom_idx):
+    def forward(self, atom_fea, nbr_fea, nbr_fea_idx, crystal_atom_idx,
+                nbr_mask=None):
         atom_fea = self.embedding(atom_fea)
         for conv in self.convs:
-            atom_fea = conv(atom_fea, nbr_fea, nbr_fea_idx)
+            atom_fea = conv(atom_fea, nbr_fea, nbr_fea_idx, nbr_mask)
         crys_fea = torch.stack(
             [atom_fea[idx].mean(dim=0) for idx in crystal_atom_idx]
         )

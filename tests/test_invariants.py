@@ -1,0 +1,76 @@
+"""GraphBatch invariant layer (VERDICT r2 #8, SURVEY.md §5 sanitizers).
+
+Every deliberate corruption below must fail LOUDLY under check_batch;
+conftest enables the global flag so every iterator-produced batch in the
+whole suite is validated as a side effect.
+"""
+
+import numpy as np
+import pytest
+
+from cgnn_tpu.data import invariants
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+from cgnn_tpu.data.graph import batch_iterator, capacities_for
+
+
+@pytest.fixture(scope="module")
+def dense_batch():
+    graphs = load_synthetic(24, FeaturizeConfig(radius=5.0, max_num_nbr=8),
+                            seed=9, max_atoms=6)
+    nc, ec = capacities_for(graphs, 8, dense_m=8, snug=True)
+    return next(batch_iterator(graphs, 8, nc, ec, dense_m=8, snug=True))
+
+
+def test_clean_batches_validate(dense_batch):
+    assert invariants.check_batch(dense_batch, dense_m=8) is dense_batch
+
+
+@pytest.mark.parametrize(
+    "corrupt,match",
+    [
+        (lambda b: b.replace(
+            centers=np.flip(np.asarray(b.centers).copy())),
+         "non-decreasing|ownership"),
+        (lambda b: b.replace(
+            neighbors=np.full_like(np.asarray(b.neighbors),
+                                   b.node_capacity + 3)),
+         "out of node-slot range"),
+        (lambda b: b.replace(
+            edge_mask=1.0 - np.asarray(b.edge_mask)),
+         "padding|prefix|features"),
+        (lambda b: b.replace(
+            node_mask=np.concatenate(
+                [[0.0], np.asarray(b.node_mask)[1:]])),
+         "prefix|padding node"),
+        (lambda b: b.replace(
+            graph_mask=np.asarray(b.graph_mask) * 0.5),
+         "outside"),
+        (lambda b: b.replace(
+            in_slots=np.zeros_like(np.asarray(b.in_slots))),
+         "transpose|twice"),
+    ],
+)
+def test_corruptions_fail_loudly(dense_batch, corrupt, match):
+    with pytest.raises(invariants.BatchInvariantError, match=match):
+        invariants.check_batch(corrupt(dense_batch), dense_m=8)
+
+
+def test_dense_ownership_checked(dense_batch):
+    c = np.asarray(dense_batch.centers).copy()
+    c[10] = (10 // 8) + 1  # wrong owner, still sorted-ish
+    with pytest.raises(invariants.BatchInvariantError):
+        invariants.check_batch(dense_batch.replace(centers=c), dense_m=8)
+
+
+def test_flag_gates_iterator_validation():
+    graphs = load_synthetic(8, FeaturizeConfig(radius=5.0, max_num_nbr=8),
+                            seed=9, max_atoms=6)
+    nc, ec = capacities_for(graphs, 4, snug=True)
+    was = invariants.enabled()
+    try:
+        invariants.enable(False)
+        assert len(list(batch_iterator(graphs, 4, nc, ec, snug=True))) >= 1
+        invariants.enable(True)
+        assert len(list(batch_iterator(graphs, 4, nc, ec, snug=True))) >= 1
+    finally:
+        invariants.enable(was)

@@ -187,3 +187,83 @@ class TestDataParallel:
                 m = jax.device_get(m)
                 losses.append(float(m["loss_sum"]) / max(float(m["count"]), 1))
         assert losses[-1] < losses[0]
+
+
+class TestDPFeatureParity:
+    """VERDICT r2 #3: buckets / snug / scan_epochs inside the DP loop."""
+
+    def _dense_setup(self, graphs):
+        from cgnn_tpu.data.graph import bucketed_batch_iterator
+
+        dense_model = CrystalGraphConvNet(
+            atom_fea_len=12, n_conv=2, h_fea_len=16, dense_m=8
+        )
+        eb = next(iter(bucketed_batch_iterator(
+            graphs, 2, 2, dense_m=8, snug=True
+        )))
+        tx = make_optimizer(optim="sgd", lr=0.05)
+
+        def fresh():
+            return create_train_state(
+                dense_model, eb, tx,
+                Normalizer.fit(np.stack([g.target for g in graphs])),
+            )
+
+        return fresh
+
+    def test_fit_dp_bucketed_snug_trains(self, setup):
+        from cgnn_tpu.parallel import fit_data_parallel
+
+        graphs, *_ = setup
+        fresh = self._dense_setup(graphs)
+        quiet = lambda *a, **k: None  # noqa: E731
+        _, result = fit_data_parallel(
+            fresh(), graphs, graphs[:8], epochs=6, batch_size=2,
+            node_cap=0, edge_cap=0, seed=5, mesh=make_mesh(4), log_fn=quiet,
+            buckets=2, snug=True, dense_m=8,
+        )
+        h = result["history"]
+        assert np.isfinite(h[-1]["train_loss"])
+        assert h[-1]["train_loss"] < h[0]["train_loss"]
+
+    def test_fit_dp_scan_epochs_matches_per_step(self, setup):
+        """First epoch of DP scan_epochs == per-step DP (same seed/batches,
+        single shape group so the orders coincide): the scan folds
+        dispatches, not math. Multi-bucket scan ordering is chunk-granular
+        by design (ScanEpochDriver docstring), so exact parity is a
+        single-shape property."""
+        from cgnn_tpu.data.graph import capacities_for
+        from cgnn_tpu.parallel import fit_data_parallel
+
+        graphs, *_ = setup
+        fresh = self._dense_setup(graphs)
+        quiet = lambda *a, **k: None  # noqa: E731
+        nc, ec = capacities_for(graphs, 2, dense_m=8, snug=True)
+
+        def run(**kw):
+            _, result = fit_data_parallel(
+                fresh(), graphs, graphs[:8], epochs=2, batch_size=2,
+                node_cap=nc, edge_cap=ec, seed=5, mesh=make_mesh(4),
+                log_fn=quiet, snug=True, dense_m=8, **kw,
+            )
+            return result["history"]
+
+        h_step = run(device_resident=True)
+        h_scan = run(scan_epochs=True)
+        assert h_scan[0]["train_loss"] == pytest.approx(
+            h_step[0]["train_loss"], rel=1e-5)
+        assert h_scan[0]["val"]["mae"] == pytest.approx(
+            h_step[0]["val"]["mae"], rel=1e-5)
+        assert np.isfinite(h_scan[1]["train_loss"])
+
+    def test_graph_shards_reject_unsupported_flags(self, setup):
+        from cgnn_tpu.parallel import fit_data_parallel
+        from cgnn_tpu.parallel.mesh import make_2d_mesh
+
+        graphs, batch, model, state, (node_cap, edge_cap) = setup
+        with pytest.raises(NotImplementedError, match="scan-epochs"):
+            fit_data_parallel(
+                state, graphs, graphs[:8], epochs=1, batch_size=2,
+                node_cap=node_cap, edge_cap=edge_cap,
+                mesh=make_2d_mesh(2, data_shards=2), scan_epochs=True,
+            )

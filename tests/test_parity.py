@@ -168,3 +168,95 @@ class TestOracleParity:
         ]
         for got, ref in pairs:
             np.testing.assert_allclose(got, ref, rtol=1e-7, atol=1e-9)
+
+
+class TestMaskedOracleParity:
+    """Under-coordinated structures (VERDICT r2 #4): the masked oracle's
+    dense [N, M] padding slots vs the framework's flat COO edges must be
+    numerically identical — forward, train-mode BN statistics included."""
+
+    def _build(self):
+        from cgnn_tpu.data.dataset import load_synthetic_mp
+
+        # radius 4.0: ~2/3 of atoms under-coordinated in the MP-like
+        # distribution (radius 6 saturates max_num_nbr, masking nothing)
+        cfg = FeaturizeConfig(radius=4.0, max_num_nbr=MAX_NBR)
+        graphs = load_synthetic_mp(6, cfg, seed=7)
+        counts = np.concatenate([
+            np.bincount(g.centers, minlength=g.num_nodes) for g in graphs
+        ])
+        assert counts.min() < MAX_NBR, "need under-coordination to test"
+
+        total_n = sum(g.num_nodes for g in graphs)
+        total_e = sum(g.num_edges for g in graphs)
+        batch = pack_graphs(graphs, total_n, total_e, len(graphs))
+
+        # dense [N, M] views with padding mask (shared helper, offset here)
+        from cgnn_tpu.data.graph import dense_neighbor_views
+
+        gdim = graphs[0].edge_fea.shape[1]
+        nbr = np.zeros((total_n, MAX_NBR, gdim))
+        idx = np.tile(np.arange(total_n)[:, None], (1, MAX_NBR))
+        mask = np.zeros((total_n, MAX_NBR))
+        crystal_atom_idx, off = [], 0
+        for g in graphs:
+            gn, gi, gm = dense_neighbor_views(g, MAX_NBR)
+            sl = slice(off, off + g.num_nodes)
+            nbr[sl], mask[sl] = gn, gm
+            # self-loop padding keeps each node's own (offset) index
+            idx[sl] = gi + off
+            crystal_atom_idx.append(torch.arange(off, off + g.num_nodes))
+            off += g.num_nodes
+
+        torch.manual_seed(1)
+        oracle = TorchCGCNN(
+            orig_atom_fea_len=batch.nodes.shape[1], nbr_fea_len=gdim,
+            atom_fea_len=ATOM_FEA_LEN, n_conv=N_CONV,
+            h_fea_len=H_FEA_LEN, n_h=N_H,
+        ).double()
+        model = CrystalGraphConvNet(
+            atom_fea_len=ATOM_FEA_LEN, n_conv=N_CONV, h_fea_len=H_FEA_LEN,
+            n_h=N_H, dtype=jnp.float64,
+        )
+        variables = variables_from_torch(
+            oracle, model.init(jax.random.key(0), batch))
+        t_inputs = (
+            torch.from_numpy(np.asarray(batch.nodes, np.float64)),
+            torch.from_numpy(nbr),
+            torch.from_numpy(idx.astype(np.int64)),
+            crystal_atom_idx,
+        )
+        return batch, oracle, model, variables, t_inputs, torch.from_numpy(mask)
+
+    def test_forward_train_masked(self):
+        batch, oracle, model, variables, t_inputs, mask = self._build()
+        oracle.train()
+        t_out = oracle(*t_inputs[:3], t_inputs[3], nbr_mask=mask)
+        j_out, mutated = model.apply(
+            variables, batch, train=True, mutable=["batch_stats"],
+        )
+        np.testing.assert_allclose(
+            np.asarray(j_out)[: t_out.shape[0]],
+            t_out.detach().numpy(), atol=1e-8,
+        )
+        # BN1 running stats updated from MASKED moments must agree
+        for i in range(N_CONV):
+            np.testing.assert_allclose(
+                np.asarray(mutated["batch_stats"][f"conv_{i}"]["bn1"]["mean"]),
+                oracle.convs[i].bn1.running_mean.detach().numpy(), atol=1e-8,
+            )
+            np.testing.assert_allclose(
+                np.asarray(mutated["batch_stats"][f"conv_{i}"]["bn1"]["var"]),
+                oracle.convs[i].bn1.running_var.detach().numpy(), atol=1e-8,
+            )
+
+    def test_forward_eval_masked(self):
+        batch, oracle, model, variables, t_inputs, mask = self._build()
+        oracle.eval()
+        with torch.no_grad():
+            t_out = oracle(*t_inputs[:3], t_inputs[3], nbr_mask=mask)
+        j_out = model.apply(variables, batch, train=False)
+        np.testing.assert_allclose(
+            np.asarray(j_out)[: t_out.shape[0]],
+            t_out.numpy(), atol=1e-8,
+        )

@@ -85,11 +85,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "jax.profiler (xprof/perfetto trace in the log dir)")
     p.add_argument("--debug-nans", action="store_true",
                    help="fail fast with a traceback at the first NaN")
+    p.add_argument("--check-invariants", action="store_true",
+                   help="validate every packed batch's GraphBatch "
+                        "invariants (sorted centers, mask/slot consistency, "
+                        "dense ownership, transpose completeness) host-side "
+                        "before it reaches the step; ~free vs device time, "
+                        "on by default in the test suite")
     p.add_argument("--node-cap", type=int, default=0, help="0 = auto")
     p.add_argument("--edge-cap", type=int, default=0, help="0 = auto")
     p.add_argument("--buckets", type=int, default=1,
                    help="size-class buckets for batching (>1 compiles one "
                         "step per bucket; better padding on mixed-size data)")
+    p.add_argument("--packing", choices=["snug", "ladder"], default="snug",
+                   help="'snug': fill-to-capacity packing with exact "
+                        "batch-count-balanced capacities (~0.99 padding "
+                        "efficiency); 'ladder': close batches at "
+                        "--batch-size graphs with geometric-ladder "
+                        "capacities (round-2 behavior)")
     p.add_argument("--pack-once", action="store_true",
                    help="pack training batches once and shuffle batch order "
                         "across epochs (large cached datasets: per-epoch "
@@ -165,6 +177,10 @@ def main(argv=None) -> int:
         from cgnn_tpu.train.observe import enable_debug_nans
 
         enable_debug_nans()
+    if args.check_invariants:
+        from cgnn_tpu.data import invariants
+
+        invariants.enable()
 
     devices = jax.devices()
     if args.device == "tpu" and devices[0].platform not in ("tpu", "axon"):
@@ -281,8 +297,9 @@ def main(argv=None) -> int:
         )
 
     layout_m = dense_m or None
+    snug = args.packing == "snug"
     node_cap, edge_cap = capacities_for(train_g, args.batch_size,
-                                        dense_m=layout_m)
+                                        dense_m=layout_m, snug=snug)
     node_cap = args.node_cap or node_cap
     if layout_m and args.edge_cap:
         print(f"warning: --edge-cap {args.edge_cap} ignored by the dense "
@@ -295,7 +312,7 @@ def main(argv=None) -> int:
     from cgnn_tpu.data.graph import batch_iterator, count_batches
 
     steps_per_epoch = max(1, count_batches(
-        train_g, args.batch_size, node_cap, edge_cap
+        train_g, args.batch_size, node_cap, edge_cap, snug=snug
     ))
     tx = make_optimizer(
         optim=args.optim.lower(), lr=args.lr, momentum=args.momentum,
@@ -306,7 +323,7 @@ def main(argv=None) -> int:
     # the iterator respects capacities (direct pack_graphs of an oversize
     # head batch would die with an opaque broadcast error)
     example = next(batch_iterator(train_g, args.batch_size, node_cap, edge_cap,
-                                  dense_m=layout_m))
+                                  dense_m=layout_m, snug=snug))
     state = create_train_state(model, example, tx, normalizer,
                                rng=jax.random.key(args.seed))
 
@@ -353,6 +370,12 @@ def main(argv=None) -> int:
 
         mesh = None
         fit_state = state
+        if graph_shards > 1 and (
+            args.buckets > 1 or args.scan_epochs or args.profile
+        ):
+            print("--buckets/--scan-epochs/--profile are not supported with "
+                  "--graph-shards (edge-sharded meshes)", file=sys.stderr)
+            return 2
         if graph_shards > 1:
             # edge-sharded model: same params, psum over 'graph' per conv;
             # the plain `state` keeps the single-device apply_fn for the
@@ -383,7 +406,9 @@ def main(argv=None) -> int:
             on_epoch_end=save_cb, start_epoch=start_epoch,
             on_epoch_metrics=log_epoch_metrics, mesh=mesh,
             pack_once=args.pack_once, device_resident=args.device_resident,
-            dense_m=layout_m,
+            dense_m=layout_m, buckets=args.buckets, snug=snug,
+            scan_epochs=args.scan_epochs, profile_steps=args.profile,
+            profile_dir=log_dir,
             **step_overrides,
         )
         state = fit_state.replace(apply_fn=state.apply_fn)
@@ -403,13 +428,13 @@ def main(argv=None) -> int:
             buckets=args.buckets, on_epoch_metrics=log_epoch_metrics,
             profile_steps=args.profile, profile_dir=log_dir,
             pack_once=args.pack_once, device_resident=args.device_resident,
-            dense_m=layout_m, scan_epochs=args.scan_epochs,
+            dense_m=layout_m, scan_epochs=args.scan_epochs, snug=snug,
             **step_overrides,
         )
 
     test_m = evaluate(state, test_g, args.batch_size, node_cap, edge_cap,
                       classification, eval_step_fn=eval_step_fn,
-                      dense_m=layout_m)
+                      dense_m=layout_m, snug=snug)
     print(f"** test {sel_key}: {test_m.get(sel_key, float('nan')):.4f} "
           f"(best val: {result['best']:.4f})")
     if force_task:
@@ -428,8 +453,10 @@ def main(argv=None) -> int:
         pstep = jax.jit(make_predict_step())
         scores, labels = [], []
         idx = 0
+        # in_cap=0: forward-only pass needs no transpose slots, and packing
+        # them would both cost host time and compile a new In shape
         for b in _biter(test_g, args.batch_size, node_cap, edge_cap,
-                        dense_m=layout_m):
+                        dense_m=layout_m, in_cap=0, snug=snug):
             out = np.asarray(jax.device_get(pstep(state, b)))
             n_real = int(np.asarray(b.graph_mask).sum())
             scores.append(out[:n_real])
