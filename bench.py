@@ -103,6 +103,7 @@ def _bench_workload(
             )
         )
     real_per_batch = [float(np.asarray(b.graph_mask).sum()) for b in batches]
+    atoms_per_batch = [float(np.asarray(b.node_mask).sum()) for b in batches]
     flops_per_batch = [
         _flops_per_batch(b, atom_dim, gauss_dim, f, h, n_conv, n_h)
         for b in batches
@@ -135,16 +136,17 @@ def _bench_workload(
     # All three round times are reported (rounds_s) so cross-round BENCH
     # comparisons can see the tunnel's run-to-run variance, not just the
     # best (VERDICT r2 weak #7).
-    best_rate, best_mfu = 0.0, 0.0
+    best_rate, best_mfu, best_atoms = 0.0, 0.0, 0.0
     rounds_s = []
     peak = _PEAK_FLOPS.get(jax.devices()[0].device_kind, _DEFAULT_PEAK)
     for _round in range(3):
-        structures = flops = 0.0
+        structures = flops = atoms = 0.0
         t0 = time.perf_counter()
         for i in range(n_timed):
             k = i % len(device_batches)
             state, metrics = train_step(state, device_batches[k])
             structures += real_per_batch[k]
+            atoms += atoms_per_batch[k]
             flops += flops_per_batch[k]
         float(metrics["loss_sum"])
         dt = time.perf_counter() - t0
@@ -152,8 +154,13 @@ def _bench_workload(
         if structures / dt > best_rate:
             best_rate = structures / dt
             best_mfu = flops / dt / peak
+            best_atoms = atoms / dt
     return {
         f"{label}structs_per_sec": round(best_rate, 1),
+        # atoms/s is the cross-distribution invariant: a 113-atom OC20
+        # slab is ~3.8x an MP structure's work, so structs/s alone makes
+        # the OC20 number look artificially low vs the 10k MP north star
+        f"{label}atoms_per_sec": round(best_atoms, 1),
         f"{label}mfu": round(best_mfu, 4),
         f"{label}node_eff": round(stats.node_efficiency, 3),
         f"{label}edge_eff": round(stats.edge_efficiency, 3),
@@ -203,6 +210,7 @@ def main() -> None:
                 "value": value,
                 "unit": "structures/sec/chip",
                 "vs_baseline": round(value / 10_000.0, 4),
+                "atoms_per_sec": mp["atoms_per_sec"],
                 "mfu": mp["mfu"],
                 "padding_eff_nodes": mp["node_eff"],
                 "padding_eff_edges": mp["edge_eff"],

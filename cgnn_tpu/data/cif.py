@@ -323,7 +323,10 @@ def parse_cif(text: str, occupancy_tol: float = 0.999) -> Structure:
                 f"no Hermann-Mauguin engine — re-export the file with "
                 f"explicit operators or symmetry-expanded (P1) sites"
             )
-        if not hm_declared and it_number and it_number not in ("1", ".", "?"):
+        # checked regardless of a (possibly mislabeled) 'P 1' H-M value: a
+        # declared non-1 IT number with no operators means the sites are an
+        # asymmetric unit either way
+        if it_number and it_number not in ("1", ".", "?"):
             raise CIFError(
                 f"space group IT number {it_number} declared without an "
                 f"explicit symmetry-operator loop; cannot expand (no "

@@ -109,11 +109,10 @@ def check_batch(batch, dense_m: int | None = None):
             _fail(f"dense slot ownership broken: centers != slot//{dense_m}")
 
     if batch.in_slots is not None:
-        in_slots = np.asarray(batch.in_slots)
         in_mask = np.asarray(batch.in_mask)
-        chex.assert_shape(in_mask, in_slots.shape)
-        if in_slots.shape[0] != ncap:
-            _fail("in_slots row count != node capacity")
+        in_slots = np.asarray(batch.in_slots).reshape(in_mask.shape)
+        if in_mask.shape[0] != ncap:
+            _fail("in_slots/in_mask row count != node capacity")
         listed = in_slots[in_mask > 0]
         rows = np.repeat(np.arange(ncap), (in_mask > 0).sum(axis=1))
         if batch.over_slots is not None:

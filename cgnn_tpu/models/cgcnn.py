@@ -155,6 +155,12 @@ class CGConv(nn.Module):
                 )
             gate, core = jnp.split(z, 2, axis=-1)
             msg = nn.sigmoid(gate) * nn.softplus(core)
+            # LOAD-BEARING for gradients, not just values: gather_transpose's
+            # scatter-free VJP assumes zero cotangent on padding edge slots,
+            # which THIS mask (together with masked BN statistics) guarantees.
+            # Removing it would silently corrupt node gradients
+            # (ops/segment.py gather_transpose docstring; parity test:
+            # tests/test_batching.py two-tier backward).
             msg = msg * edge_mask.reshape(n, m, 1).astype(msg.dtype)
             agg = msg.sum(axis=1)
         else:

@@ -42,7 +42,7 @@ def gather(values: jax.Array, indices: jax.Array) -> jax.Array:
 def gather_transpose(
     nodes: jax.Array,  # [N, F]
     neighbors: jax.Array,  # [E] i32
-    in_slots: jax.Array,  # [N, In] i32 — edge slots e with neighbors[e] == j
+    in_slots: jax.Array,  # [N*In] i32 FLAT — edge slots grouped by neighbor
     in_mask: jax.Array,  # [N, In] — 1 where the slot entry is a real edge
     over_slots: jax.Array | None = None,  # [O] i32 overflow edge slots
     over_nodes: jax.Array | None = None,  # [O] i32 (non-decreasing)
@@ -81,8 +81,11 @@ def gather_transpose(
         return g(n), None
 
     def g_bwd(_, ct):  # ct: [E, F]
-        contrib = jnp.take(ct, in_slots.reshape(-1), axis=0).reshape(
-            *in_slots.shape, ct.shape[-1]
+        # in_slots arrives pre-flattened (pack_graphs): a device-side
+        # [N, In] -> [N*In] flatten is a tiled->linear relayout that
+        # measured 0.75 ms/step under the epoch scan
+        contrib = jnp.take(ct, in_slots, axis=0).reshape(
+            *in_mask.shape, ct.shape[-1]
         )
         # accumulate in the cotangent dtype: matches the scatter-add's
         # accumulation precision, and an f32 upcast doubles the [N, In, F]

@@ -91,10 +91,12 @@ def measure_scan_dispatch(state, raw_step, device_batches, real_per_batch,
     import jax.numpy as jnp
     import numpy as np
 
+    from cgnn_tpu.data.graph import batch_shape_key
+
     # group identically-shaped batches and stack on a leading axis
     groups, reals = {}, {}
     for b, r in zip(device_batches, real_per_batch):
-        key = (b.node_capacity, b.edge_capacity)
+        key = batch_shape_key(b)
         groups.setdefault(key, []).append(b)
         reals.setdefault(key, []).append(r)
     stacked = {
@@ -198,7 +200,7 @@ def analytic_roofline(batches, f=64, h=128, n_conv=3, n_h=1):
     e_real = float(np.mean([np.asarray(b.edge_mask).sum() for b in batches]))
     g = float(np.mean([np.asarray(b.graph_mask).sum() for b in batches]))
     in_cap = float(np.mean(
-        [b.in_slots.shape[1] for b in batches if b.in_slots is not None]
+        [b.in_mask.shape[1] for b in batches if b.in_mask is not None]
     )) if batches[0].in_slots is not None else 0.0
     gauss = batches[0].edges.shape[1]
     bf2 = 2.0  # bf16 bytes
@@ -271,7 +273,9 @@ def main():
     seen = set()
     metrics = None
     for b in device_batches:
-        key = (b.node_capacity, b.edge_capacity)
+        from cgnn_tpu.data.graph import batch_shape_key
+
+        key = batch_shape_key(b)
         if key not in seen:
             seen.add(key)
             state, metrics = step(state, b)

@@ -108,9 +108,9 @@ def main(argv=None) -> int:
     tx = make_optimizer(optim="adam", lr=0.01, lr_milestones=[10**9])
     normalizer = Normalizer.fit(np.stack([g.target for g in train_g]))
     node_cap, edge_cap = capacities_for(train_g, args.batch_size,
-                                        dense_m=layout_m)
+                                        dense_m=layout_m, snug=True)
     example = pack_graphs(
-        sorted(train_g[: args.batch_size], key=lambda g: g.num_nodes),
+        sorted(train_g[: args.batch_size // 2], key=lambda g: g.num_nodes),
         node_cap, edge_cap, args.batch_size, dense_m=layout_m,
     )
     state = create_train_state(model, example, tx, normalizer,
@@ -129,7 +129,7 @@ def main(argv=None) -> int:
         batch_size=args.batch_size, node_cap=node_cap, edge_cap=edge_cap,
         buckets=args.buckets, seed=args.seed, print_freq=0,
         pack_once=args.pack_once, device_resident=args.device_resident,
-        scan_epochs=args.scan_epochs,
+        scan_epochs=args.scan_epochs, snug=True,
         dense_m=layout_m, on_epoch_metrics=on_epoch_metrics,
         log_fn=lambda msg: print(msg, file=sys.stderr),
     )

@@ -107,10 +107,11 @@ def test_transpose_slots_invariants():
     nc, ec = capacities_for(graphs, 8, dense_m=m)
     for b in batch_iterator(graphs, 8, nc, ec, dense_m=m):
         assert b.in_slots is not None and b.in_mask is not None
-        assert b.in_slots.shape == b.in_mask.shape
-        assert b.in_slots.shape[0] == nc and b.in_slots.shape[1] == m
+        assert b.in_slots.shape == (nc * m,)  # stored flat (pack_graphs)
+        assert b.in_mask.shape == (nc, m)
         real = np.nonzero(np.asarray(b.edge_mask) > 0)[0]
-        listed = np.asarray(b.in_slots)[np.asarray(b.in_mask) > 0]
+        listed = np.asarray(b.in_slots).reshape(nc, m)[
+            np.asarray(b.in_mask) > 0]
         rows, _ = np.nonzero(np.asarray(b.in_mask) > 0)
         over = np.asarray(b.over_mask) > 0
         listed = np.concatenate([listed, np.asarray(b.over_slots)[over]])
@@ -302,7 +303,7 @@ def test_per_bucket_in_cap_tracks_bucket_skew():
     batches = list(bucketed_batch_iterator(
         graphs, 8, 2, dense_m=8, snug=True, per_bucket_in_cap=True,
     ))
-    caps = {b.in_slots.shape[1] for b in batches}
+    caps = {b.in_mask.shape[1] for b in batches}
     assert len(caps) == 2, caps
     assert max(caps) == global_cap  # hub bucket pays its own skew
     assert min(caps) < global_cap  # ...and the other bucket does not

@@ -124,3 +124,17 @@ def test_placeholder_hm_does_not_bypass_it_number_refusal():
             "data_spinel_unit",
             "data_x\n_symmetry_space_group_name_H-M ?",
         ))
+
+
+def test_p1_hm_does_not_bypass_it_number_refusal():
+    """A (mislabeled) 'P 1' H-M value must not suppress the IT-number
+    check: IT 227 with no operators means asymmetric-unit sites either
+    way."""
+    from cgnn_tpu.data.cif import parse_cif
+
+    text = open(fx("it_number_only.cif")).read()
+    with pytest.raises(CIFError, match="IT number 227"):
+        parse_cif(text.replace(
+            "data_spinel_unit",
+            "data_x\n_symmetry_space_group_name_H-M 'P 1'",
+        ))

@@ -113,8 +113,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scan-epochs", action="store_true",
                    help="fold each epoch into one lax.scan dispatch per "
                         "bucket shape (implies --device-resident; maximal "
-                        "throughput on high-latency links — see the fit() "
-                        "docstring for the multi-bucket ordering caveat)")
+                        "throughput on high-latency links). DEFAULT when "
+                        "--device-resident is set: randomized chunk "
+                        "scheduling (r3) brought multi-bucket convergence "
+                        "within seed noise of the per-step loop "
+                        "(scripts/scan_convergence.py)")
+    p.add_argument("--no-scan-epochs", action="store_true",
+                   help="keep the per-step loop under --device-resident")
     # force task (BASELINE config #5)
     p.add_argument("--energy-weight", type=float, default=1.0,
                    help="w_e in L = w_e*MSE(E) + w_f*MSE(F)")
@@ -188,6 +193,20 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     print(f"devices: {devices}")
+
+    if (args.device_resident and not args.no_scan_epochs
+            and args.graph_shards <= 1 and not args.profile):
+        # scan dispatch is the device-resident default since r3 (see
+        # --scan-epochs help); --no-scan-epochs restores the per-step
+        # loop. Not auto-applied when the run needs features scan cannot
+        # provide (edge-sharded meshes, per-step profiling) — those keep
+        # the per-step loop rather than erroring on a flag the user
+        # never passed.
+        args.scan_epochs = True
+    if args.scan_epochs and args.no_scan_epochs:
+        print("--scan-epochs and --no-scan-epochs are contradictory",
+              file=sys.stderr)
+        return 2
 
     data_cfg = DataConfig(
         radius=args.radius, max_num_nbr=args.max_num_nbr,
