@@ -83,11 +83,13 @@ def _bench_workload(
     f, h, n_conv, n_h = 64, 128, 3, 1
 
     stats = PaddingStats()
+    edge_dtype = jax.numpy.bfloat16  # model computes bf16; store bf16
     if buckets > 1:
         batches = list(
             bucketed_batch_iterator(
                 graphs, batch_size, buckets, stats=stats,
                 rng=np.random.default_rng(0), dense_m=dense_m, snug=snug,
+                edge_dtype=edge_dtype,
             )
         )
     else:
@@ -98,7 +100,7 @@ def _bench_workload(
             stats.wrap(
                 batch_iterator(
                     graphs, batch_size, node_cap, edge_cap, dense_m=dense_m,
-                    snug=snug,
+                    snug=snug, edge_dtype=edge_dtype,
                 )
             )
         )

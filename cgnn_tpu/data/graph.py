@@ -234,6 +234,7 @@ def pack_graphs(
     dense_m: int | None = None,
     in_cap: int | None = None,
     over_cap: int | None = None,
+    edge_dtype=np.float32,
 ) -> GraphBatch:
     """Concatenate graphs into one fixed-capacity GraphBatch (numpy).
 
@@ -282,7 +283,10 @@ def pack_graphs(
     tdim = num_targets or int(np.atleast_1d(graphs[0].target).shape[0])
 
     nodes = np.zeros((node_cap, node_dim), np.float32)
-    edges = np.zeros((edge_cap, edge_dim), np.float32)
+    # edge features are the largest staged tensor (G floats/edge); bf16
+    # storage (train.py --bf16, bench) halves their HBM footprint and
+    # per-step read bytes — the model casts to its compute dtype anyway
+    edges = np.zeros((edge_cap, edge_dim), edge_dtype)
     if dense_m is None:
         # padding edges point at the last node slot: keeps `centers` sorted
         # (see module docstring) and their masked zero messages harmless
@@ -592,6 +596,7 @@ def bucketed_batch_iterator(
     in_cap: int | None = None,
     snug: bool = False,
     per_bucket_in_cap: bool = False,
+    edge_dtype=np.float32,
 ):
     """Yield batches using per-size-class static capacities.
 
@@ -647,7 +652,7 @@ def bucketed_batch_iterator(
             b_in_cap = in_degree_cap(sub)
         it = batch_iterator(sub, batch_size, nc, ec, shuffle=shuffle, rng=rng,
                             dense_m=dense_m, in_cap=b_in_cap, snug=snug,
-                            over_cap=over_cap)
+                            over_cap=over_cap, edge_dtype=edge_dtype)
         iters.append(stats.wrap(it) if stats is not None else it)
         weights.append(float(len(idxs)))
     active = list(range(len(iters)))
@@ -706,6 +711,7 @@ def batch_iterator(
     in_cap: int | None = None,
     snug: bool = False,
     over_cap: int | None = None,
+    edge_dtype=np.float32,
 ):
     """Yield fixed-shape GraphBatches of ``batch_size`` graphs each.
 
@@ -756,7 +762,7 @@ def batch_iterator(
             yield invariants.maybe_check(
                 pack_graphs(bucket, node_cap, edge_cap, graph_cap,
                             dense_m=dense_m, in_cap=in_cap,
-                            over_cap=over_cap),
+                            over_cap=over_cap, edge_dtype=edge_dtype),
                 dense_m,
             )
             bucket, nn, ne = [], 0, 0
@@ -771,6 +777,7 @@ def batch_iterator(
     if bucket and (not drop_last or len(bucket) >= batch_size):
         yield invariants.maybe_check(
             pack_graphs(bucket, node_cap, edge_cap, graph_cap,
-                        dense_m=dense_m, in_cap=in_cap, over_cap=over_cap),
+                        dense_m=dense_m, in_cap=in_cap, over_cap=over_cap,
+                        edge_dtype=edge_dtype),
             dense_m,
         )

@@ -42,9 +42,10 @@ class MaskedBatchNorm(nn.Module):
     # float64 activations keep float64 running stats (oracle parity)
     dtype: jnp.dtype | None = None
     # when the row axis is sharded across a mesh axis (edge-sharded graph
-    # parallelism), moments must be computed over ALL shards: two psum
-    # passes (count+mean, then centered variance) keep the numerics of the
-    # single-device centered formula
+    # parallelism), moments must be computed over ALL shards: f32-stat
+    # mode psums (count, sum, sum-of-squares) once; f64-stat mode (the
+    # oracle-parity path) psums count+mean first and the centered
+    # variance second, keeping the single-device centered numerics
     axis_name: str | None = None
 
     @nn.compact
@@ -66,6 +67,13 @@ class MaskedBatchNorm(nn.Module):
         )
 
         reduce_axes = tuple(range(x.ndim - 1))
+        # One-pass moments (E[x^2] - E[x]^2) in float32-stat mode: both
+        # sums reduce over a single read of x, where the centered two-pass
+        # form costs an extra full pass over the (large) activation per BN
+        # per direction. The two-pass form is kept for float64 stats —
+        # the double-precision oracle parity harness pins 1e-8 agreement
+        # with torch, and one-pass cancellation error would show there.
+        one_pass = stat_dtype == jnp.float32
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
@@ -73,26 +81,36 @@ class MaskedBatchNorm(nn.Module):
             if mask is not None:
                 m = mask.astype(stat_dtype)
                 n_real = m.sum()
-                s1 = (xf * m[..., None]).sum(axis=reduce_axes)
+                xm = xf * m[..., None]
+                s1 = xm.sum(axis=reduce_axes)
+                s2 = (xm * xf).sum(axis=reduce_axes) if one_pass else None
             else:
                 m = None
                 n_real = jnp.asarray(
                     np.prod([x.shape[a] for a in reduce_axes]), stat_dtype
                 )
                 s1 = xf.sum(axis=reduce_axes)
+                s2 = (xf * xf).sum(axis=reduce_axes) if one_pass else None
             if self.axis_name is not None:
-                n_real, s1 = jax.lax.psum((n_real, s1), self.axis_name)
+                if one_pass:
+                    n_real, s1, s2 = jax.lax.psum(
+                        (n_real, s1, s2), self.axis_name)
+                else:
+                    n_real, s1 = jax.lax.psum((n_real, s1), self.axis_name)
             n = jnp.maximum(n_real, 1.0)
             mean = s1 / n
-            centered = (xf - mean) ** 2
-            ss = (
-                (centered * m[..., None]).sum(axis=reduce_axes)
-                if m is not None
-                else centered.sum(axis=reduce_axes)
-            )
-            if self.axis_name is not None:
-                ss = jax.lax.psum(ss, self.axis_name)
-            var = ss / n
+            if one_pass:
+                var = jnp.maximum(s2 / n - mean * mean, 0.0)
+            else:
+                centered = (xf - mean) ** 2
+                ss = (
+                    (centered * m[..., None]).sum(axis=reduce_axes)
+                    if m is not None
+                    else centered.sum(axis=reduce_axes)
+                )
+                if self.axis_name is not None:
+                    ss = jax.lax.psum(ss, self.axis_name)
+                var = ss / n
             if not self.is_initializing():
                 # a fully-masked batch (all padding, e.g. an empty DP eval
                 # shard) must not decay the running stats toward (0, 0)

@@ -86,6 +86,7 @@ def parallel_batches(
     buckets: int = 1,
     snug: bool = False,
     stats: PaddingStats | None = None,
+    edge_dtype=np.float32,
 ) -> Iterable[GraphBatch]:
     """Yield device-stacked batches: leaves have leading axis [D, ...].
 
@@ -104,11 +105,13 @@ def parallel_batches(
         source = bucketed_batch_iterator(
             graphs, batch_size, buckets, shuffle=shuffle, rng=rng,
             dense_m=dense_m, in_cap=in_cap, snug=snug, stats=stats,
+            edge_dtype=edge_dtype,
         )
     else:
         source = batch_iterator(
             graphs, batch_size, node_cap, edge_cap, shuffle=shuffle, rng=rng,
             dense_m=dense_m, in_cap=in_cap, snug=snug,
+            edge_dtype=edge_dtype,
         )
         if stats is not None:
             source = stats.wrap(source)
@@ -263,6 +266,7 @@ def fit_data_parallel(
     scan_epochs: bool = False,
     profile_steps: int = 0,
     profile_dir: str = "",
+    edge_dtype=np.float32,
 ) -> tuple[TrainState, dict]:
     """DP twin of train.loop.fit; ``batch_size`` is per device.
 
@@ -350,14 +354,14 @@ def fit_data_parallel(
         return parallel_batches(
             train_graphs, n_dev, batch_size, node_cap, edge_cap,
             shuffle=True, rng=rng, dense_m=dense_m, buckets=buckets,
-            snug=snug, stats=pad_stats,
+            snug=snug, stats=pad_stats, edge_dtype=edge_dtype,
         )
 
     def make_val_it():
         return parallel_batches(
             val_graphs, n_dev, batch_size, node_cap, edge_cap,
             pad_incomplete=True, dense_m=dense_m, in_cap=0, buckets=buckets,
-            snug=snug,
+            snug=snug, edge_dtype=edge_dtype,
         )
 
     driver: ScanEpochDriver | None = None

@@ -398,6 +398,7 @@ def fit(
     dense_m: int | None = None,
     scan_epochs: bool = False,
     snug: bool = False,
+    edge_dtype=np.float32,
 ) -> tuple[TrainState, dict]:
     """Reference ``main()`` loop: train/validate per epoch, track best.
 
@@ -450,11 +451,13 @@ def fit(
             return bucketed_batch_iterator(
                 train_graphs, batch_size, buckets, shuffle=True, rng=rng,
                 stats=pad_stats, dense_m=dense_m, snug=snug,
+                edge_dtype=edge_dtype,
             )
         return pad_stats.wrap(
             batch_iterator(
                 train_graphs, batch_size, node_cap, edge_cap,
                 shuffle=True, rng=rng, dense_m=dense_m, snug=snug,
+                edge_dtype=edge_dtype,
             )
         )
 
@@ -463,11 +466,11 @@ def fit(
         if buckets > 1:
             return bucketed_batch_iterator(
                 val_graphs, batch_size, buckets, dense_m=dense_m, in_cap=0,
-                snug=snug,
+                snug=snug, edge_dtype=edge_dtype,
             )
         return batch_iterator(
             val_graphs, batch_size, node_cap, edge_cap, dense_m=dense_m,
-            in_cap=0, snug=snug,
+            in_cap=0, snug=snug, edge_dtype=edge_dtype,
         )
 
     train_step = jax.jit(
@@ -574,6 +577,7 @@ def evaluate(
     eval_step_fn: Callable | None = None,
     dense_m: int | None = None,
     snug: bool = False,
+    edge_dtype=np.float32,
 ) -> dict:
     if dense_m is not None:
         edge_cap = node_cap * dense_m
@@ -582,7 +586,8 @@ def evaluate(
         eval_step,
         state,
         batch_iterator(graphs, batch_size, node_cap, edge_cap,
-                       dense_m=dense_m, in_cap=0, snug=snug),
+                       dense_m=dense_m, in_cap=0, snug=snug,
+                       edge_dtype=edge_dtype),
         train=False,
     )
     return metrics

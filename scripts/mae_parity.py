@@ -131,7 +131,8 @@ def torch_train_eval(graphs, split, *, epochs, batch_size, lr, seed,
         return run(test_g), best_val
 
 
-def jax_train_eval(split, *, epochs, batch_size, lr, seed):
+def jax_train_eval(split, *, epochs, batch_size, lr, seed,
+                   matched_init=False):
     import numpy as np
 
     import jax
@@ -150,6 +151,35 @@ def jax_train_eval(split, *, epochs, batch_size, lr, seed):
     state = create_train_state(
         model, example, tx, normalizer, rng=jax.random.key(seed)
     )
+    if matched_init:
+        # draw the init from the SAME distribution the lineage trains
+        # from (torch Linear defaults: kaiming_uniform(a=sqrt(5)) +
+        # fan-in uniform bias) by transplanting a fresh UNTRAINED oracle
+        # — an independent draw (different torch seed than the oracle
+        # run), isolating framework-vs-framework optimization from the
+        # flax-lecun_normal vs torch-kaiming init lottery
+        import torch
+
+        from tests.oracle.torch_cgcnn import TorchCGCNN, variables_from_torch
+
+        torch.manual_seed(seed + 7919)
+        fresh = TorchCGCNN(
+            orig_atom_fea_len=train_g[0].atom_fea.shape[1],
+            nbr_fea_len=train_g[0].edge_fea.shape[1],
+            atom_fea_len=64, n_conv=3, h_fea_len=128, n_h=1,
+        )
+        variables = variables_from_torch(
+            fresh, {"params": state.params, "batch_stats": state.batch_stats}
+        )
+        state = state.replace(
+            params=jax.tree_util.tree_map(
+                lambda x: np.asarray(x, np.float32), variables["params"]
+            ),
+            batch_stats=jax.tree_util.tree_map(
+                lambda x: np.asarray(x, np.float32),
+                variables["batch_stats"],
+            ),
+        )
     best = {"params": state.params, "batch_stats": state.batch_stats,
             "val": float("inf")}
 
@@ -184,6 +214,11 @@ def main(argv=None) -> int:
     p.add_argument("--device", choices=["auto", "cpu"], default="auto")
     p.add_argument("--tolerance", type=float, default=0.05,
                    help="max allowed (jax_mae / torch_mae - 1)")
+    p.add_argument("--matched-init", action="store_true",
+                   help="initialize the JAX model from a fresh UNTRAINED "
+                        "torch oracle (independent draw) so both "
+                        "frameworks start from the lineage's init "
+                        "distribution")
     p.add_argument("--dataset", choices=["tiny", "mp"], default="tiny",
                    help="'mp': the realistic MP-like lognormal ~30-atom "
                         "distribution (radius 6), UNDER-COORDINATED "
@@ -239,7 +274,7 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         jax_mae, jax_val = jax_train_eval(
             split, epochs=args.epochs, batch_size=args.batch_size,
-            lr=args.lr, seed=seed,
+            lr=args.lr, seed=seed, matched_init=args.matched_init,
         )
         t_jax += time.perf_counter() - t0
         runs.append({"seed": seed,
@@ -254,6 +289,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "metric": "formation_energy_mae_parity",
         "dataset": args.dataset,
+        "matched_init": bool(args.matched_init),
         "torch_oracle_test_mae": round(mean_torch, 5),
         "jax_test_mae": round(mean_jax, 5),
         "ratio": round(ratio, 4),

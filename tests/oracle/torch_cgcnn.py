@@ -111,3 +111,48 @@ class TorchCGCNN(nn.Module):
         for fc in self.fcs:
             crys_fea = nn.functional.softplus(fc(crys_fea))
         return self.fc_out(crys_fea)
+
+
+def variables_from_torch(oracle: "TorchCGCNN", template):
+    """Transplant oracle weights into the flax variable tree.
+
+    jnp.array (copy), never jnp.asarray: on CPU, asarray of tensor.numpy()
+    is zero-copy, so torch's in-place running-stat updates during the
+    oracle forward would silently mutate the transplanted JAX arrays too.
+
+    Shared by the parity tests AND the MAE harness (which uses it with an
+    UNTRAINED oracle so both frameworks start from the same torch-default
+    init distribution — flax lecun_normal vs torch kaiming_uniform is an
+    init-lottery confound, not a framework difference).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def w(linear):  # torch [out, in] -> flax kernel [in, out]
+        return jnp.array(linear.weight.detach().numpy().T)
+
+    def b(linear):
+        return jnp.array(linear.bias.detach().numpy())
+
+    params = jax.tree_util.tree_map(lambda x: x, template["params"])
+    stats = jax.tree_util.tree_map(lambda x: x, template["batch_stats"])
+    params["embedding"] = {"kernel": w(oracle.embedding),
+                           "bias": b(oracle.embedding)}
+    for i, conv in enumerate(oracle.convs):
+        params[f"conv_{i}"]["fc_full"] = {"kernel": w(conv.fc_full),
+                                          "bias": b(conv.fc_full)}
+        for bn_name, bn in (("bn1", conv.bn1), ("bn2", conv.bn2)):
+            params[f"conv_{i}"][bn_name] = {
+                "scale": jnp.array(bn.weight.detach().numpy()),
+                "bias": jnp.array(bn.bias.detach().numpy()),
+            }
+            stats[f"conv_{i}"][bn_name] = {
+                "mean": jnp.array(bn.running_mean.detach().numpy()),
+                "var": jnp.array(bn.running_var.detach().numpy()),
+            }
+    params["conv_to_fc"] = {"kernel": w(oracle.conv_to_fc),
+                            "bias": b(oracle.conv_to_fc)}
+    for i, fc in enumerate(oracle.fcs):
+        params[f"fc_{i}"] = {"kernel": w(fc), "bias": b(fc)}
+    params["fc_out"] = {"kernel": w(oracle.fc_out), "bias": b(oracle.fc_out)}
+    return {"params": params, "batch_stats": stats}

@@ -182,3 +182,39 @@ class TestMaskedBatchNorm:
             s_pad["batch_stats"]["var"], s_real["batch_stats"]["var"],
             rtol=1e-5, atol=1e-6,
         )
+
+
+def test_one_pass_bn_matches_two_pass_reference():
+    """The f32 one-pass (E[x^2]-E[x]^2) masked moments must match a numpy
+    two-pass centered reference at f32-roundoff tolerance — the f64 parity
+    suite deliberately routes to the two-pass branch and would not catch a
+    one-pass regression (dropped mask in s2, broken psum tuple)."""
+    import jax
+
+    from cgnn_tpu.ops.norm import MaskedBatchNorm
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(2.0, 3.0, size=(257, 6)).astype(np.float32)
+    mask = (rng.random(257) > 0.3).astype(np.float32)
+
+    bn = MaskedBatchNorm()
+    variables = bn.init(jax.random.key(0), x, mask=mask)
+    y, mutated = bn.apply(
+        variables, x, mask=mask, use_running_average=False,
+        mutable=["batch_stats"],
+    )
+
+    rows = x[mask > 0]
+    mean = rows.mean(axis=0)
+    var = rows.var(axis=0)  # biased, two-pass centered
+    ref = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-4)
+    # running stats: unbiased variance update at momentum 0.1
+    n = rows.shape[0]
+    np.testing.assert_allclose(
+        np.asarray(mutated["batch_stats"]["mean"]), 0.1 * mean, rtol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(mutated["batch_stats"]["var"]),
+        0.9 * 1.0 + 0.1 * var * n / (n - 1), rtol=2e-4,
+    )

@@ -17,7 +17,7 @@ import torch
 from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
 from cgnn_tpu.data.graph import pack_graphs
 from cgnn_tpu.models import CrystalGraphConvNet
-from tests.oracle.torch_cgcnn import TorchCGCNN
+from tests.oracle.torch_cgcnn import TorchCGCNN, variables_from_torch
 
 ATOM_FEA_LEN = 24
 N_CONV = 2
@@ -74,41 +74,6 @@ def setup():
         crystal_atom_idx,
     )
     return graphs, batch, oracle, model, variables, t_inputs
-
-
-def variables_from_torch(oracle: TorchCGCNN, template):
-    """Transplant oracle weights into the flax variable tree.
-
-    jnp.array (copy), never jnp.asarray: on CPU, asarray of tensor.numpy()
-    is zero-copy, so torch's in-place running-stat updates during the oracle
-    forward would silently mutate the transplanted JAX arrays too.
-    """
-
-    def w(linear):  # torch [out, in] -> flax kernel [in, out]
-        return jnp.array(linear.weight.detach().numpy().T)
-
-    def b(linear):
-        return jnp.array(linear.bias.detach().numpy())
-
-    params = jax.tree_util.tree_map(lambda x: x, template["params"])
-    stats = jax.tree_util.tree_map(lambda x: x, template["batch_stats"])
-    params["embedding"] = {"kernel": w(oracle.embedding), "bias": b(oracle.embedding)}
-    for i, conv in enumerate(oracle.convs):
-        params[f"conv_{i}"]["fc_full"] = {"kernel": w(conv.fc_full), "bias": b(conv.fc_full)}
-        for bn_name, bn in (("bn1", conv.bn1), ("bn2", conv.bn2)):
-            params[f"conv_{i}"][bn_name] = {
-                "scale": jnp.array(bn.weight.detach().numpy()),
-                "bias": jnp.array(bn.bias.detach().numpy()),
-            }
-            stats[f"conv_{i}"][bn_name] = {
-                "mean": jnp.array(bn.running_mean.detach().numpy()),
-                "var": jnp.array(bn.running_var.detach().numpy()),
-            }
-    params["conv_to_fc"] = {"kernel": w(oracle.conv_to_fc), "bias": b(oracle.conv_to_fc)}
-    for i, fc in enumerate(oracle.fcs):
-        params[f"fc_{i}"] = {"kernel": w(fc), "bias": b(fc)}
-    params["fc_out"] = {"kernel": w(oracle.fc_out), "bias": b(oracle.fc_out)}
-    return {"params": params, "batch_stats": stats}
 
 
 class TestOracleParity:
