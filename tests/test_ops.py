@@ -218,3 +218,30 @@ def test_one_pass_bn_matches_two_pass_reference():
         np.asarray(mutated["batch_stats"]["var"]),
         0.9 * 1.0 + 0.1 * var * n / (n - 1), rtol=2e-4,
     )
+
+
+def test_windowed_gather_kernel_matches_take():
+    """Pallas windowed one-hot gather (interpret mode on CPU): bit-exact
+    vs jnp.take, including out-of-window padding self-loops -> zeros.
+    (The kernel is a measured negative result for perf — see its module
+    docstring — but stays correct and tested as a scaffold.)"""
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    from cgnn_tpu.ops import pallas_gather
+
+    nc, w = 256, 256
+    rng = np.random.default_rng(0)
+    nodes = jnp.asarray(rng.normal(size=(nc, 8)).astype(np.float32))
+    # neighbors within a window starting at 0 for block 0, 128 for block 1
+    nbr = jnp.asarray(
+        np.concatenate([
+            rng.integers(0, 128, size=128 * 4),
+            rng.integers(128, 256, size=128 * 4),
+        ]).astype(np.int32)
+    )
+    ws = jnp.asarray(np.array([0, 128], np.int32))
+    with pltpu.force_tpu_interpret_mode():
+        got = pallas_gather.windowed_gather(nodes, nbr, ws, w)
+    ref = jnp.take(nodes, nbr, axis=0).reshape(nc, 4, 8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
