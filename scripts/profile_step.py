@@ -29,7 +29,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_workload(dense_m=12):
-    """The bench.py PRIMARY workload: MP-like distribution, dense layout."""
+    """The bench.py PRIMARY workload: MP-like distribution, dense layout,
+    snug packing, bf16 edge storage (kept in lockstep with bench.py)."""
+    import jax
     import numpy as np
 
     from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic_mp
@@ -41,7 +43,8 @@ def build_workload(dense_m=12):
     batches = list(
         bucketed_batch_iterator(
             graphs, 512, 3, stats=stats,
-            rng=np.random.default_rng(0), dense_m=dense_m,
+            rng=np.random.default_rng(0), dense_m=dense_m, snug=True,
+            edge_dtype=jax.numpy.bfloat16,
         )
     )
     return graphs, batches, stats
