@@ -56,7 +56,7 @@ def check_batch(batch, dense_m: int | None = None):
       gather_transpose's scatter-free backward silently relies on.
     """
     nodes = np.asarray(batch.nodes)
-    edges = np.asarray(batch.edges)
+    edges = np.asarray(batch.flat_edges)
     centers = np.asarray(batch.centers)
     neighbors = np.asarray(batch.neighbors)
     node_graph = np.asarray(batch.node_graph)

@@ -141,7 +141,10 @@ class CGConv(nn.Module):
                 ).reshape(n, m, fdim)
             else:
                 v_j = gather(nodes, neighbors).reshape(n, m, fdim)
-            e = edges.astype(nodes.dtype).reshape(n, m, -1)
+            # dense batches carry edges pre-shaped [N, M, G] (pack_graphs)
+            e = edges.astype(nodes.dtype)
+            if e.ndim == 2:  # direct pack_graphs callers with flat edges
+                e = e.reshape(n, m, -1)
             # sliced matmuls: no [N, M, 2F+G] concat, v_i term per-node
             z = _SplitFcFull(2 * f, dtype=self.dtype, name="fc_full")(
                 nodes, v_j, e

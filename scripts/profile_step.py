@@ -205,7 +205,8 @@ def analytic_roofline(batches, f=64, h=128, n_conv=3, n_h=1):
     in_cap = float(np.mean(
         [b.in_mask.shape[1] for b in batches if b.in_mask is not None]
     )) if batches[0].in_slots is not None else 0.0
-    gauss = batches[0].edges.shape[1]
+    # [-1]: dense batches store edges [N, M, G]; [E, G] for COO
+    gauss = batches[0].edges.shape[-1]
     bf2 = 2.0  # bf16 bytes
 
     # Forward per conv layer, slot counts (padding moves too):
