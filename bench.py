@@ -182,8 +182,8 @@ def main() -> None:
     cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
 
     # PRIMARY: MP-like size distribution (~30-atom lognormal), dense
-    # layout, bucketed. Batch/bucket picked by honest-fenced sweep
-    # (512/3b 22.6k, 1024/2b 21.9k, 2048/1b 16.9k structs/s — per-slot
+    # layout, bucketed. Batch/bucket re-swept under snug packing (r3:
+    # 512/3b 47.5k, 768/3b 41.6k, 1024/3b 40.1k structs/s — per-slot
     # cost dominates, so tighter buckets beat bigger batches).
     mp_graphs = load_synthetic_mp(8192, cfg, seed=0)
     mp = _bench_workload(
