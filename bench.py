@@ -214,6 +214,10 @@ def main() -> None:
                 "vs_baseline": round(value / 10_000.0, 4),
                 "atoms_per_sec": mp["atoms_per_sec"],
                 "mfu": mp["mfu"],
+                # production scan-mode (--device-resident default) numbers
+                # live in SCALE_PROOF_MP146K.json — the epoch driver's
+                # fixed costs only amortize at real scale (measured: 31.5k
+                # at 18-batch bench epochs vs 48.3k end-to-end at MP-146k)
                 "padding_eff_nodes": mp["node_eff"],
                 "padding_eff_edges": mp["edge_eff"],
                 "compiled_shapes": mp["shapes"],
