@@ -104,6 +104,7 @@ def build_model(model_cfg: "ModelConfig", data_cfg: "DataConfig",
             step=data_cfg.step,
             dtype=jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32,
             aggregation_impl=model_cfg.aggregation,
+            dense_m=model_cfg.dense_m or None,
         )
     return model_cfg.build(edge_axis_name=edge_axis_name)
 

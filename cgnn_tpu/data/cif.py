@@ -332,6 +332,27 @@ def parse_cif(text: str, occupancy_tol: float = 0.999) -> Structure:
                 f"explicit symmetry-operator loop; cannot expand (no "
                 f"space-group table in this parser)"
             )
+        # Hall symbols declare a group just as firmly as H-M/IT-number do
+        # (advisor r3: a Hall-only non-P1 CIF silently parsed as P1 and
+        # dropped the symmetry-equivalent atoms). P1's Hall symbol is 'P 1'.
+        hall = next(
+            (
+                items[t]
+                for t in (
+                    "_space_group_name_hall",
+                    "_symmetry_space_group_name_hall",
+                )
+                if items.get(t)
+            ),
+            "",
+        )
+        hall_flat = hall.replace(" ", "").replace("_", "").upper()
+        if hall and hall_flat not in ("P1", ".", "?"):
+            raise CIFError(
+                f"Hall symbol {hall!r} declared without an explicit "
+                f"symmetry-operator loop; this parser has no Hall engine — "
+                f"re-export with explicit operators or P1 sites"
+            )
         ops = [(np.eye(3), np.zeros(3))]
 
     # Expand and deduplicate (wrap to [0,1), merge within tolerance).

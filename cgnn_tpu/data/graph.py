@@ -25,12 +25,24 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import warnings
 from typing import Any, Sequence
 
 import numpy as np
 from flax import struct
 
 from cgnn_tpu.data import invariants
+
+
+class TransposeOverflowError(ValueError):
+    """A batch's two-tier transpose overflow exceeded ``over_cap``.
+
+    ``over_cap`` is sized statistically (``overflow_cap``: mean + 3 sigma
+    of shuffle-composition variance), so shuffled runs that repack every
+    epoch can hit this on a tail batch deep into a long job.
+    ``batch_iterator`` catches THIS TYPE and splits the offending batch
+    (same compiled shape); direct ``pack_graphs`` callers see the raise.
+    """
 
 
 @dataclasses.dataclass
@@ -157,7 +169,12 @@ def batch_shape_key(batch: GraphBatch) -> tuple:
     not in per-caller copies."""
     return (
         np.shape(batch.nodes),
+        # dtype too: f32 and bf16 edge batches with identical shapes must
+        # not be np.stack-ed together (silent upcast + mixed-precision
+        # mix). Read the attribute, NOT np.asarray(...): the batch may be
+        # device-resident and asarray would fetch the whole tensor.
         np.shape(batch.edges),
+        str(batch.edges.dtype),
         None if batch.in_slots is None else np.shape(batch.in_slots),
         None if batch.over_slots is None else np.shape(batch.over_slots),
     )
@@ -417,7 +434,7 @@ def pack_graphs(
             sel2 = ~sel1
             k = int(sel2.sum())
             if k > over_cap:
-                raise ValueError(
+                raise TransposeOverflowError(
                     f"batch has {k} transpose-overflow edges > over_cap="
                     f"{over_cap}; size over_cap with overflow_cap(graphs)"
                 )
@@ -713,6 +730,47 @@ def count_batches(
     return count + (1 if in_bucket else 0)
 
 
+def _pack_overflow_safe(
+    bucket: list,
+    node_cap: int,
+    edge_cap: int,
+    graph_cap: int,
+    dense_m,
+    in_cap,
+    over_cap,
+    edge_dtype,
+):
+    """pack_graphs, splitting the batch on a two-tier over_cap overrun.
+
+    ``over_cap`` covers mean + 3 sigma of shuffle-composition variance
+    (``overflow_cap``), so a tail composition can exceed it after many
+    successful epochs. Splitting the offending batch in half re-packs each
+    half to the SAME compiled shape (capacities unchanged) — one extra
+    partially-filled batch instead of a dead run. A single graph that
+    exceeds ``over_cap`` on its own cannot be split and re-raises (it
+    indicates over_cap was sized from different graphs than are being
+    packed).
+    """
+    try:
+        yield pack_graphs(bucket, node_cap, edge_cap, graph_cap,
+                          dense_m=dense_m, in_cap=in_cap, over_cap=over_cap,
+                          edge_dtype=edge_dtype)
+    except TransposeOverflowError:
+        if len(bucket) < 2:
+            raise
+        warnings.warn(
+            f"batch of {len(bucket)} graphs exceeded over_cap={over_cap} "
+            f"(a 3-sigma shuffle tail); splitting it in half instead of "
+            f"aborting the run",
+            stacklevel=2,
+        )
+        mid = len(bucket) // 2
+        for half in (bucket[:mid], bucket[mid:]):
+            yield from _pack_overflow_safe(
+                half, node_cap, edge_cap, graph_cap, dense_m, in_cap,
+                over_cap, edge_dtype)
+
+
 def batch_iterator(
     graphs: Sequence[CrystalGraph],
     batch_size: int,
@@ -773,12 +831,10 @@ def batch_iterator(
             or nn + g.num_nodes > node_cap
             or ne + g.num_edges > edge_cap
         ):
-            yield invariants.maybe_check(
-                pack_graphs(bucket, node_cap, edge_cap, graph_cap,
-                            dense_m=dense_m, in_cap=in_cap,
-                            over_cap=over_cap, edge_dtype=edge_dtype),
-                dense_m,
-            )
+            for packed in _pack_overflow_safe(
+                    bucket, node_cap, edge_cap, graph_cap, dense_m, in_cap,
+                    over_cap, edge_dtype):
+                yield invariants.maybe_check(packed, dense_m)
             bucket, nn, ne = [], 0, 0
         bucket.append(g)
         nn += g.num_nodes
@@ -789,9 +845,7 @@ def batch_iterator(
     # capacity and essentially never reach graph_cap's slack, so a
     # graph_cap comparison would silently drop full tails.
     if bucket and (not drop_last or len(bucket) >= batch_size):
-        yield invariants.maybe_check(
-            pack_graphs(bucket, node_cap, edge_cap, graph_cap,
-                        dense_m=dense_m, in_cap=in_cap, over_cap=over_cap,
-                        edge_dtype=edge_dtype),
-            dense_m,
-        )
+        for packed in _pack_overflow_safe(
+                bucket, node_cap, edge_cap, graph_cap, dense_m, in_cap,
+                over_cap, edge_dtype):
+            yield invariants.maybe_check(packed, dense_m)

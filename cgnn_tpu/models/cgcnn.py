@@ -110,7 +110,9 @@ class CGConv(nn.Module):
         edge_mask: jax.Array,  # [E]
         node_mask: jax.Array,  # [N]
         train: bool = False,
-        in_slots: jax.Array | None = None,  # [N, In] transpose of neighbors
+        in_slots: jax.Array | None = None,  # [N*In] i32 flat transpose of
+        #   neighbors (pack_graphs stores it flat; gather_transpose wants
+        #   flat indices — the on-device 2-D->1-D reshape costs a relayout)
         in_mask: jax.Array | None = None,  # [N, In]
         over_slots: jax.Array | None = None,  # [O] two-tier overflow
         over_nodes: jax.Array | None = None,  # [O]

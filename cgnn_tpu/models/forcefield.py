@@ -56,6 +56,12 @@ class ForceFieldCGCNN(nn.Module):
     step: float = 0.2
     dtype: Any = jnp.float32
     aggregation_impl: str | None = None
+    # dense edge-slot layout (data/graph.py pack_graphs dense_m): the
+    # scatter-free aggregation applies to the force task too — in-model
+    # edge distances compose because dense batches keep the flat
+    # centers/neighbors/edge_offsets vectors in slot order. Requires
+    # batches packed with the same dense_m.
+    dense_m: int | None = None
 
     @nn.compact
     def __call__(
@@ -86,6 +92,7 @@ class ForceFieldCGCNN(nn.Module):
                 aggregation_impl=self.aggregation_impl,
                 # BatchNorm breaks train/eval force consistency (see CGConv)
                 use_batchnorm=False,
+                dense_m=self.dense_m,
                 name=f"conv_{i}",
             )(
                 nodes,
@@ -95,6 +102,13 @@ class ForceFieldCGCNN(nn.Module):
                 batch.edge_mask,
                 batch.node_mask,
                 train=train,
+                # dense two-tier transpose slots (None on COO / in_cap=0
+                # batches -> CGConv falls back to the plain gather)
+                in_slots=batch.in_slots,
+                in_mask=batch.in_mask,
+                over_slots=batch.over_slots,
+                over_nodes=batch.over_nodes,
+                over_mask=batch.over_mask,
             )
         atom_energy = ForceHead(h_fea_len=self.h_fea_len, dtype=self.dtype)(
             nodes, batch.node_mask

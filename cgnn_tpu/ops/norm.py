@@ -78,19 +78,39 @@ class MaskedBatchNorm(nn.Module):
             mean, var = ra_mean.value, ra_var.value
         else:
             xf = x.astype(stat_dtype)
+            if one_pass:
+                # Shift-invariant accumulation: var(x) = var(x - c) for any
+                # per-feature c, and a c near the data mean prevents the
+                # catastrophic cancellation of E[x^2] - E[x]^2 when
+                # |mean| >> std (f32 keeps ~7 digits; at mean 1e4, std 1 the
+                # unshifted form returns var = 0 and rsqrt AMPLIFIES). The
+                # leading row-block is real data (pack_graphs places padding
+                # last), and correctness never depends on the choice of c —
+                # only the cancellation magnitude does. The subtract fuses
+                # into the same single read of x.
+                shift = jax.lax.stop_gradient(
+                    xf[:1].mean(axis=tuple(range(xf.ndim - 1)))
+                )
+                if self.axis_name is not None:
+                    # shards must agree on c or their (s1, s2) can't be
+                    # psum-combined
+                    shift = jax.lax.pmean(shift, self.axis_name)
+                xs = xf - shift
+            else:
+                xs = xf
             if mask is not None:
                 m = mask.astype(stat_dtype)
                 n_real = m.sum()
-                xm = xf * m[..., None]
+                xm = xs * m[..., None]
                 s1 = xm.sum(axis=reduce_axes)
-                s2 = (xm * xf).sum(axis=reduce_axes) if one_pass else None
+                s2 = (xm * xs).sum(axis=reduce_axes) if one_pass else None
             else:
                 m = None
                 n_real = jnp.asarray(
                     np.prod([x.shape[a] for a in reduce_axes]), stat_dtype
                 )
-                s1 = xf.sum(axis=reduce_axes)
-                s2 = (xf * xf).sum(axis=reduce_axes) if one_pass else None
+                s1 = xs.sum(axis=reduce_axes)
+                s2 = (xs * xs).sum(axis=reduce_axes) if one_pass else None
             if self.axis_name is not None:
                 if one_pass:
                     n_real, s1, s2 = jax.lax.psum(
@@ -98,10 +118,12 @@ class MaskedBatchNorm(nn.Module):
                 else:
                     n_real, s1 = jax.lax.psum((n_real, s1), self.axis_name)
             n = jnp.maximum(n_real, 1.0)
-            mean = s1 / n
             if one_pass:
-                var = jnp.maximum(s2 / n - mean * mean, 0.0)
+                mean_s = s1 / n
+                var = jnp.maximum(s2 / n - mean_s * mean_s, 0.0)
+                mean = mean_s + shift
             else:
+                mean = s1 / n
                 centered = (xf - mean) ** 2
                 ss = (
                     (centered * m[..., None]).sum(axis=reduce_axes)

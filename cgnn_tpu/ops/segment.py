@@ -71,38 +71,45 @@ def gather_transpose(
     guarantees this: messages are multiplied by ``edge_mask`` and masked
     BatchNorm statistics exclude padding, so no gradient path reaches a
     padded slot's ``v_j``.
+
+    Implemented with ``jax.custom_derivatives.linear_call`` rather than
+    ``custom_vjp``: the gather is linear in ``nodes``, and a linear op with
+    a declared transpose composes with forward-mode AD and with REPEATED
+    differentiation — which the force task needs (grad-over-grad: the
+    outer params gradient linearizes the inner positions gradient, and
+    ``custom_vjp`` rejects that jvp). The transpose body is the same
+    gather + masked in-degree reduction as before.
     """
+    num_nodes = nodes.shape[0]
 
-    @jax.custom_vjp
-    def g(n):
-        return jnp.take(n, neighbors, axis=0)
+    def fwd(res, n):
+        nbrs = res[0]
+        return jnp.take(n, nbrs, axis=0)
 
-    def g_fwd(n):
-        return g(n), None
-
-    def g_bwd(_, ct):  # ct: [E, F]
+    def trans(res, ct):  # ct: [E, F] -> [N, F]
+        _, slots, msk, o_slots, o_nodes, o_mask = res
         # in_slots arrives pre-flattened (pack_graphs): a device-side
         # [N, In] -> [N*In] flatten is a tiled->linear relayout that
         # measured 0.75 ms/step under the epoch scan
-        contrib = jnp.take(ct, in_slots, axis=0).reshape(
-            *in_mask.shape, ct.shape[-1]
+        contrib = jnp.take(ct, slots, axis=0).reshape(
+            *msk.shape, ct.shape[-1]
         )
         # accumulate in the cotangent dtype: matches the scatter-add's
         # accumulation precision, and an f32 upcast doubles the [N, In, F]
         # intermediate's bytes for no measured accuracy gain (full-step
         # bf16: 16.0 ms vs f32-acc 17.5 ms vs scatter 18.8 ms)
-        grad = (contrib * in_mask[..., None].astype(ct.dtype)).sum(axis=1)
-        if over_slots is not None:
-            rows = jnp.take(ct, over_slots, axis=0)
-            rows = rows * over_mask[:, None].astype(ct.dtype)
+        grad = (contrib * msk[..., None].astype(ct.dtype)).sum(axis=1)
+        if o_slots is not None:
+            rows = jnp.take(ct, o_slots, axis=0)
+            rows = rows * o_mask[:, None].astype(ct.dtype)
             grad = grad + jax.ops.segment_sum(
-                rows, over_nodes, num_segments=nodes.shape[0],
+                rows, o_nodes, num_segments=num_nodes,
                 indices_are_sorted=True,
             )
-        return (grad,)
+        return grad
 
-    g.defvjp(g_fwd, g_bwd)
-    return g(nodes)
+    res = (neighbors, in_slots, in_mask, over_slots, over_nodes, over_mask)
+    return jax.custom_derivatives.linear_call(fwd, trans, res, nodes)
 
 
 def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:

@@ -73,10 +73,22 @@ def main(argv=None) -> int:
         else:
             graphs = load_synthetic(args.synthetic, data_cfg.featurize_config())
     else:
-        graphs = load_cif_directory(
-            args.root_dir, data_cfg.featurize_config(),
-            keep_geometry=force_task,
-        )
+        from cgnn_tpu.data.trajectory import is_trajectory_path
+
+        if force_task and is_trajectory_path(args.root_dir):
+            from cgnn_tpu.data.trajectory import load_trajectory_root
+
+            graphs = [
+                g
+                for grp in load_trajectory_root(
+                    args.root_dir, data_cfg.featurize_config())
+                for g in grp
+            ]
+        else:
+            graphs = load_cif_directory(
+                args.root_dir, data_cfg.featurize_config(),
+                keep_geometry=force_task,
+            )
     # pack the way the model expects (dense slot layout rides in the
     # checkpoint meta; see data/graph.py pack_graphs)
     layout_m = model_cfg.dense_m or None

@@ -220,6 +220,37 @@ def test_one_pass_bn_matches_two_pass_reference():
     )
 
 
+def test_one_pass_bn_high_mean_no_cancellation():
+    """|mean| >> std regime: unshifted f32 E[x^2]-E[x]^2 loses all variance
+    bits (var clamps to 0 and rsqrt(eps) AMPLIFIES by ~300x); the
+    shift-invariant accumulation must keep the output unit-variance.
+    Advisor finding r3 (ops/norm.py one-pass cancellation)."""
+    import jax
+
+    from cgnn_tpu.ops.norm import MaskedBatchNorm
+
+    rng = np.random.default_rng(1)
+    # mean 1e4, std 1: mean^2/var = 1e8 > 2^24 — guaranteed f32
+    # cancellation without a shift
+    x = (1e4 + rng.normal(0.0, 1.0, size=(1024, 4))).astype(np.float32)
+    mask = np.ones(1024, np.float32)
+    mask[900:] = 0.0
+
+    bn = MaskedBatchNorm()
+    variables = bn.init(jax.random.key(0), x, mask=mask)
+    y, _ = bn.apply(
+        variables, x, mask=mask, use_running_average=False,
+        mutable=["batch_stats"],
+    )
+    rows = x[mask > 0].astype(np.float64)
+    ref = (x.astype(np.float64) - rows.mean(0)) / np.sqrt(rows.var(0) + 1e-5)
+    got = np.asarray(y)[:900]
+    # unit-scale output, not a 300x blowup; tolerance is loose because the
+    # data itself carries only ~3 significant fractional digits in f32
+    np.testing.assert_allclose(got, ref[:900], atol=5e-2)
+    assert float(np.abs(got).max()) < 10.0
+
+
 def test_windowed_gather_kernel_matches_take():
     """Pallas windowed one-hot gather (interpret mode on CPU): bit-exact
     vs jnp.take, including out-of-window padding self-loops -> zeros.

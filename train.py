@@ -213,12 +213,24 @@ def main(argv=None) -> int:
         dmin=args.dmin, step=args.step,
     )
     t0 = time.perf_counter()
+    # trajectory grouping for the force task's leak-aware split (frames of
+    # one MD trajectory are time-autocorrelated; data/trajectory.py)
+    traj_groups = None
     if args.cache and os.path.exists(args.cache):
         from cgnn_tpu.data.cache import load_graph_cache
 
         graphs = load_graph_cache(args.cache)
         print(f"loaded {len(graphs)} graphs from {args.cache} "
               f"in {time.perf_counter() - t0:.1f}s")
+        if args.task == "force":
+            from cgnn_tpu.data.trajectory import regroup_by_trajectory
+
+            if any(g.forces is None or g.positions is None for g in graphs):
+                print(f"cache {args.cache} lacks force labels/geometry; "
+                      f"refeaturize from the trajectory files",
+                      file=sys.stderr)
+                return 2
+            traj_groups = regroup_by_trajectory(graphs)
     elif args.synthetic_oc20:
         graphs = load_synthetic_oc20(
             args.synthetic_oc20, data_cfg.featurize_config(), seed=args.seed
@@ -229,13 +241,32 @@ def main(argv=None) -> int:
                 args.synthetic, data_cfg.featurize_config(), seed=args.seed,
                 num_atoms=args.md_atoms, jitter=args.md_jitter,
             )
+            # one trajectory -> the same contiguous-block split policy as
+            # on-disk trajectories (frames are per-frame i.i.d. jitters
+            # here, but the split policy should not depend on that detail)
+            traj_groups = [graphs]
         else:
             graphs = load_synthetic(args.synthetic, data_cfg.featurize_config(),
                                     seed=args.seed)
     elif args.task == "force":
-        print("--task force requires --synthetic N (no offline force-labeled "
-              "CIF format is defined)", file=sys.stderr)
-        return 2
+        from cgnn_tpu.data.trajectory import (
+            is_trajectory_path,
+            load_trajectory_root,
+        )
+
+        if not args.root_dir or not is_trajectory_path(args.root_dir):
+            print("--task force needs --synthetic N or an on-disk trajectory "
+                  "dataset: a .npz file or a directory of them, one file per "
+                  "trajectory (key conventions: cgnn_tpu/data/trajectory.py; "
+                  "MD17/sGDML R/z/E/F files load unchanged)",
+                  file=sys.stderr)
+            return 2
+        traj_groups = load_trajectory_root(
+            args.root_dir, data_cfg.featurize_config()
+        )
+        graphs = [g for grp in traj_groups for g in grp]
+        print(f"loaded {len(traj_groups)} trajectories "
+              f"({len(graphs)} frames) from {args.root_dir}")
     elif args.root_dir:
         if args.workers != 1:
             from cgnn_tpu.data.cache import featurize_directory_parallel
@@ -260,23 +291,35 @@ def main(argv=None) -> int:
             save_graph_cache(graphs, args.cache)
             print(f"wrote cache {args.cache}")
 
-    train_g, val_g, test_g = train_val_test_split(
-        graphs, args.train_ratio, args.val_ratio, seed=args.seed
-    )
+    if traj_groups is not None:
+        from cgnn_tpu.data.trajectory import split_trajectory_groups
+
+        train_g, val_g, test_g = split_trajectory_groups(
+            traj_groups, args.train_ratio, args.val_ratio, seed=args.seed
+        )
+        print(f"trajectory-aware split: {len(train_g)}/{len(val_g)}/"
+              f"{len(test_g)} frames over {len(traj_groups)} trajectories")
+    else:
+        train_g, val_g, test_g = train_val_test_split(
+            graphs, args.train_ratio, args.val_ratio, seed=args.seed
+        )
     num_targets = int(train_g[0].target.shape[0])
     classification = args.task == "classification"
     force_task = args.task == "force"
 
     # dense slot layout: scatter-free aggregation (see data/graph.py); the
-    # flat COO layout remains for edge-sharded meshes, the force task, and
-    # explicit aggregation-backend experiments
-    dense_ok = (not force_task and args.graph_shards <= 1
-                and args.aggregation is None)
+    # flat COO layout remains for edge-sharded meshes and explicit
+    # aggregation-backend experiments. The force task supports dense since
+    # r4 (gather_transpose moved to linear_call so the second-order force
+    # differentiation composes — ops/segment.py) but defaults to COO until
+    # a dense-force bench win is recorded; use --layout dense to select it.
+    dense_ok = args.graph_shards <= 1 and args.aggregation is None
     if args.layout == "dense" and not dense_ok:
-        print("--layout dense is incompatible with --task force, "
-              "--graph-shards and --aggregation", file=sys.stderr)
+        print("--layout dense is incompatible with --graph-shards and "
+              "--aggregation", file=sys.stderr)
         return 2
-    use_dense = dense_ok if args.layout == "auto" else args.layout == "dense"
+    use_dense = (dense_ok and not force_task) if args.layout == "auto" \
+        else args.layout == "dense"
     dense_m = args.max_num_nbr if use_dense else 0
 
     model_cfg = ModelConfig(
