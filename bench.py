@@ -62,7 +62,7 @@ def _flops_per_batch(batch, atom_dim, gauss_dim, f, h, n_conv, n_h) -> float:
 
 def _bench_workload(
     graphs, batch_size, *, buckets=1, n_timed=40, label="", dense_m=None,
-    snug=True,
+    snug=True, fused=None,
 ):
     """-> dict(structs_per_sec, mfu, node_eff, edge_eff, shapes, rounds_s)."""
     import jax
@@ -113,7 +113,7 @@ def _bench_workload(
 
     model = CrystalGraphConvNet(
         atom_fea_len=f, n_conv=n_conv, h_fea_len=h,
-        dtype=jax.numpy.bfloat16, dense_m=dense_m,
+        dtype=jax.numpy.bfloat16, dense_m=dense_m, fused_epilogue=fused,
     )
     tx = make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10_000])
     normalizer = Normalizer.fit(np.stack([g.target for g in graphs]))
@@ -171,6 +171,52 @@ def _bench_workload(
     }
 
 
+def _bench_force_workload(graphs, batch_size, *, dense_m=None, n_timed=16,
+                          label="force_"):
+    """Force-task train-step throughput (config #5): frames/sec/chip.
+
+    The step differentiates twice (positions inside, params outside);
+    dense vs COO isolates the layout win on this workload
+    (VERDICT r3 next-step #4)."""
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.data.graph import batch_iterator, capacities_for
+    from cgnn_tpu.models.forcefield import ForceFieldCGCNN
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.force_step import make_force_train_step
+
+    node_cap, edge_cap = capacities_for(graphs, batch_size, dense_m=dense_m,
+                                        snug=True)
+    batches = list(batch_iterator(graphs, batch_size, node_cap, edge_cap,
+                                  dense_m=dense_m, snug=True))
+    real = [float(np.asarray(b.graph_mask).sum()) for b in batches]
+    model = ForceFieldCGCNN(atom_fea_len=64, n_conv=3, h_fea_len=64,
+                            dmax=6.0, dense_m=dense_m)
+    tx = make_optimizer(optim="sgd", lr=0.001, lr_milestones=[10**9])
+    normalizer = Normalizer.fit(np.stack([g.target for g in graphs]))
+    state = create_train_state(model, batches[0], tx, normalizer)
+    step = jax.jit(make_force_train_step(), donate_argnums=0)
+    device_batches = [jax.device_put(b) for b in batches]
+    state, metrics = step(state, device_batches[0])
+    float(metrics["loss_sum"])
+    best = 0.0
+    rounds_s = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = 0.0
+        for i in range(n_timed):
+            k = i % len(device_batches)
+            state, metrics = step(state, device_batches[k])
+            s += real[k]
+        float(metrics["loss_sum"])
+        dt = time.perf_counter() - t0
+        rounds_s.append(round(dt, 4))
+        best = max(best, s / dt)
+    return {f"{label}structs_per_sec": round(best, 1),
+            f"{label}rounds_s": rounds_s}
+
+
 def main() -> None:
     from cgnn_tpu.data.dataset import (
         FeaturizeConfig,
@@ -203,6 +249,24 @@ def main() -> None:
     flat = _bench_workload(
         mp_graphs, batch_size=512, buckets=3, n_timed=20, label="coo_",
     )
+    # SECONDARY: fused BN1->gate->mask->sum epilogue (r4 kernel work;
+    # ops/fused_epilogue.py) at the PRIMARY workload, both impls
+    fused_xla = _bench_workload(
+        mp_graphs, batch_size=512, buckets=3, n_timed=20,
+        label="fused_xla_", dense_m=12, fused="xla",
+    )
+    fused_pallas = _bench_workload(
+        mp_graphs, batch_size=512, buckets=3, n_timed=20,
+        label="fused_pallas_", dense_m=12, fused="pallas",
+    )
+    # SECONDARY: force task (config #5) — COO vs dense layout
+    from cgnn_tpu.data.dataset import load_trajectory
+
+    md_graphs = load_trajectory(1024, cfg, seed=0, num_atoms=16,
+                                jitter=0.05)
+    force_coo = _bench_force_workload(md_graphs, 256, label="force_coo_")
+    force_dense = _bench_force_workload(md_graphs, 256, dense_m=12,
+                                        label="force_dense_")
 
     value = mp["structs_per_sec"]
     print(
@@ -227,6 +291,9 @@ def main() -> None:
                 "oc20": oc20,
                 "tiny": tiny,
                 "coo_layout": flat,
+                "fused_epilogue_xla": fused_xla,
+                "fused_epilogue_pallas": fused_pallas,
+                "force_task": {**force_coo, **force_dense},
             }
         )
     )

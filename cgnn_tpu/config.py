@@ -31,6 +31,11 @@ class ModelConfig:
     # free aggregation, ~2x faster train step on TPU; 0/None = flat COO.
     # Serialized so predict.py packs batches the way the model expects.
     dense_m: int = 0
+    # fused BN1->gate->mask->sum epilogue: '' (off) | 'xla' | 'pallas'
+    # (ops/fused_epilogue.py). Runtime choice with identical parameters —
+    # checkpoints restore across settings — but serialized so predict
+    # rebuilds what was trained.
+    fused_epilogue: str = ""
 
     def to_meta(self) -> dict:
         return dataclasses.asdict(self) | {
@@ -44,6 +49,7 @@ class ModelConfig:
         kw["classification"] = bool(kw.get("classification", 0))
         kw["multi_task_head"] = bool(kw.get("multi_task_head", 0))
         kw["dense_m"] = int(kw.get("dense_m", 0))
+        kw["fused_epilogue"] = str(kw.get("fused_epilogue", "") or "")
         if kw.get("aggregation") in ("__none__", None):
             kw["aggregation"] = None
         return cls(**kw)
@@ -65,6 +71,14 @@ class ModelConfig:
                 n_h=self.n_h,
                 dtype=jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32,
             )
+        import jax
+
+        fused = self.fused_epilogue or None
+        if fused == "pallas" and jax.default_backend() != "tpu":
+            # the Pallas kernels lower only on TPU; 'xla' is numerically
+            # identical, so a TPU-trained checkpoint stays loadable for
+            # CPU prediction/fine-tuning
+            fused = "xla"
         return CrystalGraphConvNet(
             atom_fea_len=self.atom_fea_len,
             n_conv=self.n_conv,
@@ -79,6 +93,7 @@ class ModelConfig:
             head=head,
             edge_axis_name=edge_axis_name,
             dense_m=self.dense_m or None,
+            fused_epilogue=fused,
         )
 
 

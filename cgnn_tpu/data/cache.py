@@ -89,6 +89,8 @@ def load_graph_cache(path: str) -> list[CrystalGraph]:
     cif_ids = np.asarray(z["cif_ids"])
     has_geom = bool(int(z["has_geometry"]))
     distances = z["distances"] if "distances" in z else None
+    from cgnn_tpu.data import invariants
+
     graphs = []
     for i in range(len(node_counts)):
         ns, ne = slice(node_off[i], node_off[i + 1]), slice(edge_off[i], edge_off[i + 1])
@@ -108,7 +110,9 @@ def load_graph_cache(path: str) -> list[CrystalGraph]:
                 forces=z["forces"][ns] if "forces" in z else None,
             )
         )
-    return graphs
+    # sample-validate under --check-invariants: a truncated or bit-rotted
+    # cache would otherwise surface as silent training corruption
+    return invariants.maybe_spot_check_graphs(graphs)
 
 
 def _featurize_one(args):

@@ -119,8 +119,16 @@ def _norm_tag(tag: str) -> str:
     return tag.lower().replace(".", "_")
 
 
-def _parse_blocks(tokens: list[str]) -> dict:
-    """First data_ block -> {tag: value} plus loops as (headers, rows)."""
+def _parse_blocks(tokens: list[str]) -> list[dict]:
+    """All data_ blocks -> [{"items": {tag: value}, "loops": [...]}, ...].
+
+    Selection policy lives in ``parse_cif``: the first block carrying an
+    atom-site loop with fractional coordinates wins (publication CIFs often
+    lead with a metadata-only block); with no such block, the first block
+    is used so its specific failure (Cartesian-only sites, no sites) is
+    reported.
+    """
+    blocks: list[dict] = []
     items: dict[str, str] = {}
     loops: list[tuple[list[str], list[list[str]]]] = []
     i = 0
@@ -131,7 +139,8 @@ def _parse_blocks(tokens: list[str]) -> dict:
         low = tok.lower()
         if low.startswith("data_"):
             if seen_data:
-                break  # only the first data block
+                blocks.append({"items": items, "loops": loops})
+                items, loops = {}, []
             seen_data = True
             i += 1
         elif low == "loop_":
@@ -165,7 +174,16 @@ def _parse_blocks(tokens: list[str]) -> dict:
                 i += 1
         else:
             i += 1
-    return {"items": items, "loops": loops}
+    blocks.append({"items": items, "loops": loops})
+    return blocks
+
+
+def _has_fract_sites(block: dict) -> bool:
+    return any(
+        h.startswith("_atom_site_fract")
+        for headers, _ in block["loops"]
+        for h in headers
+    )
 
 
 _FRAC_RE = re.compile(r"(\d+)\s*/\s*(\d+)")
@@ -214,8 +232,13 @@ _SYMOP_TAGS = (
 
 
 def parse_cif(text: str, occupancy_tol: float = 0.999) -> Structure:
-    """CIF text -> Structure (symmetry-expanded to the full cell, P1)."""
-    parsed = _parse_blocks(_tokenize(text))
+    """CIF text -> Structure (symmetry-expanded to the full cell, P1).
+
+    Multi-block files: the FIRST block with fractional atom sites is the
+    structure (see _parse_blocks for the policy rationale).
+    """
+    blocks = _parse_blocks(_tokenize(text))
+    parsed = next((b for b in blocks if _has_fract_sites(b)), blocks[0])
     items, loops = parsed["items"], parsed["loops"]
 
     try:

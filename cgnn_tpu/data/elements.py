@@ -138,6 +138,10 @@ ELEMENTS: dict[int, tuple] = {
 }
 
 SYMBOL_TO_Z: dict[str, int] = {v[0]: z for z, v in ELEMENTS.items()}
+# hydrogen-isotope aliases: neutron-diffraction CIFs label deuterium/tritium
+# sites 'D'/'T' (ICSD convention); chemically they featurize as hydrogen
+SYMBOL_TO_Z["D"] = 1
+SYMBOL_TO_Z["T"] = 1
 Z_TO_SYMBOL: dict[int, str] = {z: v[0] for z, v in ELEMENTS.items()}
 
 MAX_Z = 100

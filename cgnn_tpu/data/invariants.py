@@ -50,11 +50,14 @@ def check_batch(batch, dense_m: int | None = None):
     - padding edges carry zero mask AND zero features;
     - ``node_graph`` is non-decreasing with real nodes pointing at real
       graph slots;
-    - dense layout (``dense_m``): slot ownership centers[k] == k // M;
+    - dense layout: slot ownership centers[k] == k // M (``dense_m`` is
+      inferred from pre-shaped [N, M, G] edges when not given);
     - transpose slots: ``in_slots``/``in_mask`` list every real edge slot
       exactly once under its neighbor node — the completeness property
       gather_transpose's scatter-free backward silently relies on.
     """
+    if dense_m is None and np.ndim(batch.edges) == 3:
+        dense_m = int(np.shape(batch.edges)[1])
     nodes = np.asarray(batch.nodes)
     edges = np.asarray(batch.flat_edges)
     centers = np.asarray(batch.centers)
@@ -151,3 +154,83 @@ def maybe_check(batch, dense_m: int | None = None):
     if _ENABLED:
         check_batch(batch, dense_m)
     return batch
+
+
+def check_stacked_batch(stacked, dense_m: int | None = None,
+                        train: bool = False):
+    """Validate a device-stacked batch ([D, ...] leaves) row by row.
+
+    ``train=True`` additionally requires every device row to carry at
+    least one real graph: ``empty_batch_like`` rows are an EVAL-ONLY
+    padding device (psum-neutral metrics) — in a training step their
+    zero gradients would silently dilute the pmean and their degenerate
+    statistics would reach the BatchNorm EMA (the docstring contract
+    this check enforces; see parallel/data_parallel.py).
+    """
+    import jax
+
+    n_dev = int(np.shape(stacked.node_mask)[0])
+    for d in range(n_dev):
+        row = jax.tree_util.tree_map(lambda x, _d=d: x[_d], stacked)
+        check_batch(row, dense_m)
+        if train and float(np.asarray(row.graph_mask).sum()) == 0:
+            _fail(
+                f"device row {d} of a TRAINING batch has zero real graphs "
+                f"(empty_batch_like is eval-only padding; training on it "
+                f"dilutes pmean gradients)"
+            )
+    return stacked
+
+
+def check_any(batch, dense_m: int | None = None, train: bool = False):
+    """Dispatch on stacking: 1-D node_mask -> single batch, 2-D -> stacked.
+
+    Single training batches cannot be empty by construction
+    (batch_iterator never yields an empty pack), so ``train`` only adds
+    the non-empty-row requirement for stacked batches.
+    """
+    if np.ndim(batch.node_mask) == 1:
+        return check_batch(batch, dense_m)
+    return check_stacked_batch(batch, dense_m, train=train)
+
+
+def maybe_check_any(batch, dense_m: int | None = None, train: bool = False):
+    if _ENABLED:
+        check_any(batch, dense_m, train=train)
+    return batch
+
+
+def spot_check_graphs(graphs, k: int = 16):
+    """Sample-validate CrystalGraphs (cache reload path: a bad/truncated
+    cache file would otherwise surface as silent training corruption).
+
+    Checks an evenly spaced sample of ``k`` graphs: index ranges, sorted
+    centers (the pack-time no-op-sort assumption), finite features and
+    labels, and per-array row-count consistency.
+    """
+    if not graphs:
+        _fail("empty graph list")
+    idx = np.unique(np.linspace(0, len(graphs) - 1, num=min(k, len(graphs)),
+                                dtype=np.int64))
+    for i in idx:
+        g = graphs[int(i)]
+        n, e = g.num_nodes, g.num_edges
+        if len(g.edge_fea) != e or len(g.neighbors) != e:
+            _fail(f"graph {g.cif_id!r}: edge array row counts disagree")
+        if e:
+            c, nb = np.asarray(g.centers), np.asarray(g.neighbors)
+            if c.min() < 0 or c.max() >= n or nb.min() < 0 or nb.max() >= n:
+                _fail(f"graph {g.cif_id!r}: edge endpoints out of range")
+        if not np.isfinite(np.asarray(g.atom_fea)).all():
+            _fail(f"graph {g.cif_id!r}: non-finite atom features")
+        if not np.isfinite(np.asarray(g.edge_fea)).all():
+            _fail(f"graph {g.cif_id!r}: non-finite edge features")
+        if not np.isfinite(np.asarray(g.target, np.float64)).all():
+            _fail(f"graph {g.cif_id!r}: non-finite target")
+    return graphs
+
+
+def maybe_spot_check_graphs(graphs, k: int = 16):
+    if _ENABLED:
+        spot_check_graphs(graphs, k)
+    return graphs

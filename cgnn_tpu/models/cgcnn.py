@@ -99,6 +99,11 @@ class CGConv(nn.Module):
     # scatter that runs ~50x below HBM bandwidth (the CUDA atomicAdd
     # analog of SURVEY.md §2 N2, solved the TPU way: layout, not atomics).
     dense_m: int | None = None
+    # fused BN1->gate->mask->sum epilogue (ops/fused_epilogue.py): None
+    # keeps the unfused reference path; 'xla' uses the hand-structured
+    # minimal-pass custom VJP; 'pallas' adds explicit VMEM blocking.
+    # Dense layout + use_batchnorm only; numerics match to f32 roundoff.
+    fused_epilogue: str | None = None
 
     @nn.compact
     def __call__(
@@ -123,6 +128,13 @@ class CGConv(nn.Module):
             raise NotImplementedError(
                 "dense layout + edge-sharded parallelism: shard the flat "
                 "layout instead (aggregation_impl='xla')"
+            )
+        if self.fused_epilogue is not None and (
+            self.dense_m is None or not self.use_batchnorm
+        ):
+            raise NotImplementedError(
+                "fused_epilogue requires the dense layout with BatchNorm "
+                "(it fuses the BN1->gate->mask->sum chain)"
             )
         if self.dense_m is not None:
             m = self.dense_m
@@ -151,23 +163,40 @@ class CGConv(nn.Module):
             z = _SplitFcFull(2 * f, dtype=self.dtype, name="fc_full")(
                 nodes, v_j, e
             )
-            if self.use_batchnorm:
-                # 3-D BN: statistics over the (N, M) slot axes directly —
-                # flattening to [N*M, 2F] costs a real layout-change copy
-                z = MaskedBatchNorm(dtype=self.dtype, name="bn1")(
-                    z, mask=edge_mask.reshape(n, m),
+            if self.use_batchnorm and self.fused_epilogue is not None:
+                # one custom-VJP op for BN1+gate+mask+sum with minimal
+                # activation passes (ops/fused_epilogue.py). Parameter
+                # tree identical to the unfused path (name='bn1'). The
+                # padding-slot zero-cotangent contract below holds here
+                # too: the kernel folds the mask into both the forward
+                # message and dz.
+                from cgnn_tpu.ops.fused_epilogue import FusedBN1GateSum
+
+                agg = FusedBN1GateSum(
+                    impl=self.fused_epilogue, name="bn1"
+                )(
+                    z, edge_mask.reshape(n, m),
                     use_running_average=not train,
-                )
-            gate, core = jnp.split(z, 2, axis=-1)
-            msg = nn.sigmoid(gate) * nn.softplus(core)
-            # LOAD-BEARING for gradients, not just values: gather_transpose's
-            # scatter-free VJP assumes zero cotangent on padding edge slots,
-            # which THIS mask (together with masked BN statistics) guarantees.
-            # Removing it would silently corrupt node gradients
-            # (ops/segment.py gather_transpose docstring; parity test:
-            # tests/test_batching.py two-tier backward).
-            msg = msg * edge_mask.reshape(n, m, 1).astype(msg.dtype)
-            agg = msg.sum(axis=1)
+                ).astype(nodes.dtype)
+            else:
+                if self.use_batchnorm:
+                    # 3-D BN: statistics over the (N, M) slot axes directly —
+                    # flattening to [N*M, 2F] costs a real layout-change copy
+                    z = MaskedBatchNorm(dtype=self.dtype, name="bn1")(
+                        z, mask=edge_mask.reshape(n, m),
+                        use_running_average=not train,
+                    )
+                gate, core = jnp.split(z, 2, axis=-1)
+                msg = nn.sigmoid(gate) * nn.softplus(core)
+                # LOAD-BEARING for gradients, not just values:
+                # gather_transpose's scatter-free VJP assumes zero cotangent
+                # on padding edge slots, which THIS mask (together with
+                # masked BN statistics) guarantees. Removing it would
+                # silently corrupt node gradients (ops/segment.py
+                # gather_transpose docstring; parity test:
+                # tests/test_batching.py two-tier backward).
+                msg = msg * edge_mask.reshape(n, m, 1).astype(msg.dtype)
+                agg = msg.sum(axis=1)
         else:
             v_i = gather(nodes, centers)
             v_j = gather(nodes, neighbors)
@@ -220,6 +249,7 @@ class CrystalGraphConvNet(nn.Module):
     head: nn.Module | None = None  # e.g. MultiTaskHead; replaces fc stack
     edge_axis_name: str | None = None  # edge-sharded graph parallelism
     dense_m: int | None = None  # dense slot layout (see CGConv.dense_m)
+    fused_epilogue: str | None = None  # see CGConv.fused_epilogue
 
     @nn.compact
     def __call__(
@@ -237,6 +267,7 @@ class CrystalGraphConvNet(nn.Module):
                 assume_sorted_edges=self.assume_sorted_edges,
                 edge_axis_name=self.edge_axis_name,
                 dense_m=self.dense_m,
+                fused_epilogue=self.fused_epilogue,
                 name=f"conv_{i}",
             )(
                 nodes,

@@ -22,6 +22,17 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+# Diagnostic/experiment knob: force the two-pass centered variance even for
+# float32 statistics (the f64 oracle path always uses it). Costs one extra
+# full read of the activation per BN; exists so accuracy A/Bs can isolate
+# the one-pass estimator (scripts/mae_ab.py) and as an escape hatch.
+_FORCE_TWO_PASS = False
+
+
+def force_two_pass_stats(enabled: bool = True) -> None:
+    global _FORCE_TWO_PASS
+    _FORCE_TWO_PASS = enabled
+
 
 class MaskedBatchNorm(nn.Module):
     """BatchNorm1d over rows [..., C] with an optional [...] validity mask.
@@ -73,7 +84,7 @@ class MaskedBatchNorm(nn.Module):
         # per direction. The two-pass form is kept for float64 stats —
         # the double-precision oracle parity harness pins 1e-8 agreement
         # with torch, and one-pass cancellation error would show there.
-        one_pass = stat_dtype == jnp.float32
+        one_pass = stat_dtype == jnp.float32 and not _FORCE_TWO_PASS
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:

@@ -43,14 +43,23 @@ def empty_batch_like(batch: GraphBatch) -> GraphBatch:
     Used to pad the last eval step up to a full device group; contributes
     exactly zero to psum-ed metric sums. Never use for training steps —
     running-stat updates would average in its degenerate statistics.
+    Under --check-invariants this is ENFORCED: parallel_batches checks
+    train-time device groups and make_parallel_train_step rejects
+    host-side stacked batches with an all-padding row.
     """
     ncap = batch.node_capacity
-    ecap = batch.edge_capacity
+    # dense layout: centers/neighbors are STRUCTURAL (slot k belongs to
+    # node k//M; padding = masked self-loops), so the empty batch keeps the
+    # ownership pattern; flat COO padding points at the last node slot
+    dense = np.ndim(batch.edges) == 3
+    empty_centers = (np.array(batch.centers) if dense
+                     else np.full_like(batch.centers, ncap - 1))
     return GraphBatch(
         nodes=np.zeros_like(batch.nodes),
         edges=np.zeros_like(batch.edges),
-        centers=np.full_like(batch.centers, ncap - 1),
-        neighbors=np.full_like(batch.neighbors, ncap - 1),
+        centers=empty_centers,
+        neighbors=(empty_centers.copy() if dense
+                   else np.full_like(batch.neighbors, ncap - 1)),
         node_graph=np.zeros_like(batch.node_graph),
         node_mask=np.zeros_like(batch.node_mask),
         edge_mask=np.zeros_like(batch.edge_mask),
@@ -115,19 +124,25 @@ def parallel_batches(
         )
         if stats is not None:
             source = stats.wrap(source)
+    from cgnn_tpu.data import invariants
+
     pending: dict[tuple, list[GraphBatch]] = {}
     for b in source:
         key = batch_shape_key(b)
         q = pending.setdefault(key, [])
         q.append(b)
         if len(q) == n_devices:
-            yield stack_batches(q)
+            # train-time device groups (pad_incomplete=False) must have no
+            # empty rows — the empty_batch_like eval-only contract
+            yield invariants.maybe_check_any(
+                stack_batches(q), dense_m, train=not pad_incomplete
+            )
             pending[key] = []
     if pad_incomplete:
         for q in pending.values():
             if q:
                 q += [empty_batch_like(q[0])] * (n_devices - len(q))
-                yield stack_batches(q)
+                yield invariants.maybe_check_any(stack_batches(q), dense_m)
 
 
 def shard_leading_axis(tree, mesh: Mesh):
@@ -202,7 +217,26 @@ def make_parallel_train_step(
         out_specs=(P(), P()),
         check_vma=False,  # grads/stats are pmean-ed -> replicated outputs
     )
-    return jax.jit(smapped, donate_argnums=0)
+    jitted = jax.jit(smapped, donate_argnums=0)
+
+    def guarded(state: TrainState, stacked: GraphBatch):
+        # --check-invariants last line of defense for direct callers that
+        # bypass the (already-checked) iterators: a host-side batch with an
+        # all-padding device row must not reach a TRAINING step (the
+        # empty_batch_like eval-only contract). Device-resident/traced
+        # batches skip this (their construction paths were checked).
+        from cgnn_tpu.data import invariants
+
+        if invariants.enabled() and isinstance(stacked.graph_mask, np.ndarray):
+            gm = stacked.graph_mask
+            if (gm.reshape(gm.shape[0], -1).sum(axis=1) == 0).any():
+                raise invariants.BatchInvariantError(
+                    "training step received a stacked batch with an "
+                    "all-padding device row (empty_batch_like is eval-only)"
+                )
+        return jitted(state, stacked)
+
+    return guarded
 
 
 def make_parallel_eval_step(

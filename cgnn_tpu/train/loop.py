@@ -206,10 +206,24 @@ class ScanEpochDriver:
         ``jax.device_put``); data-parallel callers pass a mesh-sharding
         stage so the per-step device axis (axis 1 of the stack) lands
         split over the mesh."""
+        from cgnn_tpu.data import invariants
+
+        # the scan trusts these stacks for a whole training run; validate
+        # every input batch (incl. DP-stacked rows) before staging them
+        for b in train_batches:
+            invariants.maybe_check_any(b, train=True)
+        for b in val_batches:
+            invariants.maybe_check_any(b)
         self._rng = rng
         self._stage = stage if stage is not None else jax.device_put
+        # per-phase wall-clock accounting (scripts/scan_cost.py reads this
+        # to attribute the driver's fixed costs); keys are cumulative
+        # seconds, reset by the caller when desired
+        self.timings: dict[str, float] = {}
+        t0 = time.perf_counter()
         self._train_groups = self._stack_groups(train_batches)
         self._val_groups = self._stack_groups(val_batches)
+        self.timings["init_stack_stage_s"] = time.perf_counter() - t0
         self._train_body, self._eval_body = train_body, eval_body
         self._train_scans: dict = {}
         self._eval_scans: dict = {}
@@ -271,6 +285,7 @@ class ScanEpochDriver:
     mixed_tail = 8
 
     def _drive(self, state: TrainState, groups, scans, body, train, first):
+        t_drive0 = time.perf_counter()
         c = self.chunk_steps
         tail = self.mixed_tail if (train and len(groups) > 1) else 0
         queues = []
@@ -347,14 +362,30 @@ class ScanEpochDriver:
                 if not chunks:
                     qs.remove(entry)
 
+        t_sched = time.perf_counter()
         run_queues(queues, weighted=multi and not first)
+        t_chunks = time.perf_counter()
         run_queues(tails, weighted=False)  # mixed single-step tail
+        t_tail = time.perf_counter()
         # ONE round trip for every chunk's sums (per-chunk fetches would
         # re-introduce the per-dispatch link latency this driver removes)
         sums: dict[str, float] = {}
         for chunk_sums in jax.device_get(pending):
             for k, v in chunk_sums.items():
                 sums[k] = sums.get(k, 0.0) + float(v)
+        t_fetch = time.perf_counter()
+        phase = "train" if train else "eval"
+        tm = self.timings
+        tm[f"{phase}_sched_s"] = tm.get(f"{phase}_sched_s", 0.0) \
+            + (t_sched - t_drive0)
+        tm[f"{phase}_chunk_dispatch_s"] = tm.get(
+            f"{phase}_chunk_dispatch_s", 0.0) + (t_chunks - t_sched)
+        tm[f"{phase}_tail_dispatch_s"] = tm.get(
+            f"{phase}_tail_dispatch_s", 0.0) + (t_tail - t_chunks)
+        tm[f"{phase}_fetch_s"] = tm.get(f"{phase}_fetch_s", 0.0) \
+            + (t_fetch - t_tail)
+        tm[f"{phase}_dispatches"] = tm.get(f"{phase}_dispatches", 0.0) \
+            + len(pending)
         return state, means_from_sums(sums, steps)
 
     def train_epoch(self, state: TrainState, first: bool):

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Classification parity harness: JAX vs the in-tree torch CGCNN oracle.
+
+VERDICT r3 next-step #8: regression has a measured MAE-parity acceptance
+(MAE_PARITY_MP.json); classification (reference ``task=classification``,
+SURVEY.md §2 component 1) had only unit tests. This trains both frameworks
+on the same synthetic metal/insulator-style task — MP-like structures,
+binary label = formation-energy proxy above/below the dataset median —
+with the same hyperparameters and matched init draws, over >= 3 seeds, and
+compares accuracy and AUC.
+
+Prints one JSON line:
+  {"torch_accuracy", "jax_accuracy", "accuracy_ratio", "torch_auc",
+   "jax_auc", ...}
+Exit 1 if jax accuracy is more than --tolerance below the oracle's.
+
+Usage: python scripts/class_parity.py [--n 1024] [--epochs 40] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def binary_labeled_dataset(n: int, seed: int):
+    """MP-like structures with label = target above/below the median.
+
+    The median threshold makes the classes balanced by construction; the
+    label is a deterministic function of structure (no label noise), so
+    both frameworks face the same learnable decision boundary.
+    """
+    import numpy as np
+
+    from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic_mp
+
+    cfg = FeaturizeConfig(radius=4.5, max_num_nbr=12)
+    graphs = load_synthetic_mp(n, cfg, seed=seed)
+    median = float(np.median([g.target[0] for g in graphs]))
+    for g in graphs:
+        g.target = np.array([1.0 if g.target[0] > median else 0.0],
+                            np.float32)
+    return graphs, cfg
+
+
+def torch_train_eval(split, *, epochs, batch_size, lr, seed, max_num_nbr):
+    """Train the classification oracle -> (test accuracy, test AUC)."""
+    import numpy as np
+    import torch
+
+    from cgnn_tpu.data.graph import dense_neighbor_views
+    from cgnn_tpu.train.metrics import class_eval
+    from tests.oracle.torch_cgcnn import TorchCGCNN
+
+    train_g, val_g, test_g = split
+    m = max_num_nbr
+
+    def dense_views(g):
+        cached = getattr(g, "_dense_views", None)
+        if cached is None:
+            cached = g._dense_views = dense_neighbor_views(g, m)
+        return cached
+
+    def collate(batch_graphs):
+        atom, nbr, idx, masks, ranges, ys = [], [], [], [], [], []
+        off = 0
+        for g in batch_graphs:
+            n = g.num_nodes
+            dn, di, dm = dense_views(g)
+            atom.append(np.asarray(g.atom_fea, np.float32))
+            nbr.append(dn)
+            idx.append(di + off)
+            masks.append(dm)
+            ranges.append(torch.arange(off, off + n))
+            ys.append(int(g.target[0]))
+            off += n
+        return (
+            torch.from_numpy(np.concatenate(atom)),
+            torch.from_numpy(np.concatenate(nbr)),
+            torch.from_numpy(np.concatenate(idx)).long(),
+            torch.from_numpy(np.concatenate(masks)),
+            ranges,
+            torch.tensor(ys, dtype=torch.long),
+        )
+
+    torch.manual_seed(seed)
+    model = TorchCGCNN(
+        orig_atom_fea_len=train_g[0].atom_fea.shape[1],
+        nbr_fea_len=train_g[0].edge_fea.shape[1],
+        atom_fea_len=64, n_conv=3, h_fea_len=128, n_h=1,
+        classification=True, num_classes=2,
+    )
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+    shuffle_rng = np.random.default_rng(seed)
+
+    def run(split_graphs, train=False):
+        model.train(train)
+        order = (shuffle_rng.permutation(len(split_graphs)) if train
+                 else np.arange(len(split_graphs)))
+        logps, labels = [], []
+        for i in range(0, len(order), batch_size):
+            bg = [split_graphs[j] for j in order[i:i + batch_size]]
+            atom, nbr, idx, mask, ranges, y = collate(bg)
+            out = model(atom, nbr, idx, ranges, nbr_mask=mask)
+            if train:
+                loss = torch.nn.functional.nll_loss(out, y)
+                opt.zero_grad()
+                loss.backward()
+                opt.step()
+            with torch.no_grad():
+                logps.append(out.detach().numpy())
+                labels.extend(int(v) for v in y)
+        return class_eval(np.concatenate(logps), np.array(labels))
+
+    best_val, best_state = -float("inf"), None
+    for _epoch in range(epochs):
+        run(train_g, train=True)
+        with torch.no_grad():
+            val = run(val_g)
+        if val["accuracy"] > best_val:
+            best_val = val["accuracy"]
+            best_state = {k: v.clone() for k, v in model.state_dict().items()}
+    model.load_state_dict(best_state)
+    with torch.no_grad():
+        return run(test_g), best_val
+
+
+def jax_train_eval(split, *, epochs, batch_size, lr, seed,
+                   matched_init=False):
+    import numpy as np
+
+    import jax
+
+    from cgnn_tpu.data.graph import batch_iterator, capacities_for
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.loop import fit
+    from cgnn_tpu.train.metrics import class_eval
+    from cgnn_tpu.train.step import make_predict_step
+
+    train_g, val_g, test_g = split
+    model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
+                                n_h=1, classification=True, num_classes=2)
+    tx = make_optimizer(optim="adam", lr=lr, lr_milestones=[10**9])
+    node_cap, edge_cap = capacities_for(train_g, batch_size)
+    example = next(batch_iterator(train_g, batch_size, node_cap, edge_cap))
+    state = create_train_state(
+        model, example, tx, Normalizer.identity(1), rng=jax.random.key(seed)
+    )
+    if matched_init:
+        import torch
+
+        from tests.oracle.torch_cgcnn import TorchCGCNN, variables_from_torch
+
+        torch.manual_seed(seed + 7919)
+        fresh = TorchCGCNN(
+            orig_atom_fea_len=train_g[0].atom_fea.shape[1],
+            nbr_fea_len=train_g[0].edge_fea.shape[1],
+            atom_fea_len=64, n_conv=3, h_fea_len=128, n_h=1,
+            classification=True, num_classes=2,
+        )
+        variables = variables_from_torch(
+            fresh, {"params": state.params, "batch_stats": state.batch_stats}
+        )
+        state = state.replace(
+            params=jax.tree_util.tree_map(
+                lambda x: np.asarray(x, np.float32), variables["params"]
+            ),
+            batch_stats=jax.tree_util.tree_map(
+                lambda x: np.asarray(x, np.float32),
+                variables["batch_stats"],
+            ),
+        )
+    best = {"params": state.params, "batch_stats": state.batch_stats,
+            "val": -float("inf")}
+
+    def on_epoch_end(s, _epoch, val_m, is_best):
+        if is_best:
+            best.update(params=jax.device_get(s.params),
+                        batch_stats=jax.device_get(s.batch_stats),
+                        val=val_m["correct"])
+
+    state, result = fit(
+        state, train_g, val_g, epochs=epochs, batch_size=batch_size,
+        node_cap=node_cap, edge_cap=edge_cap, classification=True,
+        seed=seed, print_freq=0, on_epoch_end=on_epoch_end,
+        log_fn=lambda *a, **k: None,
+    )
+    state = state.replace(params=best["params"],
+                          batch_stats=best["batch_stats"])
+    pstep = jax.jit(make_predict_step())
+    logps, labels = [], []
+    idx = 0
+    for b in batch_iterator(test_g, batch_size, node_cap, edge_cap):
+        out = np.asarray(jax.device_get(pstep(state, b)))
+        n_real = int(np.asarray(b.graph_mask).sum())
+        logps.append(out[:n_real])
+        labels.extend(int(test_g[idx + k].target[0]) for k in range(n_real))
+        idx += n_real
+    return class_eval(np.concatenate(logps), np.array(labels)), best["val"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--epochs", type=int, default=40)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--device", choices=["auto", "cpu"], default="auto")
+    p.add_argument("--tolerance", type=float, default=0.05,
+                   help="max allowed (1 - jax_accuracy / torch_accuracy)")
+    p.add_argument("--matched-init", action="store_true")
+    args = p.parse_args(argv)
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from cgnn_tpu.data.dataset import train_val_test_split
+
+    graphs, cfg = binary_labeled_dataset(args.n, seed=11)
+    runs = []
+    t_torch = t_jax = 0.0
+    for seed in range(args.seed, args.seed + args.repeats):
+        split = train_val_test_split(graphs, 0.8, 0.1, seed=seed)
+        t0 = time.perf_counter()
+        torch_m, torch_val = torch_train_eval(
+            split, epochs=args.epochs, batch_size=args.batch_size,
+            lr=args.lr, seed=seed, max_num_nbr=cfg.max_num_nbr,
+        )
+        t_torch += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax_m, jax_val = jax_train_eval(
+            split, epochs=args.epochs, batch_size=args.batch_size,
+            lr=args.lr, seed=seed, matched_init=args.matched_init,
+        )
+        t_jax += time.perf_counter() - t0
+        runs.append({
+            "seed": seed,
+            "torch_accuracy": round(torch_m["accuracy"], 4),
+            "jax_accuracy": round(jax_m["accuracy"], 4),
+            "torch_auc": round(torch_m["auc"], 4),
+            "jax_auc": round(jax_m["auc"], 4),
+            "torch_val_acc": round(torch_val, 4),
+            "jax_val_acc": round(jax_val, 4),
+        })
+
+    mean = lambda k: float(np.mean([r[k] for r in runs]))  # noqa: E731
+    acc_t, acc_j = mean("torch_accuracy"), mean("jax_accuracy")
+    print(json.dumps({
+        "metric": "classification_parity",
+        "matched_init": bool(args.matched_init),
+        "torch_accuracy": round(acc_t, 4),
+        "jax_accuracy": round(acc_j, 4),
+        "accuracy_ratio": round(acc_j / acc_t, 4),
+        "torch_auc": round(mean("torch_auc"), 4),
+        "jax_auc": round(mean("jax_auc"), 4),
+        "repeats": args.repeats,
+        "runs": runs,
+        "n_structures": args.n,
+        "epochs": args.epochs,
+        "torch_train_s": round(t_torch, 1),
+        "jax_train_s": round(t_jax, 1),
+    }))
+    return 0 if acc_j / acc_t >= 1.0 - args.tolerance else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

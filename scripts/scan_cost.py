@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""ScanEpochDriver fixed-cost breakdown at bench scale (VERDICT r3 #5).
+
+Round 3 measured 31.5k structs/s through the production epoch driver at
+18-batch bench epochs vs ~50k through the steady-step loop, then removed
+the epoch mode from bench.py instead of explaining the gap. This script
+measures WHERE the gap goes, on the exact bench workload (8192 MP-like
+structures, batch 512, 3 buckets, snug, dense, bf16):
+
+  1. steady-step rate: the bench.py dispatch loop (reference ceiling)
+  2. scan-epoch rate: ScanEpochDriver train epochs, post-compile
+  3. the driver's per-phase wall accounting (ScanEpochDriver.timings):
+     chunk-schedule build, chunk dispatches, mixed-tail dispatches
+     (single-step scans — the BN-EMA mixing tail), the deferred fetch
+
+Prints one JSON line; commit as SCAN_COST.json next to PERF.md.
+
+Usage: python scripts/scan_cost.py [--n 8192] [--epochs 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--n", type=int, default=8192)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--buckets", type=int, default=3)
+    p.add_argument("--fused-epilogue", choices=["off", "xla", "pallas"],
+                   default="off")
+    p.add_argument("--out", type=str, default="")
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic_mp
+    from cgnn_tpu.data.graph import PaddingStats, bucketed_batch_iterator
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.loop import ScanEpochDriver
+    from cgnn_tpu.train.step import make_eval_step, make_train_step
+
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    graphs = load_synthetic_mp(args.n, cfg, seed=0)
+    rng = np.random.default_rng(0)
+    stats = PaddingStats()
+    batches = list(bucketed_batch_iterator(
+        graphs, args.batch_size, args.buckets, shuffle=True, rng=rng,
+        stats=stats, dense_m=cfg.max_num_nbr, snug=True,
+        edge_dtype=jax.numpy.bfloat16,
+    ))
+    structs = sum(float(np.asarray(b.graph_mask).sum()) for b in batches)
+    model = CrystalGraphConvNet(
+        atom_fea_len=64, n_conv=3, h_fea_len=128, dtype=jax.numpy.bfloat16,
+        dense_m=cfg.max_num_nbr,
+        fused_epilogue=None if args.fused_epilogue == "off"
+        else args.fused_epilogue,
+    )
+    tx = make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10**9])
+    normalizer = Normalizer.fit(np.stack([g.target for g in graphs]))
+    state = create_train_state(model, batches[0], tx, normalizer)
+
+    out: dict = {
+        "metric": "scan_epoch_cost_breakdown",
+        "n_structures": args.n,
+        "batches_per_epoch": len(batches),
+        "structs_per_epoch": structs,
+        "fused_epilogue": args.fused_epilogue,
+    }
+
+    # 1. steady-step ceiling (bench.py loop, value-fetch fenced)
+    train_step = jax.jit(make_train_step(), donate_argnums=0)
+    device_batches = [jax.device_put(b) for b in batches]
+    seen = set()
+    metrics = None
+    for b in device_batches:
+        sh = (b.node_capacity, b.edge_capacity)
+        if sh not in seen:
+            seen.add(sh)
+            state, metrics = train_step(state, b)
+    float(metrics["loss_sum"])
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        n_timed = 2 * len(device_batches)
+        for i in range(n_timed):
+            k = i % len(device_batches)
+            state, metrics = train_step(state, device_batches[k])
+        float(metrics["loss_sum"])
+        dt = time.perf_counter() - t0
+        rate = structs * (n_timed / len(device_batches)) / dt
+        best = max(best, rate)
+    out["steady_step_structs_per_sec"] = round(best, 1)
+
+    # 2. scan-epoch driver, train epochs only (no val set: isolate the
+    # train-epoch fixed costs; production adds an eval drive on top)
+    driver = ScanEpochDriver(
+        make_train_step(), make_eval_step(),
+        batches, [], np.random.default_rng(0),
+    )
+    state, _ = driver.train_epoch(state, first=True)       # compiles
+    state, _ = driver.train_epoch(state, first=False)      # more compiles
+    state, _ = driver.train_epoch(state, first=False)
+    driver.timings.clear()
+    t0 = time.perf_counter()
+    for _ in range(args.epochs):
+        state, m = driver.train_epoch(state, first=False)
+    dt = time.perf_counter() - t0
+    out["scan_epoch_s"] = round(dt / args.epochs, 4)
+    out["scan_structs_per_sec"] = round(structs * args.epochs / dt, 1)
+    out["scan_vs_steady"] = round(
+        out["scan_structs_per_sec"] / out["steady_step_structs_per_sec"], 3
+    )
+    out["per_epoch_timings_ms"] = {
+        k: round(v / args.epochs * 1e3, 2)
+        for k, v in sorted(driver.timings.items())
+        if k.endswith("_s")
+    }
+    out["dispatches_per_epoch"] = round(
+        driver.timings.get("train_dispatches", 0.0) / args.epochs, 1
+    )
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

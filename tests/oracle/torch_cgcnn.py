@@ -86,8 +86,11 @@ class TorchCGCNN(nn.Module):
         h_fea_len: int = 128,
         n_h: int = 1,
         num_targets: int = 1,
+        classification: bool = False,
+        num_classes: int = 2,
     ):
         super().__init__()
+        self.classification = classification
         self.embedding = nn.Linear(orig_atom_fea_len, atom_fea_len)
         self.convs = nn.ModuleList(
             ConvLayer(atom_fea_len, nbr_fea_len) for _ in range(n_conv)
@@ -96,7 +99,11 @@ class TorchCGCNN(nn.Module):
         self.fcs = nn.ModuleList(
             nn.Linear(h_fea_len, h_fea_len) for _ in range(n_h - 1)
         )
-        self.fc_out = nn.Linear(h_fea_len, num_targets)
+        # lineage classification head: fc_out -> LogSoftmax (trained with
+        # NLLLoss), mirroring models/cgcnn.py's log_softmax output
+        self.fc_out = nn.Linear(
+            h_fea_len, num_classes if classification else num_targets
+        )
 
     def forward(self, atom_fea, nbr_fea, nbr_fea_idx, crystal_atom_idx,
                 nbr_mask=None):
@@ -110,7 +117,10 @@ class TorchCGCNN(nn.Module):
         crys_fea = nn.functional.softplus(crys_fea)
         for fc in self.fcs:
             crys_fea = nn.functional.softplus(fc(crys_fea))
-        return self.fc_out(crys_fea)
+        out = self.fc_out(crys_fea)
+        if self.classification:
+            out = nn.functional.log_softmax(out, dim=-1)
+        return out
 
 
 def variables_from_torch(oracle: "TorchCGCNN", template):

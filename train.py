@@ -142,6 +142,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--aggregation", choices=["xla", "sort", "pallas"],
                    default=None, help="edge-aggregation backend (flat COO "
                                       "layout only)")
+    p.add_argument("--fused-epilogue", choices=["off", "xla", "pallas"],
+                   default="off",
+                   help="fuse the BN1->gate->mask->sum chain into one "
+                        "custom-VJP op (dense layout only; 'xla' = "
+                        "structured jnp, 'pallas' = hand-blocked kernels; "
+                        "see ops/fused_epilogue.py)")
     p.add_argument("--layout", choices=["auto", "dense", "coo"], default="auto",
                    help="edge batch layout: 'dense' (node-major slots, "
                         "scatter-free aggregation — ~2x faster on TPU) or "
@@ -321,6 +327,10 @@ def main(argv=None) -> int:
     use_dense = (dense_ok and not force_task) if args.layout == "auto" \
         else args.layout == "dense"
     dense_m = args.max_num_nbr if use_dense else 0
+    if args.fused_epilogue != "off" and (not use_dense or force_task):
+        print("--fused-epilogue requires the dense layout with BatchNorm "
+              "(not --layout coo / --task force)", file=sys.stderr)
+        return 2
 
     model_cfg = ModelConfig(
         atom_fea_len=args.atom_fea_len, n_conv=args.n_conv,
@@ -329,6 +339,8 @@ def main(argv=None) -> int:
         dropout=args.dropout, dtype="bfloat16" if args.bf16 else "float32",
         aggregation=args.aggregation, multi_task_head=args.multi_task_head,
         dense_m=dense_m,
+        fused_epilogue="" if args.fused_epilogue == "off"
+        else args.fused_epilogue,
     )
     graph_shards = max(1, args.graph_shards)
     if graph_shards > 1:
