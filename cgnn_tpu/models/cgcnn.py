@@ -103,6 +103,9 @@ class CGConv(nn.Module):
     # keeps the unfused reference path; 'xla' uses the hand-structured
     # minimal-pass custom VJP; 'pallas' adds explicit VMEM blocking.
     # Dense layout + use_batchnorm only; numerics match to f32 roundoff.
+    # MEASURED NEGATIVE on v5e (both impls 5-20% slower than unfused —
+    # the custom-VJP boundary blocks producer/consumer fusion; PERF.md
+    # 6b); default stays None.
     fused_epilogue: str | None = None
 
     @nn.compact

@@ -22,6 +22,17 @@ Two implementations behind one flag:
   with explicit [BN, M, 2F] VMEM blocking — measures what hand scheduling
   adds on top.
 
+MEASURED VERDICT (round 4, real v5e, same-process interleaved rounds at
+the bench workload — PERF.md §6b): BOTH impls are ~5-20% SLOWER than the
+unfused chain (unfused 33.7-39.9k structs/s vs fused-xla 32.4-32.6k vs
+fused-pallas 32.0-32.8k). The custom-VJP boundary forfeits XLA's
+producer/consumer fusion: unfused, the normalize+gate+sum chain fuses
+into the fc_full matmul epilogue and dz into the matmul backwards, so z
+and dz never round-trip HBM as standalone tensors — exactly the passes
+this op "saves" were not being paid. Same verdict class as the r3 gather
+kernels (§3b). The module stays as a correct, tested scaffold behind
+--fused-epilogue; the default path remains unfused.
+
 Numerical contract: identical to MaskedBatchNorm(one-pass f32 stats) +
 split + sigmoid*softplus + mask + sum, to f32 roundoff (tests/test_ops.py).
 NOT used by the force task (its trunk is BatchNorm-free) — this custom_vjp

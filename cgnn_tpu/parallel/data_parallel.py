@@ -422,10 +422,9 @@ def fit_data_parallel(
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         if driver is not None:
-            state, train_m = driver.train_epoch(
+            state, train_m, val_m = driver.run_epoch_pair(
                 state, first=epoch == start_epoch
             )
-            val_m = driver.eval_epoch(state)
             if epoch == start_epoch:
                 log_fn(pad_stats.summary())
         else:

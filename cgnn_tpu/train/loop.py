@@ -227,6 +227,9 @@ class ScanEpochDriver:
         self._train_body, self._eval_body = train_body, eval_body
         self._train_scans: dict = {}
         self._eval_scans: dict = {}
+        # one-epoch-ahead schedules, keyed (id(groups), train, first) —
+        # see _build_sched/_drive
+        self._sched_cache: dict = {}
 
     def _stack_groups(self, batches: list) -> dict:
         """Group same-shape batches, stack on a leading axis, stage to HBM.
@@ -281,19 +284,32 @@ class ScanEpochDriver:
     # stats are an EMA with momentum 0.1, so the last ~16 steps carry most
     # of their weight — ending on a single-shape 16-step chunk would skew
     # eval statistics toward one size class (observed: val MAE 2x worse at
-    # MP-146k scale until the tail was mixed)
+    # MP-146k scale until the tail was mixed). Capped at n//4 per group
+    # (SCAN_COST.json r4): a FIXED 8-per-group tail turned small epochs
+    # into mostly single-step dispatching — at the 18-batch bench scale it
+    # was the whole 31.5k-vs-50k gap — while a proportional tail keeps the
+    # last few steps shape-mixed at every scale
     mixed_tail = 8
 
-    def _drive(self, state: TrainState, groups, scans, body, train, first):
-        t_drive0 = time.perf_counter()
+    def _tail_for(self, n: int) -> int:
+        return min(self.mixed_tail, max(1, n // 4))
+
+    def _build_sched(self, groups, train, first):
+        """(queues, tails, steps) with every chunk perm ALREADY staged on
+        device. Called one epoch AHEAD of use (see _drive) so the H2D
+        transfer overlaps the in-flight epoch instead of stalling the
+        device at the epoch boundary — the trace showed the driver's
+        entire fixed cost as one ~90-140 ms device-idle gap at each epoch
+        start (sync fetch + perm staging + dispatch latency round trips).
+        """
         c = self.chunk_steps
-        tail = self.mixed_tail if (train and len(groups) > 1) else 0
         queues = []
         tails = []
         steps = 0
         multi = train and len(groups) > 1
         for key, stacked in groups.items():
             n = int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
+            tail = self._tail_for(n) if multi else 0
             perm = (
                 np.arange(n) if (first or not train)
                 else self._rng.permutation(n)
@@ -327,13 +343,39 @@ class ScanEpochDriver:
                 tails.append((key, stacked, [foot[i : i + 1]
                                              for i in range(len(foot))]))
             steps += n
+        # one async transfer for every perm (a per-dispatch jnp.asarray
+        # would be a fresh synchronous H2D each time); i32 explicitly —
+        # np.arange is i64 and would trace distinct (or x64-invalid) scans
+        for entry in queues + tails:
+            entry[2][:] = jax.device_put(
+                [np.ascontiguousarray(ch, dtype=np.int32)
+                 for ch in entry[2]]
+            )
+        return queues, tails, steps
+
+    def _drive(self, state: TrainState, groups, scans, body, train, first):
+        """Dispatch one epoch; returns (state, device_sums, steps) WITHOUT
+        fetching — callers combine/fetch sums (run_epoch_pair: one link
+        sync for train+eval; train_epoch/eval_epoch: per-phase fetch)."""
+        t_drive0 = time.perf_counter()
+        sched_key = (id(groups), train, first)
+        sched = self._sched_cache.pop(sched_key, None)
+        if sched is None:
+            sched = self._build_sched(groups, train, first)
+        queues, tails, steps = sched
+        multi = train and len(groups) > 1
         # chunks across shape groups: weighted-random pick (multi-bucket
-        # training) or sequential; defer every fetch to the epoch end so
-        # the dispatch chain never stalls on a round trip
-        pending: list[dict] = []
+        # training) or sequential. Chunk metric sums accumulate ON DEVICE
+        # (async adds) and are fetched ONCE, packed into a single array —
+        # a list-of-dicts device_get at epoch end moved every scalar as
+        # its own link round trip, which at bench scale (17 chunks x 4
+        # keys) was ~250 ms/epoch: the whole driver-vs-steady gap
+        # (SCAN_COST.json r4; metrics.fetch_device_sums)
+        dev_sums: dict | None = None
+        n_chunks = 0
 
         def run_queues(qs, weighted):
-            nonlocal state
+            nonlocal state, dev_sums, n_chunks
             rr = 0
             while qs:
                 if weighted and len(qs) > 1:
@@ -349,16 +391,15 @@ class ScanEpochDriver:
                     entry = qs[rr % len(qs)]
                     rr += 1
                 key, stacked, chunks = entry
-                chunk = chunks.pop(0)
+                chunk = chunks.pop(0)  # device-staged perm (see above)
                 # compile key includes the chunk length (bounded per
                 # group: <= 2c distinct lengths, one remainder, length 1)
                 fn = self._scan_fn(
                     scans, (key, len(chunk)), body, train
                 )
-                state, chunk_sums = fn(
-                    state, stacked, jnp.asarray(chunk)
-                )
-                pending.append(chunk_sums)
+                state, chunk_sums = fn(state, stacked, chunk)
+                dev_sums = accumulate_on_device(dev_sums, chunk_sums)
+                n_chunks += 1
                 if not chunks:
                     qs.remove(entry)
 
@@ -367,13 +408,14 @@ class ScanEpochDriver:
         t_chunks = time.perf_counter()
         run_queues(tails, weighted=False)  # mixed single-step tail
         t_tail = time.perf_counter()
-        # ONE round trip for every chunk's sums (per-chunk fetches would
-        # re-introduce the per-dispatch link latency this driver removes)
-        sums: dict[str, float] = {}
-        for chunk_sums in jax.device_get(pending):
-            for k, v in chunk_sums.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
-        t_fetch = time.perf_counter()
+        # prebuild + stage the NEXT epoch's schedule while this epoch's
+        # dispatches are still executing: its H2D transfers ride along the
+        # in-flight work instead of stalling the next epoch's first scan.
+        # (Pops nothing if the run ends here — a few unused rng draws,
+        # consumed in the same order a further epoch would have.)
+        self._sched_cache[(id(groups), train, False if train else first)] = \
+            self._build_sched(groups, train, False if train else first)
+        t_prebuild = time.perf_counter()
         phase = "train" if train else "eval"
         tm = self.timings
         tm[f"{phase}_sched_s"] = tm.get(f"{phase}_sched_s", 0.0) \
@@ -382,24 +424,56 @@ class ScanEpochDriver:
             f"{phase}_chunk_dispatch_s", 0.0) + (t_chunks - t_sched)
         tm[f"{phase}_tail_dispatch_s"] = tm.get(
             f"{phase}_tail_dispatch_s", 0.0) + (t_tail - t_chunks)
-        tm[f"{phase}_fetch_s"] = tm.get(f"{phase}_fetch_s", 0.0) \
-            + (t_fetch - t_tail)
+        tm[f"{phase}_prebuild_s"] = tm.get(f"{phase}_prebuild_s", 0.0) \
+            + (t_prebuild - t_tail)
         tm[f"{phase}_dispatches"] = tm.get(f"{phase}_dispatches", 0.0) \
-            + len(pending)
-        return state, means_from_sums(sums, steps)
+            + n_chunks
+        return state, dev_sums, steps
 
     def train_epoch(self, state: TrainState, first: bool):
-        return self._drive(
+        state, dev_sums, steps = self._drive(
             state, self._train_groups, self._train_scans,
             self._train_body, train=True, first=first,
         )
+        return state, means_from_sums(fetch_device_sums(dev_sums), steps)
 
     def eval_epoch(self, state: TrainState):
-        _, means = self._drive(
+        _, dev_sums, steps = self._drive(
             state, self._val_groups, self._eval_scans,
             self._eval_body, train=False, first=True,
         )
-        return means
+        return means_from_sums(fetch_device_sums(dev_sums), steps)
+
+    def run_epoch_pair(self, state: TrainState, first: bool):
+        """Train epoch + eval epoch with ONE link sync for both.
+
+        Each fetch on a high-latency link stalls the device for a full
+        round trip (the trace's only remaining gap); eval's dispatches
+        depend on the post-train state only THROUGH THE DEVICE, so they
+        can be enqueued before the train sums are ever fetched —
+        halving the per-epoch sync count. -> (state, train_means,
+        val_means).
+        """
+        state, tr_sums, tr_steps = self._drive(
+            state, self._train_groups, self._train_scans,
+            self._train_body, train=True, first=first,
+        )
+        ev_sums, ev_steps = None, 0
+        if self._val_groups:
+            _, ev_sums, ev_steps = self._drive(
+                state, self._val_groups, self._eval_scans,
+                self._eval_body, train=False, first=True,
+            )
+        combined = {f"t:{k}": v for k, v in (tr_sums or {}).items()}
+        combined |= {f"e:{k}": v for k, v in (ev_sums or {}).items()}
+        t0 = time.perf_counter()
+        fetched = fetch_device_sums(combined or None)
+        self.timings["pair_fetch_s"] = self.timings.get(
+            "pair_fetch_s", 0.0) + (time.perf_counter() - t0)
+        tr = {k[2:]: v for k, v in fetched.items() if k.startswith("t:")}
+        ev = {k[2:]: v for k, v in fetched.items() if k.startswith("e:")}
+        return (state, means_from_sums(tr, tr_steps),
+                means_from_sums(ev, ev_steps))
 
 
 def fit(
@@ -549,10 +623,9 @@ def fit(
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         if driver is not None:
-            state, train_m = driver.train_epoch(
+            state, train_m, val_m = driver.run_epoch_pair(
                 state, first=epoch == start_epoch
             )
-            val_m = driver.eval_epoch(state)
         else:
             if plan is not None:
                 epoch_train, epoch_val = plan.epoch_iterators()

@@ -145,9 +145,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused-epilogue", choices=["off", "xla", "pallas"],
                    default="off",
                    help="fuse the BN1->gate->mask->sum chain into one "
-                        "custom-VJP op (dense layout only; 'xla' = "
-                        "structured jnp, 'pallas' = hand-blocked kernels; "
-                        "see ops/fused_epilogue.py)")
+                        "custom-VJP op (dense layout only). MEASURED "
+                        "SLOWER than the default unfused path on v5e — "
+                        "the custom-VJP boundary forfeits XLA's producer/"
+                        "consumer fusion (PERF.md 6b); kept for "
+                        "reproduction/experiments")
     p.add_argument("--layout", choices=["auto", "dense", "coo"], default="auto",
                    help="edge batch layout: 'dense' (node-major slots, "
                         "scatter-free aggregation — ~2x faster on TPU) or "
