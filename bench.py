@@ -291,16 +291,7 @@ def main() -> None:
     )
     edrv = ScanEpochDriver(make_train_step(), make_eval_step(), eb, [],
                            np.random.default_rng(0))
-    # warm until an epoch introduces no new (shape, chunk-length) program:
-    # chunk lengths are drawn randomly per epoch, so a fixed warmup count
-    # could leave a first-compile (seconds through the tunnel) inside the
-    # timed region
-    prev = -1
-    for _ in range(10):
-        if len(edrv._train_scans) == prev:
-            break
-        prev = len(edrv._train_scans)
-        estate, _, _ = edrv.run_epoch_pair(estate, first=False)
+    estate = edrv.warm(estate)  # keeps first-compiles out of timed epochs
     et0 = _time.perf_counter()
     for _ in range(4):
         estate, _, _ = edrv.run_epoch_pair(estate, first=False)

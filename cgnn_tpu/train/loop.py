@@ -353,16 +353,46 @@ class ScanEpochDriver:
             )
         return queues, tails, steps
 
+    def warm(self, state: TrainState) -> TrainState:
+        """Run epochs until one adds no new (shape, chunk-length) program.
+
+        Chunk lengths are drawn randomly per epoch, so a fixed warmup
+        count can leave a first-compile (seconds through a high-latency
+        link) inside a caller's timed region; benches call this before
+        timing (bench.py, scripts/scan_cost.py).
+        """
+        state, *_ = self.run_epoch_pair(state, first=True)
+        prev = -1
+        for _ in range(10):
+            if len(self._train_scans) == prev:
+                break
+            prev = len(self._train_scans)
+            state, *_ = self.run_epoch_pair(state, first=False)
+        return state
+
     def _drive(self, state: TrainState, groups, scans, body, train, first):
         """Dispatch one epoch; returns (state, device_sums, steps) WITHOUT
         fetching — callers combine/fetch sums (run_epoch_pair: one link
         sync for train+eval; train_epoch/eval_epoch: per-phase fetch)."""
         t_drive0 = time.perf_counter()
         sched_key = (id(groups), train, first)
-        sched = self._sched_cache.pop(sched_key, None)
-        if sched is None:
-            sched = self._build_sched(groups, train, first)
+        if train:
+            sched = self._sched_cache.pop(sched_key, None)
+            if sched is None:
+                sched = self._build_sched(groups, train, first)
+        else:
+            # the eval schedule is deterministic (first=True, arange
+            # perms): build once, reuse every epoch — re-staging identical
+            # perms each epoch was pure waste
+            sched = self._sched_cache.get(sched_key)
+            if sched is None:
+                sched = self._build_sched(groups, train, first)
+                self._sched_cache[sched_key] = sched
         queues, tails, steps = sched
+        # run_queues consumes the chunk lists (pop/remove): work on
+        # shallow copies so the cached eval schedule survives reuse
+        queues = [(k, st, list(ch)) for k, st, ch in queues]
+        tails = [(k, st, list(ch)) for k, st, ch in tails]
         multi = train and len(groups) > 1
         # chunks across shape groups: weighted-random pick (multi-bucket
         # training) or sequential. Chunk metric sums accumulate ON DEVICE
@@ -408,13 +438,14 @@ class ScanEpochDriver:
         t_chunks = time.perf_counter()
         run_queues(tails, weighted=False)  # mixed single-step tail
         t_tail = time.perf_counter()
-        # prebuild + stage the NEXT epoch's schedule while this epoch's
-        # dispatches are still executing: its H2D transfers ride along the
-        # in-flight work instead of stalling the next epoch's first scan.
-        # (Pops nothing if the run ends here — a few unused rng draws,
-        # consumed in the same order a further epoch would have.)
-        self._sched_cache[(id(groups), train, False if train else first)] = \
-            self._build_sched(groups, train, False if train else first)
+        # prebuild + stage the NEXT train epoch's schedule while this
+        # epoch's dispatches are still executing: its H2D transfers ride
+        # along the in-flight work instead of stalling the next epoch's
+        # first scan. (If the run ends here the prebuild is unused — a few
+        # rng draws consumed in the same order a further epoch would have.)
+        if train:
+            self._sched_cache[(id(groups), True, False)] = \
+                self._build_sched(groups, True, False)
         t_prebuild = time.perf_counter()
         phase = "train" if train else "eval"
         tm = self.timings

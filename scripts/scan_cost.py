@@ -124,16 +124,7 @@ def main(argv=None) -> int:
         make_train_step(), make_eval_step(),
         batches, [], np.random.default_rng(0),
     )
-    state, _, _ = driver.run_epoch_pair(state, first=True)   # compiles
-    # warm until an epoch adds no new (shape, chunk-length) program
-    # (lengths are drawn randomly per epoch; a fixed count could leave a
-    # first-compile inside the timed region)
-    prev = -1
-    for _ in range(10):
-        if len(driver._train_scans) == prev:
-            break
-        prev = len(driver._train_scans)
-        state, _, _ = driver.run_epoch_pair(state, first=False)
+    state = driver.warm(state)  # keeps first-compiles out of timed epochs
     driver.timings.clear()
     t0 = time.perf_counter()
     for _ in range(args.epochs):

@@ -317,17 +317,17 @@ def main(argv=None) -> int:
 
     # dense slot layout: scatter-free aggregation (see data/graph.py); the
     # flat COO layout remains for edge-sharded meshes and explicit
-    # aggregation-backend experiments. The force task supports dense since
-    # r4 (gather_transpose moved to linear_call so the second-order force
-    # differentiation composes — ops/segment.py) but defaults to COO until
-    # a dense-force bench win is recorded; use --layout dense to select it.
+    # aggregation-backend experiments. Default for ALL tasks incl. force
+    # since r4: gather_transpose moved to linear_call so the second-order
+    # force differentiation composes (ops/segment.py), parity is pinned to
+    # training-step gradients (tests/test_forces.py), and the bench
+    # measures dense at 1.59x COO on the force workload (BENCH r4).
     dense_ok = args.graph_shards <= 1 and args.aggregation is None
     if args.layout == "dense" and not dense_ok:
         print("--layout dense is incompatible with --graph-shards and "
               "--aggregation", file=sys.stderr)
         return 2
-    use_dense = (dense_ok and not force_task) if args.layout == "auto" \
-        else args.layout == "dense"
+    use_dense = dense_ok if args.layout == "auto" else args.layout == "dense"
     dense_m = args.max_num_nbr if use_dense else 0
     if args.fused_epilogue != "off" and (not use_dense or force_task):
         print("--fused-epilogue requires the dense layout with BatchNorm "
