@@ -172,6 +172,67 @@ class TestFit:
             assert np.isfinite(h["train"]["loss"])
             assert np.isfinite(h["val"]["mae"])
 
+    def test_scan_driver_mechanics(self, tiny_dataset):
+        """r4 driver internals: run_epoch_pair == train_epoch+eval_epoch
+        metrics, warm() stabilizes the compiled-program set, the eval
+        schedule is cached (and survives reuse — its chunk lists are
+        consumed per epoch), and the mixed tail scales with group size."""
+        from cgnn_tpu.data.graph import bucketed_batch_iterator
+        from cgnn_tpu.train.loop import ScanEpochDriver
+        from cgnn_tpu.train.step import make_eval_step, make_train_step
+
+        train_g, val_g, _ = tiny_dataset
+        batches = list(bucketed_batch_iterator(
+            train_g, 8, 2, shuffle=True, rng=np.random.default_rng(0),
+        ))
+        vbatches = list(bucketed_batch_iterator(val_g, 8, 2, in_cap=0))
+
+        def fresh():
+            model = CrystalGraphConvNet(atom_fea_len=16, n_conv=1,
+                                        h_fea_len=16)
+            tx = make_optimizer(optim="sgd", lr=0.01)
+            state = create_train_state(
+                model, batches[0], tx,
+                Normalizer.fit(np.stack([g.target for g in train_g])),
+                rng=jax.random.key(0),
+            )
+            drv = ScanEpochDriver(make_train_step(), make_eval_step(),
+                                  batches, vbatches,
+                                  np.random.default_rng(7))
+            return state, drv
+
+        # pair == separate drives, epoch by epoch (same rng consumption:
+        # eval makes no draws, so interleaving order is identical)
+        s1, d1 = fresh()
+        s2, d2 = fresh()
+        for epoch in range(3):
+            first = epoch == 0
+            s1, tm1, vm1 = d1.run_epoch_pair(s1, first=first)
+            s2, tm2 = d2.train_epoch(s2, first=first)
+            vm2 = d2.eval_epoch(s2)
+            assert tm1["loss"] == pytest.approx(tm2["loss"], rel=1e-6)
+            assert vm1["mae"] == pytest.approx(vm2["mae"], rel=1e-6)
+            assert tm1["count"] == len(train_g)
+            assert vm1["count"] == len(val_g)
+
+        # eval schedule is cached once and reused without decay
+        eval_keys = [k for k in d1._sched_cache if not k[1]]
+        assert len(eval_keys) == 1
+
+        # warm(): the program set stabilizes and further epochs add none
+        s3, d3 = fresh()
+        s3 = d3.warm(s3)
+        n_programs = len(d3._train_scans)
+        for _ in range(3):
+            s3, _, _ = d3.run_epoch_pair(s3, first=False)
+        assert len(d3._train_scans) == n_programs
+
+        # proportional tail: small groups no longer dispatch mostly
+        # single-step scans
+        assert d3._tail_for(6) == 1
+        assert d3._tail_for(40) == 8   # capped at mixed_tail
+        assert d3._tail_for(1) == 1    # never zero for a real group
+
     def test_checkpoint_round_trip(self, tiny_dataset, tmp_path):
         train_g, _, _ = tiny_dataset
         model = CrystalGraphConvNet(atom_fea_len=8, n_conv=1, h_fea_len=16)

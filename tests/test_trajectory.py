@@ -147,6 +147,27 @@ def test_is_trajectory_path(tmp_path):
     assert not is_trajectory_path(str(tmp_path / "missing.npz"))
 
 
+def test_force_data_parallel_cli(tmp_path):
+    """--task force --data-parallel over virtual devices: the composite
+    loss's nested differentiation under shard_map, dense default layout,
+    driven exactly as a user would from the CLI."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    })
+    p = subprocess.run(
+        [sys.executable, "train.py", FIXTURES, "--task", "force",
+         "--device", "cpu", "--epochs", "1", "--optim", "Adam", "-b", "8",
+         "--radius", "5", "--data-parallel",
+         "--ckpt-dir", str(tmp_path / "ckpt"), "--print-freq", "0"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "dp x2" in p.stdout, p.stdout
+    assert "force_mae" in p.stdout
+
+
 def test_force_train_predict_from_disk_cli(tmp_path):
     """Config #5 end to end FROM DISK: train.py on the fixture trajectory
     directory, then predict.py on one fixture file -> CSV + forces npz.
