@@ -58,15 +58,18 @@ def run_epoch(
     whenever link latency is nontrivial (remote/tunneled accelerators) and
     throttles dispatch pipelining everywhere else. A sliding window of
     in-flight step results provides backpressure (bounds how many staged
-    batches can hold live HBM buffers ahead of execution) without stalling
-    the pipeline: each iteration VALUE-FETCHES one scalar from the step
-    ``_WINDOW`` dispatches ago — a true data dependency, unlike
+    batches can hold live HBM buffers ahead of execution): once
+    ``2 * _WINDOW`` results are in flight, ONE scalar from ``_WINDOW``
+    dispatches ago is VALUE-FETCHED — a true data dependency, unlike
     ``block_until_ready``, which this machine's tunneled runtime satisfies
-    before execution completes; the fetch is ~0.1 ms when the pipeline is
-    healthy because that step already finished. ``batch_time`` reports the
-    wall-clock mean per step over each sync window (dispatch is async, so a
-    per-dispatch stopwatch would read zero); ``data_time`` is host wait per
-    batch as before.
+    before execution completes — proving every earlier step finished, so
+    at most ``2 * _WINDOW`` batches hold live buffers. One fence per
+    ``_WINDOW`` steps, NOT per step: each fetch costs a full link round
+    trip (~75 ms on the tunnel; the per-step fence made this loop 4-5x
+    slower than the scan driver — SCAN_COST.json r4). ``batch_time``
+    reports the wall-clock mean per step over each sync window (dispatch
+    is async, so a per-dispatch stopwatch would read zero); ``data_time``
+    is host wait per batch as before.
     """
     from collections import deque
 
@@ -97,7 +100,16 @@ def run_epoch(
             metrics = step_fn(state, batch)
         dev_sums = accumulate_on_device(dev_sums, metrics)
         inflight.append(next(iter(metrics.values())))
-        if len(inflight) > _WINDOW:
+        if len(inflight) >= 2 * _WINDOW:
+            # ONE fence per _WINDOW steps, not per step: each value fetch
+            # is a full link round trip (~75 ms on the tunneled runtime —
+            # a per-step fence made this loop 4-5x slower than the scan
+            # driver at bench scale, SCAN_COST.json r4). Fetching the
+            # _WINDOW-th-oldest handle proves every step before it
+            # finished, so at most 2*_WINDOW batches hold live HBM
+            # buffers ahead of execution.
+            for _ in range(_WINDOW - 1):
+                inflight.popleft()
             jax.device_get(inflight.popleft())  # true fence, see docstring
         window_steps += 1
         end = time.perf_counter()
@@ -247,16 +259,17 @@ class ScanEpochDriver:
             for k, bs in groups.items()
         }
 
-    # mean steps folded into one dispatch; small enough that shape groups
-    # stay interleaved at chunk granularity (BatchNorm running stats and
-    # the optimizer must not see one size class for hundreds of
-    # consecutive steps), large enough to amortize per-dispatch link
-    # latency. Actual chunk lengths are drawn geometrically and groups are
-    # picked weighted-randomly (see _drive) so the multi-bucket step
-    # SEQUENCE approximates the per-step loop's weighted interleave — the
-    # r2 deterministic round-robin's long correlated runs were the
-    # residual convergence gap at MP-146k scale.
-    chunk_steps = 8
+    # mean steps folded into one dispatch. Small, deliberately: r4
+    # measured that dispatch COUNT is essentially free (48 two-step scans
+    # run at the rate of 3 thirty-two-step scans — only SYNC points cost,
+    # PERF.md 6c), while chunk GRANULARITY is what multi-bucket
+    # convergence pays for — at MP-146k, chunk 8's same-shape runs cost
+    # ~35% val MAE vs the per-step interleave (0.0599 vs 0.0447, same
+    # seed/budget), and chunk 2 recovers it fully (0.0424 at 3.0 s vs
+    # 2.7 s epochs; PERF.md 6e). Actual lengths are drawn from
+    # {1, 2, 4} and groups picked weighted-randomly (see _drive) so the
+    # step sequence tracks the per-step loop's weighted interleave.
+    chunk_steps = 2
 
     def _scan_fn(self, cache: dict, key, body: Callable, train: bool):
         if key not in cache:
@@ -354,20 +367,32 @@ class ScanEpochDriver:
         return queues, tails, steps
 
     def warm(self, state: TrainState) -> TrainState:
-        """Run epochs until one adds no new (shape, chunk-length) program.
+        """Compile every (shape, chunk-length) scan program the driver can
+        draw, so no first-compile (seconds through a high-latency link)
+        lands inside a caller's timed region (bench.py, scan_cost.py).
 
-        Chunk lengths are drawn randomly per epoch, so a fixed warmup
-        count can leave a first-compile (seconds through a high-latency
-        link) inside a caller's timed region; benches call this before
-        timing (bench.py, scripts/scan_cost.py).
+        Deterministic by enumeration: chunk lengths come from the bounded
+        set {1 .. c/2, c, 2c} (sizes + remainders + tail singles), so each
+        is executed once directly — sampling warmup epochs until the
+        program set stabilizes can miss a rare length for many epochs when
+        ``chunk_steps`` is small.
         """
+        c = self.chunk_steps
+        lengths = sorted(set(range(1, max(2, c // 2 + 1))) | {c, 2 * c})
+        for key, stacked in self._train_groups.items():
+            n = int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
+            for ln in lengths:
+                if ln > n:
+                    continue
+                fn = self._scan_fn(
+                    self._train_scans, (key, ln), self._train_body, True
+                )
+                perm = jax.device_put(
+                    np.arange(ln, dtype=np.int32) % n
+                )
+                state, _ = fn(state, stacked, perm)
+        # eval programs + the pair plumbing compile on a normal epoch
         state, *_ = self.run_epoch_pair(state, first=True)
-        prev = -1
-        for _ in range(10):
-            if len(self._train_scans) == prev:
-                break
-            prev = len(self._train_scans)
-            state, *_ = self.run_epoch_pair(state, first=False)
         return state
 
     def _drive(self, state: TrainState, groups, scans, body, train, first):
