@@ -162,11 +162,15 @@ def dense_neighbor_views(
     return nbr, idx, mask
 
 
-def batch_shape_key(batch: GraphBatch) -> tuple:
+def batch_shape_key(batch) -> tuple:
     """Hashable key identifying a batch's full compiled shape — the ONE
     definition shared by every shape-grouping consumer (ScanEpochDriver,
     parallel_batches); a new shape-bearing GraphBatch field belongs here,
     not in per-caller copies."""
+    if hasattr(batch, "atom_idx"):  # CompactBatch (duck-typed: no cycle)
+        from cgnn_tpu.data.compact import compact_shape_key
+
+        return compact_shape_key(batch)
     return (
         np.shape(batch.nodes),
         # dtype too: f32 and bf16 edge batches with identical shapes must
@@ -492,54 +496,11 @@ def pack_graphs(
         if dense_m is None:
             raise ValueError("transpose slots require the dense layout "
                              "(dense_m)")
-        # transpose the real edges: group flat slot ids by neighbor node.
-        # Stable-sorting by neighbor + a cumcount gives each real edge its
-        # row-local position; padding entries stay masked at slot 0.
-        real = np.nonzero(edge_mask > 0)[0]
-        nb = neighbors[real]
-        counts = np.bincount(nb, minlength=node_cap)
-        order = np.argsort(nb, kind="stable")
-        tier = dense_m if over_cap is not None else in_cap
-        if over_cap is None and len(real) and counts.max() > tier:
-            raise ValueError(
-                f"a node has in-degree {counts.max()} > in_cap={in_cap}; "
-                f"size in_cap with in_degree_cap(graphs)"
+        in_slots, in_mask, over_slots, over_nodes, over_mask = (
+            transpose_slots(
+                neighbors, edge_mask > 0, node_cap, dense_m, in_cap, over_cap
             )
-        # fill by gather (same pattern as the dense edge grid above): row
-        # j's k-th incoming edge is the neighbor-sorted edge at
-        # starts[j] + k when k < in-degree, else the sentinel zero
-        real_sorted = real[order].astype(np.int32)
-        starts = np.cumsum(counts) - counts
-        src = starts[:, None] + np.arange(tier)
-        tier_valid = np.arange(tier) < counts[:, None]
-        np.copyto(src, len(real), where=~tier_valid)
-        pad = np.concatenate([real_sorted, np.zeros(1, np.int32)])
-        # stored FLAT [node_cap * tier]: the backward's gather wants flat
-        # indices, and flattening the 2-D array on DEVICE costs a tiled->
-        # linear relayout measured at 0.75 ms/step under the epoch scan
-        # (s32 [1, N, In] slice -> [N*In]); in_mask keeps the 2-D shape
-        # for the masked in-degree reduction. uint8 mask: it is only ever
-        # cast to the compute dtype on device, and at MP-146k scale a f32
-        # mask would stage ~0.5 GB of HBM
-        in_slots = np.take(pad, src.ravel(), mode="clip")
-        in_mask = tier_valid.astype(np.uint8)
-        if over_cap is not None:
-            # edges with within-neighbor rank >= tier, in sorted positions
-            sel2 = np.arange(len(real)) - starts.repeat(counts) >= tier
-            k = int(sel2.sum())
-            if k > over_cap:
-                raise TransposeOverflowError(
-                    f"batch has {k} transpose-overflow edges > over_cap="
-                    f"{over_cap}; size over_cap with overflow_cap(graphs)"
-                )
-            # padding targets the LAST node slot so over_nodes stays
-            # non-decreasing (the sorted-scatter promise; masked zero rows)
-            over_slots = np.zeros(over_cap, np.int32)
-            over_nodes = np.full(over_cap, node_cap - 1, np.int32)
-            over_mask = np.zeros(over_cap, np.uint8)
-            over_slots[:k] = real_sorted[sel2]
-            over_nodes[:k] = nb[order][sel2]
-            over_mask[:k] = 1
+        )
 
     return GraphBatch(
         nodes=nodes,
@@ -563,6 +524,74 @@ def pack_graphs(
         over_nodes=over_nodes,
         over_mask=over_mask,
     )
+
+
+def transpose_slots(
+    neighbors: np.ndarray,
+    edge_real: np.ndarray,
+    node_cap: int,
+    dense_m: int,
+    in_cap: int | None,
+    over_cap: int | None,
+) -> tuple:
+    """Transpose of the neighbor gather: group real edge slots by their
+    neighbor node (the scatter-free-backward mapping; see pack_graphs).
+
+    ``neighbors`` [Ecap] i32, ``edge_real`` [Ecap] bool. Returns
+    ``(in_slots, in_mask, over_slots, over_nodes, over_mask)`` — the last
+    three ``None`` unless ``over_cap`` selects the two-tier layout.
+    Stable-sorting by neighbor + a cumcount gives each real edge its
+    row-local position; padding entries stay masked at slot 0.
+    Shared by ``pack_graphs`` and the compact-staging packer
+    (data/compact.py), which must agree exactly.
+    """
+    real = np.nonzero(edge_real)[0]
+    nb = neighbors[real]
+    counts = np.bincount(nb, minlength=node_cap)
+    order = np.argsort(nb, kind="stable")
+    tier = dense_m if over_cap is not None else in_cap
+    if over_cap is None and len(real) and counts.max() > tier:
+        raise ValueError(
+            f"a node has in-degree {counts.max()} > in_cap={in_cap}; "
+            f"size in_cap with in_degree_cap(graphs)"
+        )
+    # fill by gather (same pattern as the dense edge grid in pack_graphs):
+    # row j's k-th incoming edge is the neighbor-sorted edge at
+    # starts[j] + k when k < in-degree, else the sentinel zero
+    real_sorted = real[order].astype(np.int32)
+    starts = np.cumsum(counts) - counts
+    src = starts[:, None] + np.arange(tier)
+    tier_valid = np.arange(tier) < counts[:, None]
+    np.copyto(src, len(real), where=~tier_valid)
+    pad = np.concatenate([real_sorted, np.zeros(1, np.int32)])
+    # stored FLAT [node_cap * tier]: the backward's gather wants flat
+    # indices, and flattening the 2-D array on DEVICE costs a tiled->
+    # linear relayout measured at 0.75 ms/step under the epoch scan
+    # (s32 [1, N, In] slice -> [N*In]); in_mask keeps the 2-D shape
+    # for the masked in-degree reduction. uint8 mask: it is only ever
+    # cast to the compute dtype on device, and at MP-146k scale a f32
+    # mask would stage ~0.5 GB of HBM
+    in_slots = np.take(pad, src.ravel(), mode="clip")
+    in_mask = tier_valid.astype(np.uint8)
+    over_slots = over_nodes = over_mask = None
+    if over_cap is not None:
+        # edges with within-neighbor rank >= tier, in sorted positions
+        sel2 = np.arange(len(real)) - starts.repeat(counts) >= tier
+        k = int(sel2.sum())
+        if k > over_cap:
+            raise TransposeOverflowError(
+                f"batch has {k} transpose-overflow edges > over_cap="
+                f"{over_cap}; size over_cap with overflow_cap(graphs)"
+            )
+        # padding targets the LAST node slot so over_nodes stays
+        # non-decreasing (the sorted-scatter promise; masked zero rows)
+        over_slots = np.zeros(over_cap, np.int32)
+        over_nodes = np.full(over_cap, node_cap - 1, np.int32)
+        over_mask = np.zeros(over_cap, np.uint8)
+        over_slots[:k] = real_sorted[sel2]
+        over_nodes[:k] = nb[order][sel2]
+        over_mask[:k] = 1
+    return in_slots, in_mask, over_slots, over_nodes, over_mask
 
 
 def pad_batch(
@@ -722,6 +751,7 @@ def bucketed_batch_iterator(
     snug: bool = False,
     per_bucket_in_cap: bool = False,
     edge_dtype=np.float32,
+    pack_fn=None,
 ):
     """Yield batches using per-size-class static capacities.
 
@@ -777,7 +807,8 @@ def bucketed_batch_iterator(
             b_in_cap = in_degree_cap(sub)
         it = batch_iterator(sub, batch_size, nc, ec, shuffle=shuffle, rng=rng,
                             dense_m=dense_m, in_cap=b_in_cap, snug=snug,
-                            over_cap=over_cap, edge_dtype=edge_dtype)
+                            over_cap=over_cap, edge_dtype=edge_dtype,
+                            pack_fn=pack_fn)
         iters.append(stats.wrap(it) if stats is not None else it)
         weights.append(float(len(idxs)))
     active = list(range(len(iters)))
@@ -833,6 +864,7 @@ def _pack_overflow_safe(
     in_cap,
     over_cap,
     edge_dtype,
+    pack_fn=None,
 ):
     """pack_graphs, splitting the batch on a two-tier over_cap overrun.
 
@@ -845,10 +877,11 @@ def _pack_overflow_safe(
     indicates over_cap was sized from different graphs than are being
     packed).
     """
+    pack = pack_fn or pack_graphs
     try:
-        yield pack_graphs(bucket, node_cap, edge_cap, graph_cap,
-                          dense_m=dense_m, in_cap=in_cap, over_cap=over_cap,
-                          edge_dtype=edge_dtype)
+        yield pack(bucket, node_cap, edge_cap, graph_cap,
+                   dense_m=dense_m, in_cap=in_cap, over_cap=over_cap,
+                   edge_dtype=edge_dtype)
     except TransposeOverflowError:
         if len(bucket) < 2:
             raise
@@ -862,7 +895,7 @@ def _pack_overflow_safe(
         for half in (bucket[:mid], bucket[mid:]):
             yield from _pack_overflow_safe(
                 half, node_cap, edge_cap, graph_cap, dense_m, in_cap,
-                over_cap, edge_dtype)
+                over_cap, edge_dtype, pack_fn=pack_fn)
 
 
 def batch_iterator(
@@ -878,6 +911,7 @@ def batch_iterator(
     snug: bool = False,
     over_cap: int | None = None,
     edge_dtype=np.float32,
+    pack_fn=None,
 ):
     """Yield fixed-shape GraphBatches of ``batch_size`` graphs each.
 
@@ -927,7 +961,7 @@ def batch_iterator(
         ):
             for packed in _pack_overflow_safe(
                     bucket, node_cap, edge_cap, graph_cap, dense_m, in_cap,
-                    over_cap, edge_dtype):
+                    over_cap, edge_dtype, pack_fn=pack_fn):
                 yield invariants.maybe_check(packed, dense_m)
             bucket, nn, ne = [], 0, 0
         bucket.append(g)
@@ -941,5 +975,5 @@ def batch_iterator(
     if bucket and (not drop_last or len(bucket) >= batch_size):
         for packed in _pack_overflow_safe(
                 bucket, node_cap, edge_cap, graph_cap, dense_m, in_cap,
-                over_cap, edge_dtype):
+                over_cap, edge_dtype, pack_fn=pack_fn):
             yield invariants.maybe_check(packed, dense_m)

@@ -112,47 +112,105 @@ def check_batch(batch, dense_m: int | None = None):
             _fail(f"dense slot ownership broken: centers != slot//{dense_m}")
 
     if batch.in_slots is not None:
-        in_mask = np.asarray(batch.in_mask)
-        in_slots = np.asarray(batch.in_slots).reshape(in_mask.shape)
-        if in_mask.shape[0] != ncap:
-            _fail("in_slots/in_mask row count != node capacity")
-        listed = in_slots[in_mask > 0]
-        rows = np.repeat(np.arange(ncap), (in_mask > 0).sum(axis=1))
-        if batch.over_slots is not None:
-            over_slots = np.asarray(batch.over_slots)
-            over_nodes = np.asarray(batch.over_nodes)
-            over_mask = np.asarray(batch.over_mask)
-            chex.assert_shape(over_nodes, over_slots.shape)
-            chex.assert_shape(over_mask, over_slots.shape)
-            if np.any(np.diff(over_nodes) < 0):
-                _fail("over_nodes is not non-decreasing (sorted-scatter "
-                      "promise broken)")
-            listed = np.concatenate([listed, over_slots[over_mask > 0]])
-            rows = np.concatenate([rows, over_nodes[over_mask > 0]])
-        if listed.size != int(real_e.sum()):
-            _fail(
-                f"transpose mapping lists {listed.size} edges but the batch "
-                f"has {int(real_e.sum())} real edges (gather_transpose "
-                f"backward would drop/duplicate gradient)"
-            )
-        if listed.size:
-            if np.unique(listed).size != listed.size:
-                _fail("transpose mapping lists an edge slot twice")
-            if not real_e[listed].all():
-                _fail("transpose mapping lists a padding edge slot")
-            if not np.array_equal(
-                np.sort(listed), np.sort(np.nonzero(real_e)[0])
-            ):
-                _fail("transpose mapping misses a real edge slot")
-            if not np.array_equal(neighbors[listed], rows):
-                _fail("a transpose row lists an edge of a different neighbor")
+        _check_transpose_mapping(batch, neighbors, real_e, ncap)
+    return batch
+
+
+def _check_transpose_mapping(batch, neighbors, real_e, ncap):
+    """The gather_transpose completeness property (flat ``neighbors`` [E]
+    and ``real_e`` [E] bool) — shared by GraphBatch and CompactBatch."""
+    in_mask = np.asarray(batch.in_mask)
+    in_slots = np.asarray(batch.in_slots).reshape(in_mask.shape)
+    if in_mask.shape[0] != ncap:
+        _fail("in_slots/in_mask row count != node capacity")
+    listed = in_slots[in_mask > 0]
+    rows = np.repeat(np.arange(ncap), (in_mask > 0).sum(axis=1))
+    if batch.over_slots is not None:
+        over_slots = np.asarray(batch.over_slots)
+        over_nodes = np.asarray(batch.over_nodes)
+        over_mask = np.asarray(batch.over_mask)
+        chex.assert_shape(over_nodes, over_slots.shape)
+        chex.assert_shape(over_mask, over_slots.shape)
+        if np.any(np.diff(over_nodes) < 0):
+            _fail("over_nodes is not non-decreasing (sorted-scatter "
+                  "promise broken)")
+        listed = np.concatenate([listed, over_slots[over_mask > 0]])
+        rows = np.concatenate([rows, over_nodes[over_mask > 0]])
+    if listed.size != int(real_e.sum()):
+        _fail(
+            f"transpose mapping lists {listed.size} edges but the batch "
+            f"has {int(real_e.sum())} real edges (gather_transpose "
+            f"backward would drop/duplicate gradient)"
+        )
+    if listed.size:
+        if np.unique(listed).size != listed.size:
+            _fail("transpose mapping lists an edge slot twice")
+        if not real_e[listed].all():
+            _fail("transpose mapping lists a padding edge slot")
+        if not np.array_equal(
+            np.sort(listed), np.sort(np.nonzero(real_e)[0])
+        ):
+            _fail("transpose mapping misses a real edge slot")
+        if not np.array_equal(neighbors[listed], rows):
+            _fail("a transpose row lists an edge of a different neighbor")
+
+
+def check_compact_batch(batch, dense_m: int | None = None):
+    """Validate a CompactBatch (data/compact.py) — the raw-form analog of
+    ``check_batch``. The expensive expanded-form checks (feature zeros on
+    padding) become mask/range checks on the raw payload; the transpose-
+    mapping completeness check is shared verbatim."""
+    atom_idx = np.asarray(batch.atom_idx)
+    distances = np.asarray(batch.distances)
+    neighbors = np.asarray(batch.neighbors)
+    node_graph = np.asarray(batch.node_graph)
+    node_mask = np.asarray(batch.node_mask)
+    edge_mask = np.asarray(batch.edge_mask)
+    graph_mask = np.asarray(batch.graph_mask)
+    ncap, m = distances.shape
+    if dense_m is not None and dense_m != m:
+        _fail(f"compact batch packed with M={m} but dense_m={dense_m} "
+              f"expected")
+    chex.assert_shape(atom_idx, (ncap,))
+    chex.assert_shape(neighbors, (ncap * m,))
+    chex.assert_shape(edge_mask, (ncap, m))
+    chex.assert_shape(node_mask, (ncap,))
+    for name, msk in (("node_mask", node_mask), ("edge_mask", edge_mask),
+                      ("graph_mask", graph_mask)):
+        if not np.isin(msk, (0, 1)).all():
+            _fail(f"{name} contains values outside {{0, 1}}")
+    if atom_idx.min(initial=0) < 0:
+        _fail("negative atom vocabulary index")
+    if neighbors.min(initial=0) < 0 or neighbors.max(initial=0) >= ncap:
+        _fail("neighbors out of node-slot range")
+    real_e = edge_mask > 0
+    if not node_mask[neighbors.reshape(ncap, m)[real_e]].all():
+        _fail("a real edge's neighbor is a padding node")
+    if np.any(real_e & ~(node_mask > 0)[:, None]):
+        _fail("a padding node owns a real edge slot")
+    if np.any(distances[~real_e] != 0):
+        _fail("padding edge slots carry nonzero distances")
+    if not np.isfinite(distances).all():
+        _fail("non-finite distances")
+    real_n = node_mask > 0
+    if not np.all(np.diff(node_mask.astype(np.int8)) <= 0):
+        _fail("real nodes are not a contiguous prefix of the node slots")
+    if np.any(np.diff(node_graph[real_n]) < 0):
+        _fail("node_graph is not non-decreasing over real nodes")
+    if real_n.any() and not graph_mask[node_graph[real_n]].all():
+        _fail("a real node belongs to a padding graph slot")
+    if batch.in_slots is not None:
+        _check_transpose_mapping(batch, neighbors, real_e.reshape(-1), ncap)
     return batch
 
 
 def maybe_check(batch, dense_m: int | None = None):
     """check_batch when globally enabled, else pass-through."""
     if _ENABLED:
-        check_batch(batch, dense_m)
+        if hasattr(batch, "atom_idx"):
+            check_compact_batch(batch, dense_m)
+        else:
+            check_batch(batch, dense_m)
     return batch
 
 
@@ -170,9 +228,11 @@ def check_stacked_batch(stacked, dense_m: int | None = None,
     import jax
 
     n_dev = int(np.shape(stacked.node_mask)[0])
+    checker = (check_compact_batch if hasattr(stacked, "atom_idx")
+               else check_batch)
     for d in range(n_dev):
         row = jax.tree_util.tree_map(lambda x, _d=d: x[_d], stacked)
-        check_batch(row, dense_m)
+        checker(row, dense_m)
         if train and float(np.asarray(row.graph_mask).sum()) == 0:
             _fail(
                 f"device row {d} of a TRAINING batch has zero real graphs "
@@ -190,6 +250,8 @@ def check_any(batch, dense_m: int | None = None, train: bool = False):
     the non-empty-row requirement for stacked batches.
     """
     if np.ndim(batch.node_mask) == 1:
+        if hasattr(batch, "atom_idx"):
+            return check_compact_batch(batch, dense_m)
         return check_batch(batch, dense_m)
     return check_stacked_batch(batch, dense_m, train=train)
 

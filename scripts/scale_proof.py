@@ -51,6 +51,15 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--device", choices=["auto", "cpu"], default="auto")
     p.add_argument("--layout", choices=["dense", "coo"], default="dense")
+    p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache",
+                   metavar="DIR",
+                   help="persistent XLA compile cache ('' disables); "
+                        "warmth is recorded in the output JSON")
+    p.add_argument("--compact", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="stage raw atoms+distances and featurize on device "
+                        "(data/compact.py); auto = on when scan+dense "
+                        "supports it")
     args = p.parse_args(argv)
     if args.device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -58,6 +67,22 @@ def main(argv=None) -> int:
 
     if args.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    compile_cache_warm = False
+    if args.compile_cache:
+        try:
+            # persistent compile cache: scan-program compiles (tens of
+            # seconds each through a high-latency link) become disk hits
+            # on re-runs; warmth is recorded in the output JSON so cold
+            # and warm first-epoch numbers are never silently mixed
+            compile_cache_warm = bool(os.path.isdir(args.compile_cache)
+                                      and os.listdir(args.compile_cache))
+            jax.config.update("jax_compilation_cache_dir",
+                              args.compile_cache)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+        except Exception as e:  # noqa: BLE001 — cache is best-effort
+            print(f"compilation cache unavailable: {e}", file=sys.stderr)
     import numpy as np
 
     from cgnn_tpu.data.cache import load_graph_cache, save_graph_cache
@@ -71,7 +96,8 @@ def main(argv=None) -> int:
     from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
     from cgnn_tpu.train.loop import capacities_for, fit
 
-    out: dict = {"metric": "mp146k_scale_proof", "n_structures": args.n}
+    out: dict = {"metric": "mp146k_scale_proof", "n_structures": args.n,
+                 "compile_cache_warm": compile_cache_warm}
 
     # 1. featurize (generation + neighbor search + Gaussian expansion)
     cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
@@ -121,6 +147,28 @@ def main(argv=None) -> int:
     state = create_train_state(model, example, tx, normalizer,
                                rng=jax.random.key(args.seed))
 
+    compact_spec = None
+    if args.compact == "on" and not (args.scan_epochs and layout_m):
+        print("--compact on requires --scan-epochs and --layout dense",
+              file=sys.stderr)
+        return 2
+    if args.compact != "off" and args.scan_epochs and layout_m is not None:
+        from cgnn_tpu.data.compact import CompactSpec, CompactUnsupported
+
+        try:
+            t0 = time.perf_counter()
+            compact_spec = CompactSpec.build(
+                train_g + val_g, cfg.gdf(), dense_m=layout_m,
+                edge_dtype=jax.numpy.bfloat16,
+            )
+            out["compact_spec_build_s"] = round(time.perf_counter() - t0, 1)
+        except CompactUnsupported as e:
+            if args.compact == "on":
+                raise
+            print(f"compact staging unavailable ({e}); using full "
+                  f"staging", file=sys.stderr)
+    out["compact"] = compact_spec is not None
+
     epoch_times: list[float] = []
     last_t = [time.perf_counter()]
 
@@ -136,8 +184,21 @@ def main(argv=None) -> int:
         pack_once=args.pack_once, device_resident=args.device_resident,
         scan_epochs=args.scan_epochs, snug=True,
         dense_m=layout_m, on_epoch_metrics=on_epoch_metrics,
+        compact=compact_spec,
         log_fn=lambda msg: print(msg, file=sys.stderr),
     )
+    if "staging" in result:
+        # first-epoch accounting (VERDICT r4 missing #1): how the one-time
+        # cost before steady epochs splits into host packing, stack+stage
+        # dispatch, and the remainder (H2D completion + compiles + first
+        # dispatches, inseparable through an async link)
+        st = dict(result["staging"])
+        if epoch_times:
+            st["compile_stage_first_dispatch_s"] = round(
+                epoch_times[0] - st["pack_s"]
+                - st["stack_stage_dispatch_s"], 1
+            )
+        out["first_epoch_breakdown"] = st
     # steady state: exclude the first epoch (compiles + pack_once packing)
     # and use the MEDIAN — the scan driver's randomly drawn chunk lengths
     # can first-compile in a later epoch too (observed: an 8.1 s epoch 2

@@ -150,6 +150,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "the custom-VJP boundary forfeits XLA's producer/"
                         "consumer fusion (PERF.md 6b); kept for "
                         "reproduction/experiments")
+    p.add_argument("--compact-staging", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="stage batches in raw form (atom vocabulary index "
+                        "+ scalar distance, ~12x fewer bytes) and rebuild "
+                        "features inside the jitted scan body "
+                        "(data/compact.py). Requires --scan-epochs + dense "
+                        "layout, energy/classification tasks, single "
+                        "device. auto = on when supported")
+    p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache",
+                   metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "('' disables); scan-program compiles become disk "
+                        "hits on re-runs")
     p.add_argument("--layout", choices=["auto", "dense", "coo"], default="auto",
                    help="edge batch layout: 'dense' (node-major slots, "
                         "scatter-free aggregation — ~2x faster on TPU) or "
@@ -168,6 +181,14 @@ def main(argv=None) -> int:
     if args.device == "cpu":
         # env var alone is not honored under the axon TPU tunnel
         jax.config.update("jax_platforms", "cpu")
+    if args.compile_cache:
+        try:
+            jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+        except Exception as e:  # noqa: BLE001 — cache is best-effort
+            print(f"compilation cache unavailable: {e}", file=sys.stderr)
     import numpy as np
 
     from cgnn_tpu.config import DataConfig, ModelConfig, build_model
@@ -445,6 +466,11 @@ def main(argv=None) -> int:
         step_overrides = {"best_metric": "force_mae"}
 
     if graph_shards > 1 or (args.data_parallel and len(devices) > 1):
+        if args.compact_staging == "on":
+            print("--compact-staging on is not yet supported with "
+                  "--data-parallel/--graph-shards (full staging only); "
+                  "drop the flag or use auto", file=sys.stderr)
+            return 2
         from cgnn_tpu.parallel import fit_data_parallel
         from cgnn_tpu.parallel.mesh import make_2d_mesh
 
@@ -500,6 +526,28 @@ def main(argv=None) -> int:
                 ),
                 "eval_step_fn": eval_step_fn,
             }
+        compact_ok = (args.scan_epochs and layout_m is not None
+                      and not force_task)
+        if args.compact_staging == "on" and not compact_ok:
+            print("--compact-staging on requires --scan-epochs, the dense "
+                  "layout, and a non-force task", file=sys.stderr)
+            return 2
+        if args.compact_staging != "off" and compact_ok:
+            from cgnn_tpu.data.compact import CompactSpec, CompactUnsupported
+
+            try:
+                step_overrides["compact"] = CompactSpec.build(
+                    train_g + val_g + test_g,
+                    data_cfg.featurize_config().gdf(),
+                    dense_m=layout_m, edge_dtype=edge_dtype,
+                )
+                print("compact staging: on (raw atoms+distances staged; "
+                      "features rebuilt on device)")
+            except CompactUnsupported as e:
+                if args.compact_staging == "on":
+                    raise
+                print(f"compact staging unavailable ({e}); using full "
+                      f"staging", file=sys.stderr)
         state, result = fit(
             state, train_g, val_g, epochs=args.epochs, batch_size=args.batch_size,
             node_cap=node_cap, edge_cap=edge_cap, classification=classification,
