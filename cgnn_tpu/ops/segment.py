@@ -23,6 +23,21 @@ import jax.numpy as jnp
 _DEFAULT_IMPL = "xla"
 _VALID_IMPLS = ("xla", "pallas", "sort")
 
+# gather_transpose differentiation mechanism. "linear_call" (default,
+# round 4+) composes with repeated/forward-mode AD (the force task's
+# grad-over-grad needs it); "custom_vjp" is the round-3 implementation,
+# kept ONLY so the interleaved A/B harness (scripts/bench_ab.py) can
+# measure both mechanisms in one process — it emits the same transpose
+# math but rejects second-order AD.
+_TRANSPOSE_IMPL = "linear_call"
+
+
+def set_transpose_impl(impl: str) -> None:
+    global _TRANSPOSE_IMPL
+    if impl not in ("linear_call", "custom_vjp"):
+        raise ValueError(f"unknown transpose impl {impl!r}")
+    _TRANSPOSE_IMPL = impl
+
 
 def set_default_aggregation_impl(impl: str) -> None:
     """Select the global default edge-aggregation backend ('xla'|'pallas'|'sort')."""
@@ -82,22 +97,22 @@ def gather_transpose(
     """
     num_nodes = nodes.shape[0]
 
-    def fwd(res, n):
-        nbrs = res[0]
-        return jnp.take(n, nbrs, axis=0)
+    def _transpose_ct(ct, slots, msk, o_slots, o_nodes, o_mask):
+        """The shared cotangent transpose ([E, F] -> [N, F]) — ONE body
+        for both AD mechanisms so the A/B harness isolates the mechanism,
+        never the math.
 
-    def trans(res, ct):  # ct: [E, F] -> [N, F]
-        _, slots, msk, o_slots, o_nodes, o_mask = res
-        # in_slots arrives pre-flattened (pack_graphs): a device-side
-        # [N, In] -> [N*In] flatten is a tiled->linear relayout that
-        # measured 0.75 ms/step under the epoch scan
+        in_slots arrives pre-flattened (pack_graphs): a device-side
+        [N, In] -> [N*In] flatten is a tiled->linear relayout that
+        measured 0.75 ms/step under the epoch scan. Accumulation stays in
+        the cotangent dtype: matches the scatter-add's accumulation
+        precision, and an f32 upcast doubles the [N, In, F]
+        intermediate's bytes for no measured accuracy gain (full-step
+        bf16: 16.0 ms vs f32-acc 17.5 ms vs scatter 18.8 ms).
+        """
         contrib = jnp.take(ct, slots, axis=0).reshape(
             *msk.shape, ct.shape[-1]
         )
-        # accumulate in the cotangent dtype: matches the scatter-add's
-        # accumulation precision, and an f32 upcast doubles the [N, In, F]
-        # intermediate's bytes for no measured accuracy gain (full-step
-        # bf16: 16.0 ms vs f32-acc 17.5 ms vs scatter 18.8 ms)
         grad = (contrib * msk[..., None].astype(ct.dtype)).sum(axis=1)
         if o_slots is not None:
             rows = jnp.take(ct, o_slots, axis=0)
@@ -107,6 +122,30 @@ def gather_transpose(
                 indices_are_sorted=True,
             )
         return grad
+
+    if _TRANSPOSE_IMPL == "custom_vjp":  # round-3 mechanism (A/B only)
+
+        @jax.custom_vjp
+        def g(n):
+            return jnp.take(n, neighbors, axis=0)
+
+        def g_fwd(n):
+            return g(n), None
+
+        def g_bwd(_, ct):
+            return (_transpose_ct(ct, in_slots, in_mask, over_slots,
+                                  over_nodes, over_mask),)
+
+        g.defvjp(g_fwd, g_bwd)
+        return g(nodes)
+
+    def fwd(res, n):
+        nbrs = res[0]
+        return jnp.take(n, nbrs, axis=0)
+
+    def trans(res, ct):  # ct: [E, F] -> [N, F]
+        _, slots, msk, o_slots, o_nodes, o_mask = res
+        return _transpose_ct(ct, slots, msk, o_slots, o_nodes, o_mask)
 
     res = (neighbors, in_slots, in_mask, over_slots, over_nodes, over_mask)
     return jax.custom_derivatives.linear_call(fwd, trans, res, nodes)
