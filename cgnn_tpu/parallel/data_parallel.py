@@ -301,6 +301,7 @@ def fit_data_parallel(
     profile_steps: int = 0,
     profile_dir: str = "",
     edge_dtype=np.float32,
+    chunk_steps: int | None = None,
 ) -> tuple[TrainState, dict]:
     """DP twin of train.loop.fit; ``batch_size`` is per device.
 
@@ -409,6 +410,7 @@ def fit_data_parallel(
             train_step, eval_step,
             list(make_train_it()), list(make_val_it()),
             rng, stage=lambda t: shard_scan_stack(t, mesh),
+            chunk_steps=chunk_steps,
         )
     plan = (
         PackOncePlan(

@@ -214,7 +214,8 @@ class ScanEpochDriver:
     def __init__(self, train_body: Callable, eval_body: Callable,
                  train_batches: list, val_batches: list,
                  rng: np.random.Generator, stage: Callable | None = None,
-                 expand: Callable | None = None):
+                 expand: Callable | None = None,
+                 chunk_steps: int | None = None):
         """``stage`` places each stacked group on device (default
         ``jax.device_put``); data-parallel callers pass a mesh-sharding
         stage so the per-step device axis (axis 1 of the stack) lands
@@ -230,6 +231,10 @@ class ScanEpochDriver:
             tb, eb = train_body, eval_body
             train_body = lambda s, b: tb(s, expand(b))  # noqa: E731
             eval_body = lambda s, b: eb(s, expand(b))  # noqa: E731
+        if chunk_steps is not None:
+            if chunk_steps < 1:
+                raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
+            self.chunk_steps = int(chunk_steps)
 
         # the scan trusts these stacks for a whole training run; validate
         # every input batch (incl. DP-stacked rows) before staging them
@@ -572,6 +577,7 @@ def fit(
     snug: bool = False,
     edge_dtype=np.float32,
     compact=None,
+    chunk_steps: int | None = None,
 ) -> tuple[TrainState, dict]:
     """Reference ``main()`` loop: train/validate per epoch, track best.
 
@@ -717,6 +723,7 @@ def fit(
             val_list,
             rng,
             expand=expand,
+            chunk_steps=chunk_steps,
         )
         staging["stack_stage_dispatch_s"] = round(
             driver.timings["init_stack_stage_s"], 2

@@ -120,6 +120,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(scripts/scan_convergence.py)")
     p.add_argument("--no-scan-epochs", action="store_true",
                    help="keep the per-step loop under --device-resident")
+    p.add_argument("--chunk-steps", type=int, default=2, metavar="C",
+                   help="scan-driver mean chunk granularity (steps folded "
+                        "per dispatch; lengths drawn from {C/2, C, 2C}). "
+                        "Small on purpose: coarse chunks create long "
+                        "same-shape runs that cost multi-bucket val "
+                        "accuracy (~35%% MAE at MP-146k with C=8 vs C=2, "
+                        "PERF.md 6e); dispatch count itself is ~free")
     # force task (BASELINE config #5)
     p.add_argument("--energy-weight", type=float, default=1.0,
                    help="w_e in L = w_e*MSE(E) + w_f*MSE(F)")
@@ -174,6 +181,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.chunk_steps < 1:
+        print(f"--chunk-steps must be >= 1, got {args.chunk_steps}",
+              file=sys.stderr)
+        return 2
     if args.device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -515,6 +526,7 @@ def main(argv=None) -> int:
             dense_m=layout_m, buckets=args.buckets, snug=snug,
             scan_epochs=args.scan_epochs, profile_steps=args.profile,
             profile_dir=log_dir, edge_dtype=edge_dtype,
+            chunk_steps=args.chunk_steps,
             **step_overrides,
         )
         state = fit_state.replace(apply_fn=state.apply_fn)
@@ -557,7 +569,7 @@ def main(argv=None) -> int:
             profile_steps=args.profile, profile_dir=log_dir,
             pack_once=args.pack_once, device_resident=args.device_resident,
             dense_m=layout_m, scan_epochs=args.scan_epochs, snug=snug,
-            edge_dtype=edge_dtype,
+            edge_dtype=edge_dtype, chunk_steps=args.chunk_steps,
             **step_overrides,
         )
 
