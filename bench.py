@@ -297,6 +297,48 @@ def main() -> None:
         estate, _, _ = edrv.run_epoch_pair(estate, first=False)
     epoch_rate = estructs * 4 / (_time.perf_counter() - et0)
 
+    # inference throughput (predict.py fast path, VERDICT r4 weak #5),
+    # two numbers with different denominators:
+    # - device rate: forward steps over pre-staged batches (the train
+    #   bench's own convention — packing excluded), value-fetch fenced
+    # - end-to-end rate: run_fast_inference including host packing and
+    #   the stacked fetch (what a cold `predict.py` run sees; host
+    #   packing dominates it at scale, see PERF.md §9)
+    from cgnn_tpu.train.infer import run_fast_inference
+    from cgnn_tpu.train.step import make_predict_step
+
+    istate = create_train_state(
+        emodel, eb[0], make_optimizer(optim="sgd", lr=0.01,
+                                      lr_milestones=[10**9]),
+        Normalizer.fit(np.stack([g.target for g in mp_graphs])),
+    )
+    pstep = jax.jit(make_predict_step())  # ONE jitted step for all passes
+    infer_kw = dict(buckets=3, dense_m=12, snug=True,
+                    edge_dtype=jax.numpy.bfloat16, predict_step=pstep)
+    run_fast_inference(istate, mp_graphs, 512, **infer_kw)  # compile pass
+    _, infer_e2e = run_fast_inference(istate, mp_graphs, 512, **infer_kw)
+
+    ib = list(bucketed_batch_iterator(
+        mp_graphs, 512, 3, rng=np.random.default_rng(0), dense_m=12,
+        in_cap=0, snug=True, edge_dtype=jax.numpy.bfloat16,
+    ))
+    ireal = [float(np.asarray(b.graph_mask).sum()) for b in ib]
+    idev = [jax.device_put(b) for b in ib]
+    out = None
+    for b in idev:  # compile per shape
+        out = pstep(istate, b)
+    float(out[0, 0])
+    infer_dev = 0.0
+    for _ in range(3):
+        it0 = _time.perf_counter()
+        done = 0.0
+        for _rep in range(3):
+            for k, b in enumerate(idev):
+                out = pstep(istate, b)
+                done += ireal[k]
+        float(out[0, 0])
+        infer_dev = max(infer_dev, done / (_time.perf_counter() - it0))
+
     value = mp["structs_per_sec"]
     print(
         json.dumps(
@@ -313,6 +355,11 @@ def main() -> None:
                 "epoch_driver_structs_per_sec": round(epoch_rate, 1),
                 "epoch_driver_vs_step": round(
                     epoch_rate / max(value, 1.0), 3),
+                # forward-only inference (predict.py fast path): device
+                # rate over staged batches (train-bench convention) and
+                # the end-to-end rate incl. host packing
+                "inference_structs_per_sec": round(infer_dev, 1),
+                "inference_e2e_structs_per_sec": round(infer_e2e, 1),
                 "padding_eff_nodes": mp["node_eff"],
                 "padding_eff_edges": mp["edge_eff"],
                 "compiled_shapes": mp["shapes"],

@@ -1,0 +1,61 @@
+"""predict.py fast path (train/infer.py): bucketed pipelined inference
+must return predictions in input order, identical to the naive
+batch-at-a-time loop (eval mode is batch-composition-independent)."""
+
+import jax
+import numpy as np
+
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic_mp
+from cgnn_tpu.data.graph import batch_iterator, capacities_for
+from cgnn_tpu.models import CrystalGraphConvNet
+from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+from cgnn_tpu.train.infer import run_fast_inference
+from cgnn_tpu.train.step import make_predict_step
+
+CFG = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+
+
+def test_fast_inference_order_and_values():
+    graphs = load_synthetic_mp(160, CFG, seed=5)
+    model = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=32,
+                                dense_m=12)
+    nc, ec = capacities_for(graphs, 32, dense_m=12, snug=True)
+    example = next(batch_iterator(graphs, 32, nc, ec, dense_m=12, in_cap=0,
+                                  snug=True))
+    state = create_train_state(
+        model, example, make_optimizer(),
+        Normalizer.fit(np.stack([g.target for g in graphs])),
+        rng=jax.random.key(3),
+    )
+
+    # reference: naive single-bucket ladder loop, fetch per batch
+    pstep = jax.jit(make_predict_step())
+    nc_l, ec_l = capacities_for(graphs, 32, dense_m=12)
+    want = []
+    for b in batch_iterator(graphs, 32, nc_l, ec_l, dense_m=12, in_cap=0):
+        out = np.asarray(jax.device_get(pstep(state, b)))
+        want.append(out[: int(np.asarray(b.graph_mask).sum())])
+    want = np.concatenate(want)
+
+    got, rate = run_fast_inference(state, graphs, 32, buckets=3, dense_m=12,
+                                   snug=True)
+    assert rate > 0
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fast_inference_single_bucket_small():
+    graphs = load_synthetic_mp(20, CFG, seed=6)
+    model = CrystalGraphConvNet(atom_fea_len=8, n_conv=1, h_fea_len=16,
+                                dense_m=12)
+    nc, ec = capacities_for(graphs, 8, dense_m=12, snug=True)
+    example = next(batch_iterator(graphs, 8, nc, ec, dense_m=12, in_cap=0,
+                                  snug=True))
+    state = create_train_state(
+        model, example, make_optimizer(),
+        Normalizer.fit(np.stack([g.target for g in graphs])),
+        rng=jax.random.key(0),
+    )
+    preds, _ = run_fast_inference(state, graphs, 8, dense_m=12)
+    assert preds.shape == (20, 1)
+    assert np.isfinite(preds).all()
