@@ -400,21 +400,43 @@ def fit_data_parallel(
         )
 
     driver: ScanEpochDriver | None = None
+    packed_lists: tuple | None = None
     if scan_epochs:
         if profile_steps:
             log_fn(
                 "scan_epochs: --profile is unavailable inside the "
                 "whole-epoch scan (epoch-level metrics only)"
             )
-        driver = ScanEpochDriver(
-            train_step, eval_step,
-            list(make_train_it()), list(make_val_it()),
-            rng, stage=lambda t: shard_scan_stack(t, mesh),
-            chunk_steps=chunk_steps,
+        from cgnn_tpu.train.loop import (
+            check_device_resident_fit,
+            staged_nbytes,
         )
+
+        train_list = list(make_train_it())
+        val_list = list(make_val_it())
+        staged_bytes = staged_nbytes(train_list + val_list)
+        # the stacked [D, ...] device axis shards over the mesh, so the
+        # per-device share is total / n_dev
+        if check_device_resident_fit(staged_bytes, n_devices=n_dev,
+                                     log_fn=log_fn):
+            driver = ScanEpochDriver(
+                train_step, eval_step, train_list, val_list,
+                rng, stage=lambda t: shard_scan_stack(t, mesh),
+                chunk_steps=chunk_steps,
+            )
+        else:
+            # loud fallback (see check_device_resident_fit): host-side
+            # pack-once, mesh-sharded restaging per epoch
+            scan_epochs = False
+            device_resident = False
+            packed_lists = (train_list, val_list)
     plan = (
         PackOncePlan(
-            make_train_it, make_val_it, rng,
+            (lambda: packed_lists[0]) if packed_lists is not None
+            else make_train_it,
+            (lambda: packed_lists[1]) if packed_lists is not None
+            else make_val_it,
+            rng,
             device_resident=device_resident, stage=shard_put,
         )
         if pack_once and driver is None

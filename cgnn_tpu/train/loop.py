@@ -40,6 +40,71 @@ _WINDOW = 8
 from cgnn_tpu.train.state import TrainState
 from cgnn_tpu.train.step import make_eval_step, make_train_step
 
+# HBM per chip by device kind, for the device-resident capacity precheck
+# (jax's memory_stats() returns None on this runtime, so a table it is)
+_HBM_BYTES = {
+    "TPU v5 lite": 16 << 30,  # v5e
+    "TPU v5": 95 << 30,       # v5p
+    "TPU v4": 32 << 30,
+    "TPU v6 lite": 32 << 30,  # trillium
+}
+# fraction of HBM the staged dataset may claim — the rest is params, opt
+# state, activations, XLA workspace, and the scan driver's staged perms
+_STAGE_FRACTION = 0.8
+
+
+def staged_nbytes(batches) -> int:
+    """Total bytes the batch pytrees would occupy staged on device — the
+    ONE definition both fit() and fit_data_parallel feed the capacity
+    precheck (what counts toward the budget must not diverge)."""
+    return sum(
+        x.nbytes for b in batches for x in jax.tree_util.tree_leaves(b)
+    )
+
+
+def device_hbm_budget(device=None) -> int | None:
+    """Usable staging budget in bytes for ``device`` (None = unknown)."""
+    device = device or jax.devices()[0]
+    stats = None
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — backend-dependent, best-effort
+        pass
+    if stats and "bytes_limit" in stats:
+        free = int(stats["bytes_limit"]) - int(stats.get("bytes_in_use", 0))
+        return int(free * _STAGE_FRACTION)
+    total = _HBM_BYTES.get(getattr(device, "device_kind", ""), None)
+    return None if total is None else int(total * _STAGE_FRACTION)
+
+
+def check_device_resident_fit(staged_bytes: int, n_devices: int = 1,
+                              log_fn: Callable = print) -> bool:
+    """True when ``staged_bytes`` fits the device-resident budget.
+
+    False (with a LOUD explanation of the fallback and the knobs that
+    shrink staging) means the caller should keep batches host-side and
+    restage per epoch (``pack_once`` semantics) instead of dying in an
+    opaque XLA OOM mid-staging. Unknown budgets (CPU test meshes, exotic
+    devices) pass — the check never blocks platforms it cannot size.
+    """
+    budget = device_hbm_budget()
+    if budget is None:
+        return True
+    per_device = staged_bytes / max(n_devices, 1)
+    if per_device <= budget:
+        return True
+    log_fn(
+        f"device-resident staging needs {per_device / 1e9:.1f} GB/device "
+        f"but only ~{budget / 1e9:.1f} GB of HBM is budgeted for data "
+        f"({_STAGE_FRACTION:.0%} of "
+        f"{getattr(jax.devices()[0], 'device_kind', 'device')} capacity): "
+        f"FALLING BACK to host-side pack-once staging (per-step H2D each "
+        f"epoch). To stage on-device: --compact-staging (~12x smaller; "
+        f"single-device runs today), more data-parallel devices, or a "
+        f"smaller dataset/batch capacity."
+    )
+    return False
+
 
 def run_epoch(
     step_fn: Callable,
@@ -694,6 +759,7 @@ def fit(
             "inside the whole-epoch scan (epoch-level metrics only)"
         )
     staging: dict = {}
+    packed_lists: tuple | None = None
     if scan_epochs:
         # fold each epoch into one lax.scan dispatch per bucket shape over
         # the HBM-resident stacked batches (amortizes per-step dispatch
@@ -707,31 +773,46 @@ def fit(
         train_list = list(train_batches(rng))
         val_list = list(val_batches())
         staging["pack_s"] = round(time.perf_counter() - t_pack, 2)
-        staging["staged_mb"] = round(
-            sum(
-                x.nbytes
-                for b in train_list + val_list
-                for x in jax.tree_util.tree_leaves(b)
-            )
-            / 1e6,
-            1,
-        )
-        driver = ScanEpochDriver(
-            train_step_fn or make_train_step(classification),
-            eval_step_fn or make_eval_step(classification),
-            train_list,
-            val_list,
-            rng,
-            expand=expand,
-            chunk_steps=chunk_steps,
-        )
-        staging["stack_stage_dispatch_s"] = round(
-            driver.timings["init_stack_stage_s"], 2
-        )
+        staged_bytes = staged_nbytes(train_list + val_list)
+        staging["staged_mb"] = round(staged_bytes / 1e6, 1)
         staging["compact"] = compact is not None
+        if check_device_resident_fit(staged_bytes, log_fn=log_fn):
+            driver = ScanEpochDriver(
+                train_step_fn or make_train_step(classification),
+                eval_step_fn or make_eval_step(classification),
+                train_list,
+                val_list,
+                rng,
+                expand=expand,
+                chunk_steps=chunk_steps,
+            )
+            staging["stack_stage_dispatch_s"] = round(
+                driver.timings["init_stack_stage_s"], 2
+            )
+        else:
+            # LOUD fallback (check_device_resident_fit already logged the
+            # numbers): keep the packed batches host-side and restage per
+            # epoch instead of dying in an opaque XLA OOM mid-staging
+            staging["fallback"] = "host_pack_once"
+            scan_epochs = False
+            device_resident = False
+            packed_lists = (train_list, val_list)
+            if expand is not None:
+                # the per-step loop sees CompactBatches: expansion moves
+                # into the jitted step bodies
+                tb = train_step_fn or make_train_step(classification)
+                eb = eval_step_fn or make_eval_step(classification)
+                train_step = jax.jit(
+                    lambda s, b: tb(s, expand(b)), donate_argnums=0
+                )
+                eval_step = jax.jit(lambda s, b: eb(s, expand(b)))
     plan = (
         PackOncePlan(
-            lambda: train_batches(rng), val_batches, rng,
+            (lambda: packed_lists[0]) if packed_lists is not None
+            else (lambda: train_batches(rng)),
+            (lambda: packed_lists[1]) if packed_lists is not None
+            else val_batches,
+            rng,
             device_resident=device_resident,
         )
         if pack_once and driver is None
