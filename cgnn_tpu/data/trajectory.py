@@ -30,6 +30,7 @@ time blocks so adjacent frames stay within one split.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Sequence
 
 import numpy as np
@@ -231,9 +232,18 @@ def split_trajectory_groups(
 ) -> tuple[list, list, list]:
     """Leak-aware train/val/test split (see module docstring for policy).
 
-    With >= 3 trajectories: whole trajectories per split — the first three
-    (in seeded shuffle order) seed train/val/test so none is empty, the
-    rest go greedily to the split furthest below its frame-count quota.
+    With >= 3 trajectories: whole trajectories per split — each split with
+    a nonzero quota is seeded with one trajectory (in seeded shuffle
+    order) so none it owes frames to is empty, the rest go greedily to
+    the split furthest below its frame-count quota. A zero-ratio split
+    (e.g. ``val_ratio=0``) is never seeded and receives nothing.
+
+    Whole-trajectory granularity means the realized frame fractions can
+    deviate from the requested ratios by up to one trajectory's worth of
+    frames per split — substantial when trajectory lengths are very
+    unequal. A UserWarning reports the realized fractions whenever any
+    split lands more than 5 points (0.05 absolute) off its quota.
+
     With 1-2 trajectories: contiguous time blocks within each.
     """
     if train_ratio + val_ratio >= 1.0 + 1e-9:
@@ -253,13 +263,27 @@ def split_trajectory_groups(
     order = np.random.default_rng(seed).permutation(len(groups))
     total = float(sum(len(g) for g in groups))
     quota = (train_ratio, val_ratio, 1.0 - train_ratio - val_ratio)
+    seeds = [j for j in range(3) if quota[j] > 1e-9]
     splits: tuple[list, list, list] = ([], [], [])
     for k, i in enumerate(order):
         grp = groups[int(i)]
-        if k < 3:
-            j = k  # seed each split with one trajectory
+        if k < len(seeds):
+            j = seeds[k]  # seed each owed split with one trajectory
         else:
-            deficits = [quota[j] - len(splits[j]) / total for j in range(3)]
+            deficits = [
+                quota[j] - len(splits[j]) / total
+                if quota[j] > 1e-9 else -np.inf
+                for j in range(3)
+            ]
             j = int(np.argmax(deficits))
         splits[j].extend(grp)
+    realized = tuple(len(s) / total for s in splits)
+    if any(abs(realized[j] - quota[j]) > 0.05 for j in range(3)):
+        warnings.warn(
+            "whole-trajectory split deviates from requested ratios: "
+            f"realized train/val/test = {realized[0]:.3f}/{realized[1]:.3f}/"
+            f"{realized[2]:.3f} vs requested {quota[0]:.3f}/{quota[1]:.3f}/"
+            f"{quota[2]:.3f} (granularity is one trajectory)",
+            stacklevel=2,
+        )
     return splits

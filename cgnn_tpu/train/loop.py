@@ -9,6 +9,7 @@ reference's console output.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Iterable, Sequence
 
@@ -34,9 +35,14 @@ from cgnn_tpu.train.metrics import (
     means_from_sums,
 )
 
-# in-flight dispatch window (backpressure depth) for the epoch drivers here
-# and in parallel.data_parallel
-_WINDOW = 8
+# In-flight dispatch window (backpressure depth) for the epoch drivers here
+# and in parallel.data_parallel. The fence cadence bounds live staged
+# batches at 2*_WINDOW (not _WINDOW+1): that is intentional — one fence per
+# _WINDOW steps instead of per step halves link round trips — but it doubles
+# peak HBM held by staged batches, so memory-tight large-capacity configs
+# can shrink it via the environment (CGNN_TPU_WINDOW=2 bounds staging at 4
+# batches at the cost of more frequent fences).
+_WINDOW = max(1, int(os.environ.get("CGNN_TPU_WINDOW", "8")))
 from cgnn_tpu.train.state import TrainState
 from cgnn_tpu.train.step import make_eval_step, make_train_step
 
@@ -452,12 +458,24 @@ class ScanEpochDriver:
         draw, so no first-compile (seconds through a high-latency link)
         lands inside a caller's timed region (bench.py, scan_cost.py).
 
+        Runs the REAL train bodies (compilation requires execution here),
+        but against a disposable on-device copy of ``state``, so the
+        ~1+ epochs of optimizer updates on skewed arange%n-repeated batches
+        never touch the caller's state: the returned state is the input,
+        untrained, with every program the driver can draw sitting in the
+        jit cache (keyed on shapes/dtypes, which the copy shares).
+
         Deterministic by enumeration: chunk lengths come from the bounded
         set {1 .. c/2, c, 2c} (sizes + remainders + tail singles), so each
         is executed once directly — sampling warmup epochs until the
         program set stabilizes can miss a rare length for many epochs when
         ``chunk_steps`` is small.
         """
+        # Real buffers, not aliases: the train bodies donate their state
+        # argument, so passing the caller's arrays would invalidate them.
+        scratch = jax.tree_util.tree_map(
+            lambda x: jnp.array(x) if isinstance(x, jax.Array) else x, state
+        )
         c = self.chunk_steps
         lengths = sorted(set(range(1, max(2, c // 2 + 1))) | {c, 2 * c})
         for key, stacked in self._train_groups.items():
@@ -471,9 +489,9 @@ class ScanEpochDriver:
                 perm = jax.device_put(
                     np.arange(ln, dtype=np.int32) % n
                 )
-                state, _ = fn(state, stacked, perm)
+                scratch, _ = fn(scratch, stacked, perm)
         # eval programs + the pair plumbing compile on a normal epoch
-        state, *_ = self.run_epoch_pair(state, first=True)
+        self.run_epoch_pair(scratch, first=True)
         return state
 
     def _drive(self, state: TrainState, groups, scans, body, train, first):

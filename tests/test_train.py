@@ -219,9 +219,18 @@ class TestFit:
         eval_keys = [k for k in d1._sched_cache if not k[1]]
         assert len(eval_keys) == 1
 
-        # warm(): the program set stabilizes and further epochs add none
+        # warm(): the program set stabilizes and further epochs add none;
+        # it compiles via a disposable state copy, so the caller's state
+        # comes back bit-identical (warm must not train — advisor r4)
         s3, d3 = fresh()
+        before = jax.tree_util.tree_map(np.asarray, s3.params)
         s3 = d3.warm(s3)
+        after = jax.tree_util.tree_map(np.asarray, s3.params)
+        assert all(
+            np.array_equal(a, b) for a, b in zip(
+                jax.tree_util.tree_leaves(before),
+                jax.tree_util.tree_leaves(after))
+        )
         n_programs = len(d3._train_scans)
         for _ in range(3):
             s3, _, _ = d3.run_epoch_pair(s3, first=False)

@@ -122,6 +122,24 @@ def test_split_by_trajectory_is_atomic():
                 assert not (stems & {x.split("/")[0] for x in other})
 
 
+def test_split_zero_val_ratio_gets_no_trajectory():
+    """val_ratio=0 must not lose a whole trajectory to val (advisor r4)."""
+    groups = [[f"{c}/{i}" for i in range(20)] for c in "abcde"]
+    train, val, test = split_trajectory_groups(groups, 0.8, 0.0, seed=1)
+    assert val == []
+    assert len(train) + len(test) == 100
+    assert test  # test quota is 0.2 > 0, so it is still seeded
+
+
+def test_split_warns_on_large_ratio_deviation():
+    """Very unequal trajectories: realized fractions can be a whole
+    trajectory off the quota — that must come with a warning."""
+    groups = [[f"big/{i}" for i in range(70)], [f"m/{i}" for i in range(10)],
+              [f"s/{i}" for i in range(10)], [f"t/{i}" for i in range(10)]]
+    with pytest.warns(UserWarning, match="deviates from requested"):
+        split_trajectory_groups(groups, 0.34, 0.33, seed=0)
+
+
 def test_split_contiguous_for_few_trajectories():
     """1-2 trajectories: contiguous time blocks, train = prefix."""
     grp = [f"a/{i:03d}" for i in range(100)]
