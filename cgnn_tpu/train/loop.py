@@ -42,7 +42,18 @@ from cgnn_tpu.train.metrics import (
 # peak HBM held by staged batches, so memory-tight large-capacity configs
 # can shrink it via the environment (CGNN_TPU_WINDOW=2 bounds staging at 4
 # batches at the cost of more frequent fences).
-_WINDOW = max(1, int(os.environ.get("CGNN_TPU_WINDOW", "8")))
+try:
+    _WINDOW = int(os.environ.get("CGNN_TPU_WINDOW", "8"))
+except ValueError:
+    import warnings
+
+    warnings.warn("CGNN_TPU_WINDOW must be a positive integer; using 8")
+    _WINDOW = 8
+if _WINDOW < 1:
+    import warnings
+
+    warnings.warn("CGNN_TPU_WINDOW must be >= 1; clamping to 1")
+    _WINDOW = 1
 from cgnn_tpu.train.state import TrainState
 from cgnn_tpu.train.step import make_eval_step, make_train_step
 
