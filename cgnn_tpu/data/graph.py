@@ -269,6 +269,7 @@ def pack_graphs(
     in_cap: int | None = None,
     over_cap: int | None = None,
     edge_dtype=np.float32,
+    transpose_shards: int = 1,
 ) -> GraphBatch:
     """Concatenate graphs into one fixed-capacity GraphBatch (numpy).
 
@@ -294,6 +295,14 @@ def pack_graphs(
     the ~7% of edges with within-neighbor rank >= M go to the node-sorted
     ``over_slots``/``over_nodes`` COO overflow (capacity ``over_cap``, see
     ``overflow_cap``; overflowing it raises, never truncates).
+
+    ``transpose_shards > 1`` (two-tier only) builds the PER-SHARD stacked
+    mappings for node-strip graph sharding directly
+    (``shard_transpose_slots``) instead of the flat global mapping —
+    avoiding a pack-then-rebuild on the host critical path. A per-shard
+    overflow exceeding ``over_cap`` raises exactly like the global build
+    (a shard's overflow is never larger than the batch's would-be global
+    overflow, so this is at most as strict).
     """
     if not graphs:
         raise ValueError("cannot pack an empty graph list")
@@ -496,11 +505,25 @@ def pack_graphs(
         if dense_m is None:
             raise ValueError("transpose slots require the dense layout "
                              "(dense_m)")
-        in_slots, in_mask, over_slots, over_nodes, over_mask = (
-            transpose_slots(
-                neighbors, edge_mask > 0, node_cap, dense_m, in_cap, over_cap
+        if transpose_shards > 1:
+            if over_cap is None:
+                raise ValueError(
+                    "transpose_shards requires the two-tier layout "
+                    "(over_cap; in_cap single-tier mappings cannot shard)"
+                )
+            in_slots, in_mask, over_slots, over_nodes, over_mask = (
+                shard_transpose_slots(
+                    neighbors, edge_mask > 0, node_cap, dense_m,
+                    transpose_shards, over_cap,
+                )
             )
-        )
+        else:
+            in_slots, in_mask, over_slots, over_nodes, over_mask = (
+                transpose_slots(
+                    neighbors, edge_mask > 0, node_cap, dense_m, in_cap,
+                    over_cap,
+                )
+            )
 
     return GraphBatch(
         nodes=nodes,
@@ -594,6 +617,55 @@ def transpose_slots(
     return in_slots, in_mask, over_slots, over_nodes, over_mask
 
 
+def shard_transpose_slots(
+    neighbors: np.ndarray,
+    edge_real: np.ndarray,
+    node_cap: int,
+    dense_m: int,
+    n_shards: int,
+    over_cap: int,
+) -> tuple:
+    """Per-shard two-tier transpose mappings for node-strip graph sharding.
+
+    Under dense-layout graph parallelism (parallel/edge_parallel.py), shard
+    ``s`` owns the contiguous node strip ``[s*N/D, (s+1)*N/D)`` and — by the
+    dense layout's slot-ownership rule — exactly that strip's edge slots.
+    The scatter-free backward then needs, PER SHARD, the edge slots in that
+    shard grouped by neighbor node (over ALL nodes: a strip's edges point
+    anywhere): each shard transposes its own [E/D, F] cotangent into a
+    partial [N, F] node gradient, and the shard_map machinery sums the
+    partials (the transpose of the replicated-nodes cast).
+
+    Tier-1 width stays ``dense_m`` and the overflow capacity stays the
+    batch-global ``over_cap``: an edge's within-neighbor rank restricted to
+    one shard never exceeds its global rank, so every (tier, overflow)
+    bound that held for the unsharded mapping holds per shard — sharding
+    introduces NO new overflow failure mode, and the per-shard shapes are
+    static functions of (node_cap, dense_m, n_shards) only.
+
+    Returns stacked arrays with a leading shard axis, slot indices LOCAL to
+    each shard's edge range: ``in_slots [D, node_cap*dense_m]``,
+    ``in_mask [D, node_cap, dense_m]``, ``over_slots/over_nodes/over_mask
+    [D, over_cap]``.
+    """
+    e_cap = len(neighbors)
+    if e_cap % n_shards:
+        raise ValueError(
+            f"edge capacity {e_cap} not divisible by {n_shards} shards "
+            f"(node_cap must be a multiple of the shard count)"
+        )
+    e_s = e_cap // n_shards
+    parts = [
+        transpose_slots(
+            neighbors[s * e_s : (s + 1) * e_s],
+            edge_real[s * e_s : (s + 1) * e_s],
+            node_cap, dense_m, None, over_cap,
+        )
+        for s in range(n_shards)
+    ]
+    return tuple(np.stack([p[i] for p in parts]) for i in range(5))
+
+
 def pad_batch(
     graphs: Sequence[CrystalGraph],
     graph_cap: int,
@@ -617,6 +689,7 @@ def capacities_for(
     headroom: float = 1.15,
     dense_m: int | None = None,
     snug: bool = False,
+    node_multiple: int = 1,
 ) -> tuple[int, int]:
     """Pick one (node_cap, edge_cap) for a dataset so every shuffled batch
     fits: batch_size * max-per-graph sizes would be safe but wasteful; use
@@ -635,7 +708,20 @@ def capacities_for(
     >=0.97.
 
     With ``dense_m`` the edge capacity is exactly ``node_cap * dense_m``
-    (the dense slot layout, pack_graphs)."""
+    (the dense slot layout, pack_graphs).
+
+    ``node_multiple`` rounds the node capacity up to a multiple (node-strip
+    graph sharding needs ``node_cap`` divisible by the shard count so every
+    shard owns a whole strip; parallel/edge_parallel.py)."""
+    if node_multiple > 1:
+        def _round_caps(nc, ec):
+            nc2 = -(-nc // node_multiple) * node_multiple
+            if dense_m is not None:
+                return nc2, nc2 * dense_m
+            return nc2, ec
+        nc, ec = capacities_for(graphs, batch_size, headroom,
+                                dense_m=dense_m, snug=snug)
+        return _round_caps(nc, ec)
     nodes = np.array([g.num_nodes for g in graphs])
     if snug:
         # balance capacity to the BATCH COUNT: with B = ceil(n/batch_size)
@@ -752,6 +838,8 @@ def bucketed_batch_iterator(
     per_bucket_in_cap: bool = False,
     edge_dtype=np.float32,
     pack_fn=None,
+    node_multiple: int = 1,
+    transpose_shards: int = 1,
 ):
     """Yield batches using per-size-class static capacities.
 
@@ -801,14 +889,15 @@ def bucketed_batch_iterator(
             continue
         sub = [graphs[int(i)] for i in idxs]
         nc, ec = capacities_for(sub, batch_size, headroom, dense_m=dense_m,
-                                snug=snug)
+                                snug=snug, node_multiple=node_multiple)
         b_in_cap = in_cap
         if dense_m is not None and b_in_cap is None and per_bucket_in_cap:
             b_in_cap = in_degree_cap(sub)
         it = batch_iterator(sub, batch_size, nc, ec, shuffle=shuffle, rng=rng,
                             dense_m=dense_m, in_cap=b_in_cap, snug=snug,
                             over_cap=over_cap, edge_dtype=edge_dtype,
-                            pack_fn=pack_fn)
+                            pack_fn=pack_fn,
+                            transpose_shards=transpose_shards)
         iters.append(stats.wrap(it) if stats is not None else it)
         weights.append(float(len(idxs)))
     active = list(range(len(iters)))
@@ -865,6 +954,7 @@ def _pack_overflow_safe(
     over_cap,
     edge_dtype,
     pack_fn=None,
+    transpose_shards: int = 1,
 ):
     """pack_graphs, splitting the batch on a two-tier over_cap overrun.
 
@@ -878,10 +968,12 @@ def _pack_overflow_safe(
     packed).
     """
     pack = pack_fn or pack_graphs
+    kw = {"transpose_shards": transpose_shards} if transpose_shards > 1 \
+        else {}
     try:
         yield pack(bucket, node_cap, edge_cap, graph_cap,
                    dense_m=dense_m, in_cap=in_cap, over_cap=over_cap,
-                   edge_dtype=edge_dtype)
+                   edge_dtype=edge_dtype, **kw)
     except TransposeOverflowError:
         if len(bucket) < 2:
             raise
@@ -895,7 +987,8 @@ def _pack_overflow_safe(
         for half in (bucket[:mid], bucket[mid:]):
             yield from _pack_overflow_safe(
                 half, node_cap, edge_cap, graph_cap, dense_m, in_cap,
-                over_cap, edge_dtype, pack_fn=pack_fn)
+                over_cap, edge_dtype, pack_fn=pack_fn,
+                transpose_shards=transpose_shards)
 
 
 def batch_iterator(
@@ -912,6 +1005,7 @@ def batch_iterator(
     over_cap: int | None = None,
     edge_dtype=np.float32,
     pack_fn=None,
+    transpose_shards: int = 1,
 ):
     """Yield fixed-shape GraphBatches of ``batch_size`` graphs each.
 
@@ -961,7 +1055,8 @@ def batch_iterator(
         ):
             for packed in _pack_overflow_safe(
                     bucket, node_cap, edge_cap, graph_cap, dense_m, in_cap,
-                    over_cap, edge_dtype, pack_fn=pack_fn):
+                    over_cap, edge_dtype, pack_fn=pack_fn,
+                    transpose_shards=transpose_shards):
                 yield invariants.maybe_check(packed, dense_m)
             bucket, nn, ne = [], 0, 0
         bucket.append(g)
@@ -975,5 +1070,6 @@ def batch_iterator(
     if bucket and (not drop_last or len(bucket) >= batch_size):
         for packed in _pack_overflow_safe(
                 bucket, node_cap, edge_cap, graph_cap, dense_m, in_cap,
-                over_cap, edge_dtype, pack_fn=pack_fn):
+                over_cap, edge_dtype, pack_fn=pack_fn,
+                transpose_shards=transpose_shards):
             yield invariants.maybe_check(packed, dense_m)

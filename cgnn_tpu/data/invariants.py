@@ -118,24 +118,62 @@ def check_batch(batch, dense_m: int | None = None):
 
 def _check_transpose_mapping(batch, neighbors, real_e, ncap):
     """The gather_transpose completeness property (flat ``neighbors`` [E]
-    and ``real_e`` [E] bool) — shared by GraphBatch and CompactBatch."""
+    and ``real_e`` [E] bool) — shared by GraphBatch and CompactBatch.
+
+    Per-shard stacked mappings (``in_mask`` [D, N, tier] from
+    shard_transpose_slots, node-strip graph sharding) are validated by
+    converting each shard's LOCAL slot indices back to global ids — each
+    shard must list exactly its own slot range's real edges, and the union
+    must satisfy the same completeness property as the flat mapping."""
     in_mask = np.asarray(batch.in_mask)
-    in_slots = np.asarray(batch.in_slots).reshape(in_mask.shape)
-    if in_mask.shape[0] != ncap:
-        _fail("in_slots/in_mask row count != node capacity")
-    listed = in_slots[in_mask > 0]
-    rows = np.repeat(np.arange(ncap), (in_mask > 0).sum(axis=1))
-    if batch.over_slots is not None:
-        over_slots = np.asarray(batch.over_slots)
-        over_nodes = np.asarray(batch.over_nodes)
-        over_mask = np.asarray(batch.over_mask)
-        chex.assert_shape(over_nodes, over_slots.shape)
-        chex.assert_shape(over_mask, over_slots.shape)
-        if np.any(np.diff(over_nodes) < 0):
-            _fail("over_nodes is not non-decreasing (sorted-scatter "
-                  "promise broken)")
-        listed = np.concatenate([listed, over_slots[over_mask > 0]])
-        rows = np.concatenate([rows, over_nodes[over_mask > 0]])
+    if in_mask.ndim == 3:
+        n_sh = in_mask.shape[0]
+        if len(real_e) % n_sh:
+            _fail("sharded transpose mapping: edge capacity not divisible "
+                  "by the shard count")
+        e_s = len(real_e) // n_sh
+        in_slots = np.asarray(batch.in_slots).reshape(in_mask.shape)
+        listed_parts, row_parts = [], []
+        for s in range(n_sh):
+            lst = in_slots[s][in_mask[s] > 0]
+            if lst.size and (lst.min() < 0 or lst.max() >= e_s):
+                _fail(f"shard {s} transpose mapping lists a slot outside "
+                      f"its local range [0, {e_s})")
+            listed_parts.append(lst + s * e_s)
+            row_parts.append(
+                np.repeat(np.arange(ncap), (in_mask[s] > 0).sum(axis=1)))
+            if batch.over_slots is not None:
+                osl = np.asarray(batch.over_slots)[s]
+                ond = np.asarray(batch.over_nodes)[s]
+                omk = np.asarray(batch.over_mask)[s]
+                if np.any(np.diff(ond) < 0):
+                    _fail(f"shard {s} over_nodes is not non-decreasing")
+                sel = omk > 0
+                if sel.any() and (osl[sel].min() < 0
+                                  or osl[sel].max() >= e_s):
+                    _fail(f"shard {s} overflow lists a slot outside its "
+                          f"local range")
+                listed_parts.append(osl[sel] + s * e_s)
+                row_parts.append(ond[sel])
+        listed = np.concatenate(listed_parts)
+        rows = np.concatenate(row_parts)
+    else:
+        in_slots = np.asarray(batch.in_slots).reshape(in_mask.shape)
+        if in_mask.shape[0] != ncap:
+            _fail("in_slots/in_mask row count != node capacity")
+        listed = in_slots[in_mask > 0]
+        rows = np.repeat(np.arange(ncap), (in_mask > 0).sum(axis=1))
+        if batch.over_slots is not None:
+            over_slots = np.asarray(batch.over_slots)
+            over_nodes = np.asarray(batch.over_nodes)
+            over_mask = np.asarray(batch.over_mask)
+            chex.assert_shape(over_nodes, over_slots.shape)
+            chex.assert_shape(over_mask, over_slots.shape)
+            if np.any(np.diff(over_nodes) < 0):
+                _fail("over_nodes is not non-decreasing (sorted-scatter "
+                      "promise broken)")
+            listed = np.concatenate([listed, over_slots[over_mask > 0]])
+            rows = np.concatenate([rows, over_nodes[over_mask > 0]])
     if listed.size != int(real_e.sum()):
         _fail(
             f"transpose mapping lists {listed.size} edges but the batch "

@@ -127,19 +127,78 @@ class CGConv(nn.Module):
         over_mask: jax.Array | None = None,  # [O]
     ) -> jax.Array:
         f = self.features
-        if self.dense_m is not None and self.edge_axis_name is not None:
-            raise NotImplementedError(
-                "dense layout + edge-sharded parallelism: shard the flat "
-                "layout instead (aggregation_impl='xla')"
-            )
         if self.fused_epilogue is not None and (
             self.dense_m is None or not self.use_batchnorm
+            or self.edge_axis_name is not None
         ):
             raise NotImplementedError(
                 "fused_epilogue requires the dense layout with BatchNorm "
-                "(it fuses the BN1->gate->mask->sum chain)"
+                "(it fuses the BN1->gate->mask->sum chain) and no graph "
+                "sharding"
             )
-        if self.dense_m is not None:
+        if self.dense_m is not None and self.edge_axis_name is not None:
+            # Node-strip sharded dense layout (graph parallelism composed
+            # with the fast path; parallel/edge_parallel.py). Shard s owns
+            # the contiguous node strip [s*N/D, (s+1)*N/D) and — by dense
+            # slot ownership — exactly its [N/D, M] edge slots, so the
+            # per-node message sum is COMPLETE shard-locally (no psum for
+            # aggregation, unlike the COO edge-sharded branch). The one
+            # per-conv collective is the psum of the zero-padded strip
+            # aggregates back to full [N, F] (its transpose distributes the
+            # next conv's cotangent). BN1 moments span shards via
+            # axis_name; BN2 + the residual run on the replicated full
+            # aggregate, bit-identical to the unsharded dense path.
+            axis = self.edge_axis_name
+            m = self.dense_m
+            n_full = nodes.shape[0]
+            fdim = nodes.shape[-1]
+            e = edges.astype(nodes.dtype)
+            if e.ndim == 2:
+                e = e.reshape(-1, m, e.shape[-1])
+            n_strip = e.shape[0]
+            idx = jax.lax.axis_index(axis)
+            # linear_call (gather_transpose) does not insert the implicit
+            # replicated->varying cast standard ops get, so cast explicitly:
+            # the cast's transpose is the psum that completes each shard's
+            # partial [N, F] node cotangent
+            nodes_v = jax.lax.pcast(nodes, axis, to="varying")
+            if in_slots is not None:
+                # per-shard two-tier mappings arrive with a leading
+                # singleton from the shard-stack axis (graph.py
+                # shard_transpose_slots): squeeze to this shard's mapping
+                v_j = gather_transpose(
+                    nodes_v, neighbors, in_slots[0], in_mask[0],
+                    over_slots=None if over_slots is None else over_slots[0],
+                    over_nodes=None if over_nodes is None else over_nodes[0],
+                    over_mask=None if over_mask is None else over_mask[0],
+                ).reshape(n_strip, m, fdim)
+            else:  # eval batches carry no transpose mapping
+                v_j = gather(nodes_v, neighbors).reshape(n_strip, m, fdim)
+            nodes_strip = jax.lax.dynamic_slice_in_dim(
+                nodes, idx * n_strip, n_strip
+            )
+            z = _SplitFcFull(2 * f, dtype=self.dtype, name="fc_full")(
+                nodes_strip, v_j, e
+            )
+            emask = edge_mask.reshape(n_strip, m)
+            if self.use_batchnorm:
+                z = MaskedBatchNorm(
+                    dtype=self.dtype, name="bn1", axis_name=axis
+                )(z, mask=emask, use_running_average=not train)
+            gate, core = jnp.split(z, 2, axis=-1)
+            msg = nn.sigmoid(gate) * nn.softplus(core)
+            # zero cotangent on padding slots — load-bearing for the
+            # scatter-free backward exactly as in the unsharded branch
+            msg = msg * emask[..., None].astype(msg.dtype)
+            agg_strip = msg.sum(axis=1)  # [N/D, F], complete per node
+            agg = jax.lax.psum(
+                jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros((n_full, f), agg_strip.dtype), agg_strip,
+                    idx * n_strip, axis=0,
+                ),
+                axis,
+            )
+        elif self.dense_m is not None:
             m = self.dense_m
             n = nodes.shape[0]
             fdim = nodes.shape[-1]

@@ -96,6 +96,9 @@ def parallel_batches(
     snug: bool = False,
     stats: PaddingStats | None = None,
     edge_dtype=np.float32,
+    prep_fn: Callable | None = None,
+    node_multiple: int = 1,
+    transpose_shards: int = 1,
 ) -> Iterable[GraphBatch]:
     """Yield device-stacked batches: leaves have leading axis [D, ...].
 
@@ -109,21 +112,29 @@ def parallel_batches(
     in a group runs the same compiled shape. At most ``n_devices - 1``
     batches per shape are dropped per training epoch (the per-shape
     drop_last tail).
+
+    ``prep_fn`` transforms each batch before shape-keying/stacking (dense
+    graph sharding attaches per-shard transpose mappings here);
+    ``node_multiple`` rounds bucket-computed node capacities up so strips
+    divide evenly (capacities_for).
     """
     if buckets > 1:
         source = bucketed_batch_iterator(
             graphs, batch_size, buckets, shuffle=shuffle, rng=rng,
             dense_m=dense_m, in_cap=in_cap, snug=snug, stats=stats,
-            edge_dtype=edge_dtype,
+            edge_dtype=edge_dtype, node_multiple=node_multiple,
+            transpose_shards=transpose_shards,
         )
     else:
         source = batch_iterator(
             graphs, batch_size, node_cap, edge_cap, shuffle=shuffle, rng=rng,
             dense_m=dense_m, in_cap=in_cap, snug=snug,
-            edge_dtype=edge_dtype,
+            edge_dtype=edge_dtype, transpose_shards=transpose_shards,
         )
         if stats is not None:
             source = stats.wrap(source)
+    if prep_fn is not None:
+        source = map(prep_fn, source)
     from cgnn_tpu.data import invariants
 
     pending: dict[tuple, list[GraphBatch]] = {}
@@ -332,20 +343,24 @@ def fit_data_parallel(
     if dense_m is not None:
         edge_cap = node_cap * dense_m
     graph_shards = int(mesh.shape.get("graph", 1))
-    if graph_shards > 1 and (buckets > 1 or scan_epochs or profile_steps):
+    if graph_shards > 1 and (scan_epochs or profile_steps):
         raise NotImplementedError(
-            "--buckets/--scan-epochs/--profile are not supported with "
-            "edge-sharded ('graph') meshes; use a pure data mesh"
+            "--scan-epochs/--profile are not supported with edge-sharded "
+            "('graph') meshes; use a pure data mesh"
         )
+    if graph_shards > 1 and buckets > 1 and dense_m is None:
+        raise NotImplementedError(
+            "--buckets with --graph-shards requires the dense layout "
+            "(per-size-class capacities shard by node strips)"
+        )
+    prep_train = prep_val = None
+    node_multiple = 1
+    transpose_shards = 1
     if graph_shards > 1:
-        if dense_m is not None:
-            raise NotImplementedError(
-                "dense layout + graph sharding: use the flat layout "
-                "(dense_m=None) with edge-sharded meshes"
-            )
         from cgnn_tpu.parallel.edge_parallel import (
             make_dp_edge_parallel_eval_step,
             make_dp_edge_parallel_train_step,
+            prepare_dense_sharded,
             shard_stacked_batch,
         )
 
@@ -353,12 +368,31 @@ def fit_data_parallel(
             raise NotImplementedError(
                 "custom step bodies are not supported with graph sharding"
             )
-        # pack at a shard-divisible edge capacity up front (cheaper than
-        # re-padding every batch after the fact)
-        edge_cap = -(-edge_cap // graph_shards) * graph_shards
         n_dev = int(mesh.shape["data"])
-        train_step = make_dp_edge_parallel_train_step(mesh, classification)
-        eval_step = make_dp_edge_parallel_eval_step(mesh, classification)
+        if dense_m is not None:
+            # dense fast path composed with node-strip graph sharding
+            # (VERDICT r4 #3): round node_cap so every shard owns a whole
+            # 8-aligned strip; train batches pack their per-shard
+            # transpose mappings DIRECTLY (pack_graphs transpose_shards —
+            # no pack-then-rebuild on the host critical path), eval
+            # batches drop their mapping fields (prepare_dense_sharded)
+            mult = 8 * graph_shards
+            node_cap = -(-node_cap // mult) * mult
+            edge_cap = node_cap * dense_m
+            node_multiple = mult
+            transpose_shards = graph_shards
+            prep_val = lambda b: prepare_dense_sharded(  # noqa: E731
+                b, graph_shards, train=False)
+            train_step = make_dp_edge_parallel_train_step(
+                mesh, classification, dense=True)
+            eval_step = make_dp_edge_parallel_eval_step(
+                mesh, classification, dense=True)
+        else:
+            # pack at a shard-divisible edge capacity up front (cheaper
+            # than re-padding every batch after the fact)
+            edge_cap = -(-edge_cap // graph_shards) * graph_shards
+            train_step = make_dp_edge_parallel_train_step(mesh, classification)
+            eval_step = make_dp_edge_parallel_eval_step(mesh, classification)
         shard_put = lambda b: shard_stacked_batch(b, mesh)  # noqa: E731
     else:
         n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
@@ -390,6 +424,8 @@ def fit_data_parallel(
             train_graphs, n_dev, batch_size, node_cap, edge_cap,
             shuffle=True, rng=rng, dense_m=dense_m, buckets=buckets,
             snug=snug, stats=pad_stats, edge_dtype=edge_dtype,
+            prep_fn=prep_train, node_multiple=node_multiple,
+            transpose_shards=transpose_shards,
         )
 
     def make_val_it():
@@ -397,6 +433,7 @@ def fit_data_parallel(
             val_graphs, n_dev, batch_size, node_cap, edge_cap,
             pad_incomplete=True, dense_m=dense_m, in_cap=0, buckets=buckets,
             snug=snug, edge_dtype=edge_dtype,
+            prep_fn=prep_val, node_multiple=node_multiple,
         )
 
     driver: ScanEpochDriver | None = None

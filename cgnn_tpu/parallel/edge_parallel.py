@@ -92,6 +92,87 @@ def batch_specs(
     return GraphBatch(**{name: spec(name) for name in _ALL_FIELDS})
 
 
+def dense_batch_specs(
+    graph_axis: str = "graph",
+    data_axis: str | None = None,
+    with_transpose: bool = True,
+) -> GraphBatch:
+    """PartitionSpecs for a DENSE-layout batch under node-strip graph
+    sharding (prepare_dense_sharded): ``edges`` [N, M, G] split over its
+    node-owner axis, the flat per-slot leaves ([E] = [N*M]) split likewise,
+    and the per-shard transpose stacks ([D, ...]) split one-mapping-per-
+    shard. Node leaves stay replicated over ``graph_axis`` — the conv
+    slices its own strip and psums the padded aggregate back to full.
+
+    ``with_transpose=False`` matches eval batches, whose transpose fields
+    are dropped by ``prepare_dense_sharded`` (no backward, no mapping)."""
+    lead = (data_axis,) if data_axis else ()
+
+    def spec(name):
+        if name in _DENSE_ONLY_FIELDS:
+            return P(*lead, graph_axis) if with_transpose else None
+        if name in EDGE_FIELDS:
+            return P(*lead, graph_axis)
+        return P(*lead)
+
+    return GraphBatch(**{name: spec(name) for name in _ALL_FIELDS})
+
+
+def prepare_dense_sharded(
+    batch: GraphBatch, n_shards: int, train: bool = True
+) -> GraphBatch:
+    """Host-side prep of a dense-layout batch for node-strip sharding.
+
+    Training batches get per-shard two-tier transpose mappings
+    (data/graph.py shard_transpose_slots — shard-local slot indices,
+    stacked [D, ...]); eval batches drop their mapping fields entirely
+    (no backward runs, and an empty [N, 0] mapping would force a distinct
+    sharded pytree/spec structure for nothing).
+    """
+    if np.ndim(batch.edges) != 3:
+        raise ValueError(
+            "prepare_dense_sharded expects a dense-layout batch "
+            "(edges pre-shaped [N, M, G]; pack with dense_m)"
+        )
+    ncap = batch.node_capacity
+    if ncap % n_shards:
+        raise ValueError(
+            f"node capacity {ncap} not divisible by {n_shards} graph "
+            f"shards; round node_cap up to a multiple of the shard count"
+        )
+    if not train or batch.in_slots is None:
+        return dataclasses.replace(
+            batch, in_slots=None, in_mask=None, over_slots=None,
+            over_nodes=None, over_mask=None,
+        )
+    if np.ndim(batch.in_mask) == 3:
+        return batch  # already per-shard (pack_graphs transpose_shards)
+    if batch.over_slots is None:
+        # A single-tier mapping carries no overflow capacity, and the
+        # per-shard rebuild is only guaranteed overflow-safe when the cap
+        # came from the batch's own two-tier mapping (per-shard overflow
+        # is a subset of global overflow). A guessed cap could raise
+        # TransposeOverflowError mid-training — refuse instead.
+        raise ValueError(
+            "graph sharding needs the two-tier transpose layout; pack "
+            "with in_cap=None (the default) instead of a single-tier "
+            "in_cap"
+        )
+    from cgnn_tpu.data.graph import shard_transpose_slots
+
+    m = batch.edges.shape[1]
+    in_slots, in_mask, over_slots, over_nodes, over_mask = (
+        shard_transpose_slots(
+            np.asarray(batch.neighbors), np.asarray(batch.edge_mask) > 0,
+            ncap, m, n_shards, len(batch.over_slots),
+        )
+    )
+    return dataclasses.replace(
+        batch, in_slots=in_slots, in_mask=in_mask, over_slots=over_slots,
+        over_nodes=over_nodes, over_mask=over_mask,
+    )
+
+
 def shard_batch(
     batch: GraphBatch,
     mesh: Mesh,
@@ -100,8 +181,16 @@ def shard_batch(
 ):
     """device_put a batch with edge leaves split over the graph axis (and,
     when ``data_axis`` is given, every leaf's leading stacked-device axis
-    split over it)."""
-    specs = batch_specs(graph_axis=graph_axis, data_axis=data_axis)
+    split over it). Dense-layout batches ([N, M, G] edges, optionally
+    prepared by ``prepare_dense_sharded``) get the dense spec set."""
+    dense_rank = 4 if data_axis else 3
+    if np.ndim(batch.edges) == dense_rank:
+        specs = dense_batch_specs(
+            graph_axis=graph_axis, data_axis=data_axis,
+            with_transpose=batch.in_slots is not None,
+        )
+    else:
+        specs = batch_specs(graph_axis=graph_axis, data_axis=data_axis)
 
     def put(x, s):
         return jax.device_put(x, NamedSharding(mesh, s))
@@ -111,23 +200,36 @@ def shard_batch(
     )
 
 
+def _specs(graph_axis, data_axis=None, dense=False, with_transpose=True):
+    """Spec pytree for COO (batch_specs) or dense (dense_batch_specs)."""
+    if dense:
+        return dense_batch_specs(
+            graph_axis=graph_axis, data_axis=data_axis,
+            with_transpose=with_transpose,
+        )
+    return batch_specs(graph_axis=graph_axis, data_axis=data_axis)
+
+
 def make_edge_parallel_train_step(
     mesh: Mesh,
     classification: bool = False,
     graph_axis: str = "graph",
+    dense: bool = False,
 ) -> Callable:
     """(replicated state, edge-sharded batch) -> (state, metrics).
 
     The model inside ``state.apply_fn`` must be built with
-    ``edge_axis_name=graph_axis``. Replication checking stays ON so the
-    parameter-gradient psum over the graph axis is inserted by transpose.
+    ``edge_axis_name=graph_axis`` (and, for ``dense=True``, the matching
+    ``dense_m``; batches via ``prepare_dense_sharded``). Replication
+    checking stays ON so the parameter-gradient psum over the graph axis
+    is inserted by transpose.
     """
     inner = make_train_step(classification)
 
     smapped = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(P(), batch_specs(graph_axis=graph_axis)),
+        in_specs=(P(), _specs(graph_axis, dense=dense)),
         out_specs=(P(), P()),
     )
     return jax.jit(smapped, donate_argnums=0)
@@ -137,12 +239,13 @@ def make_edge_parallel_eval_step(
     mesh: Mesh,
     classification: bool = False,
     graph_axis: str = "graph",
+    dense: bool = False,
 ) -> Callable:
     inner = make_eval_step(classification)
     smapped = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(P(), batch_specs(graph_axis=graph_axis)),
+        in_specs=(P(), _specs(graph_axis, dense=dense, with_transpose=False)),
         out_specs=P(),
     )
     return jax.jit(smapped)
@@ -153,6 +256,7 @@ def make_dp_edge_parallel_train_step(
     classification: bool = False,
     data_axis: str = "data",
     graph_axis: str = "graph",
+    dense: bool = False,
 ) -> Callable:
     """2-D mesh step: batches stacked over 'data', edges sharded over
     'graph' within each data shard. Input leaves: [D, ...] with edge leaves
@@ -181,7 +285,7 @@ def make_dp_edge_parallel_train_step(
     smapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), batch_specs(graph_axis=graph_axis, data_axis=data_axis)),
+        in_specs=(P(), _specs(graph_axis, data_axis, dense=dense)),
         out_specs=(P(), P()),
     )
     return jax.jit(smapped, donate_argnums=0)
@@ -193,6 +297,7 @@ def make_dp_edge_parallel_eval_step(
     loss_fn: Callable | None = None,
     data_axis: str = "data",
     graph_axis: str = "graph",
+    dense: bool = False,
 ) -> Callable:
     """2-D mesh eval step: metrics psum over 'data' (each graph shard
     computes identical metrics after the model's psum over 'graph')."""
@@ -206,7 +311,8 @@ def make_dp_edge_parallel_eval_step(
     smapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), batch_specs(graph_axis=graph_axis, data_axis=data_axis)),
+        in_specs=(P(), _specs(graph_axis, data_axis, dense=dense,
+                              with_transpose=False)),
         out_specs=P(),
     )
     return jax.jit(smapped)
@@ -219,7 +325,8 @@ def shard_stacked_batch(
     graph_axis: str = "graph",
 ):
     """device_put a [D, ...]-stacked batch onto a 2-D mesh: leading axis over
-    'data', edge leaves additionally split over 'graph'."""
+    'data', edge leaves additionally split over 'graph' (dense-layout
+    batches — edges stacked [D, N, M, G] — get the dense spec set)."""
     return shard_batch(
         stacked, mesh, graph_axis=graph_axis, data_axis=data_axis
     )

@@ -172,10 +172,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "hits on re-runs")
     p.add_argument("--layout", choices=["auto", "dense", "coo"], default="auto",
                    help="edge batch layout: 'dense' (node-major slots, "
-                        "scatter-free aggregation — ~2x faster on TPU) or "
-                        "'coo' (flat edge list). Default: dense when "
-                        "compatible (regression/classification, no "
-                        "--graph-shards, no --aggregation override)")
+                        "scatter-free aggregation — ~2x faster on TPU; "
+                        "composes with --graph-shards via node-strip "
+                        "sharding) or 'coo' (flat edge list). Default: "
+                        "dense unless --aggregation overrides the backend")
     return p
 
 
@@ -354,16 +354,19 @@ def main(argv=None) -> int:
     # force differentiation composes (ops/segment.py), parity is pinned to
     # training-step gradients (tests/test_forces.py), and the bench
     # measures dense at 1.59x COO on the force workload (BENCH r4).
-    dense_ok = args.graph_shards <= 1 and args.aggregation is None
+    dense_ok = args.aggregation is None
     if args.layout == "dense" and not dense_ok:
-        print("--layout dense is incompatible with --graph-shards and "
-              "--aggregation", file=sys.stderr)
+        print("--layout dense is incompatible with --aggregation",
+              file=sys.stderr)
         return 2
     use_dense = dense_ok if args.layout == "auto" else args.layout == "dense"
     dense_m = args.max_num_nbr if use_dense else 0
-    if args.fused_epilogue != "off" and (not use_dense or force_task):
+    if args.fused_epilogue != "off" and (
+        not use_dense or force_task or args.graph_shards > 1
+    ):
         print("--fused-epilogue requires the dense layout with BatchNorm "
-              "(not --layout coo / --task force)", file=sys.stderr)
+              "and no graph sharding (not --layout coo / --task force / "
+              "--graph-shards)", file=sys.stderr)
         return 2
 
     model_cfg = ModelConfig(
@@ -487,11 +490,13 @@ def main(argv=None) -> int:
 
         mesh = None
         fit_state = state
-        if graph_shards > 1 and (
-            args.buckets > 1 or args.scan_epochs or args.profile
-        ):
-            print("--buckets/--scan-epochs/--profile are not supported with "
+        if graph_shards > 1 and (args.scan_epochs or args.profile):
+            print("--scan-epochs/--profile are not supported with "
                   "--graph-shards (edge-sharded meshes)", file=sys.stderr)
+            return 2
+        if graph_shards > 1 and args.buckets > 1 and not use_dense:
+            print("--buckets with --graph-shards requires the dense layout "
+                  "(drop --layout coo)", file=sys.stderr)
             return 2
         if graph_shards > 1:
             # edge-sharded model: same params, psum over 'graph' per conv;
