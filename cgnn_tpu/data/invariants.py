@@ -125,55 +125,65 @@ def _check_transpose_mapping(batch, neighbors, real_e, ncap):
     converting each shard's LOCAL slot indices back to global ids — each
     shard must list exactly its own slot range's real edges, and the union
     must satisfy the same completeness property as the flat mapping."""
+    def collect(in_slots, in_mask, over, ncap, slot_range, offset, tag):
+        """One mapping's (listed global slot ids, neighbor rows) — the
+        SHARED collector for the flat mapping (offset 0, full slot range)
+        and each shard of a per-shard stack (local range + shard offset),
+        so the completeness contract cannot diverge between the two."""
+        if in_mask.shape[0] != ncap:
+            _fail(f"{tag}in_slots/in_mask row count != node capacity")
+        lst = in_slots.reshape(in_mask.shape)[in_mask > 0]
+        if lst.size and (lst.min() < 0 or lst.max() >= slot_range):
+            _fail(f"{tag}transpose mapping lists a slot outside its "
+                  f"range [0, {slot_range})")
+        parts = [lst + offset]
+        rows = [np.repeat(np.arange(ncap), (in_mask > 0).sum(axis=1))]
+        if over is not None:
+            osl, ond, omk = over
+            chex.assert_shape(ond, osl.shape)
+            chex.assert_shape(omk, osl.shape)
+            if np.any(np.diff(ond) < 0):
+                _fail(f"{tag}over_nodes is not non-decreasing "
+                      f"(sorted-scatter promise broken)")
+            sel = omk > 0
+            if sel.any() and (osl[sel].min() < 0
+                              or osl[sel].max() >= slot_range):
+                _fail(f"{tag}overflow lists a slot outside its range")
+            parts.append(osl[sel] + offset)
+            rows.append(ond[sel])
+        return parts, rows
+
     in_mask = np.asarray(batch.in_mask)
+    over_all = (
+        None if batch.over_slots is None
+        else (np.asarray(batch.over_slots), np.asarray(batch.over_nodes),
+              np.asarray(batch.over_mask))
+    )
     if in_mask.ndim == 3:
         n_sh = in_mask.shape[0]
         if len(real_e) % n_sh:
             _fail("sharded transpose mapping: edge capacity not divisible "
                   "by the shard count")
         e_s = len(real_e) // n_sh
-        in_slots = np.asarray(batch.in_slots).reshape(in_mask.shape)
+        in_slots = np.asarray(batch.in_slots).reshape(n_sh, -1)
         listed_parts, row_parts = [], []
         for s in range(n_sh):
-            lst = in_slots[s][in_mask[s] > 0]
-            if lst.size and (lst.min() < 0 or lst.max() >= e_s):
-                _fail(f"shard {s} transpose mapping lists a slot outside "
-                      f"its local range [0, {e_s})")
-            listed_parts.append(lst + s * e_s)
-            row_parts.append(
-                np.repeat(np.arange(ncap), (in_mask[s] > 0).sum(axis=1)))
-            if batch.over_slots is not None:
-                osl = np.asarray(batch.over_slots)[s]
-                ond = np.asarray(batch.over_nodes)[s]
-                omk = np.asarray(batch.over_mask)[s]
-                if np.any(np.diff(ond) < 0):
-                    _fail(f"shard {s} over_nodes is not non-decreasing")
-                sel = omk > 0
-                if sel.any() and (osl[sel].min() < 0
-                                  or osl[sel].max() >= e_s):
-                    _fail(f"shard {s} overflow lists a slot outside its "
-                          f"local range")
-                listed_parts.append(osl[sel] + s * e_s)
-                row_parts.append(ond[sel])
+            parts, rows_s = collect(
+                in_slots[s], in_mask[s],
+                None if over_all is None else tuple(x[s] for x in over_all),
+                ncap, e_s, s * e_s, f"shard {s} ",
+            )
+            listed_parts += parts
+            row_parts += rows_s
         listed = np.concatenate(listed_parts)
         rows = np.concatenate(row_parts)
     else:
-        in_slots = np.asarray(batch.in_slots).reshape(in_mask.shape)
-        if in_mask.shape[0] != ncap:
-            _fail("in_slots/in_mask row count != node capacity")
-        listed = in_slots[in_mask > 0]
-        rows = np.repeat(np.arange(ncap), (in_mask > 0).sum(axis=1))
-        if batch.over_slots is not None:
-            over_slots = np.asarray(batch.over_slots)
-            over_nodes = np.asarray(batch.over_nodes)
-            over_mask = np.asarray(batch.over_mask)
-            chex.assert_shape(over_nodes, over_slots.shape)
-            chex.assert_shape(over_mask, over_slots.shape)
-            if np.any(np.diff(over_nodes) < 0):
-                _fail("over_nodes is not non-decreasing (sorted-scatter "
-                      "promise broken)")
-            listed = np.concatenate([listed, over_slots[over_mask > 0]])
-            rows = np.concatenate([rows, over_nodes[over_mask > 0]])
+        parts, rows_p = collect(
+            np.asarray(batch.in_slots), in_mask, over_all, ncap,
+            len(real_e), 0, "",
+        )
+        listed = np.concatenate(parts)
+        rows = np.concatenate(rows_p)
     if listed.size != int(real_e.sum()):
         _fail(
             f"transpose mapping lists {listed.size} edges but the batch "

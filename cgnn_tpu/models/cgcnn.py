@@ -165,7 +165,17 @@ class CGConv(nn.Module):
             if in_slots is not None:
                 # per-shard two-tier mappings arrive with a leading
                 # singleton from the shard-stack axis (graph.py
-                # shard_transpose_slots): squeeze to this shard's mapping
+                # shard_transpose_slots): squeeze to this shard's mapping.
+                # A non-singleton means the mapping was built for a
+                # different shard count than this mesh — [0] would then
+                # silently drop cotangents, so refuse at trace time.
+                if in_slots.shape[0] != 1:
+                    raise ValueError(
+                        f"per-shard transpose mapping was built for "
+                        f"{in_slots.shape[0]}x this mesh's graph-shard "
+                        f"count (pack with transpose_shards == the mesh's "
+                        f"'graph' axis size)"
+                    )
                 v_j = gather_transpose(
                     nodes_v, neighbors, in_slots[0], in_mask[0],
                     over_slots=None if over_slots is None else over_slots[0],

@@ -146,7 +146,15 @@ def prepare_dense_sharded(
             over_nodes=None, over_mask=None,
         )
     if np.ndim(batch.in_mask) == 3:
-        return batch  # already per-shard (pack_graphs transpose_shards)
+        # already per-shard (pack_graphs transpose_shards) — but ONLY for
+        # the same shard count: a 4-shard mapping split over a 2-way mesh
+        # would drop half the cotangents with no shape error
+        if batch.in_mask.shape[0] != n_shards:
+            raise ValueError(
+                f"batch carries a {batch.in_mask.shape[0]}-shard transpose "
+                f"mapping but {n_shards} graph shards were requested"
+            )
+        return batch
     if batch.over_slots is None:
         # A single-tier mapping carries no overflow capacity, and the
         # per-shard rebuild is only guaranteed overflow-safe when the cap
