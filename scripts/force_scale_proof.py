@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Force-task scale proof (BASELINE config #5 at scale; VERDICT r4 #8).
+
+Real MD17 data is unavailable offline, so this exercises the FULL force
+pipeline at MD17 scale with synthetic LJ trajectories (the same potential
+tests/test_forces.py fits): several independent trajectories of different
+molecule sizes, leak-aware whole-trajectory splits, the dense edge-slot
+layout with the linear_call two-tier transpose under the second-order
+force objective, snug packing, size-class buckets, and the scan epoch
+driver — the exact composition `train.py --task force --scan-epochs`
+runs. Records the force-MAE convergence curve AND end-to-end throughput
+in one artifact (config #2's SCALE_PROOF_MP146K.json, for the force task).
+
+MD17's headline sets are 50k-600k frames of 9-21-atom molecules, with
+train/test drawn from the SAME molecule's trajectory — a per-molecule
+fit, not cross-molecule transfer. The default here matches that: TWO
+long per-molecule trajectories (12- and 16-atom LJ systems, 25k frames
+each), which the leak-aware splitter divides into contiguous time blocks
+within each trajectory (train on early frames, validate/test on later
+ones — adjacent-frame leakage excluded by block contiguity).
+--trajectories >= 3 switches to whole-trajectory splits, which makes it
+a (much harder) cross-molecule transfer task.
+
+Prints one JSON line (FORCE_SCALE_PROOF.json via --out).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--frames", type=int, default=50_000)
+    p.add_argument("--trajectories", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--buckets", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--device", choices=["auto", "cpu"], default="auto")
+    p.add_argument("--no-scan", action="store_true",
+                   help="per-step loop instead of the scan epoch driver")
+    p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache")
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    compile_cache_warm = False
+    if args.compile_cache:
+        compile_cache_warm = bool(os.path.isdir(args.compile_cache)
+                                  and os.listdir(args.compile_cache))
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+    import numpy as np
+
+    from cgnn_tpu.data.dataset import FeaturizeConfig, load_trajectory
+    from cgnn_tpu.data.trajectory import split_trajectory_groups
+    from cgnn_tpu.models.forcefield import ForceFieldCGCNN
+    from cgnn_tpu.train import (
+        Normalizer,
+        create_train_state,
+        fit,
+        make_optimizer,
+    )
+    from cgnn_tpu.train.force_step import (
+        make_force_eval_step,
+        make_force_train_step,
+    )
+
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+
+    # ---- stage 1: generate + featurize (timed) ------------------------
+    t0 = time.perf_counter()
+    per_traj = args.frames // args.trajectories
+    sizes = ([12, 16] if args.trajectories == 2
+             else [8 + 2 * (t % 7) for t in range(args.trajectories)])
+    groups = []
+    for t in range(args.trajectories):
+        grp = load_trajectory(per_traj, cfg, seed=100 + t,
+                              num_atoms=sizes[t])
+        for g in grp:
+            g.cif_id = f"traj{t}/{g.cif_id}"
+        groups.append(grp)
+    featurize_s = time.perf_counter() - t0
+    n_frames = sum(len(g) for g in groups)
+
+    # ---- stage 2: leak-aware split (contiguous time blocks within each
+    # trajectory at the default --trajectories 2; whole trajectories per
+    # split from 3 up — see module docstring) ---------------------------
+    train_g, val_g, test_g = split_trajectory_groups(
+        groups, 0.8, 0.1, seed=args.seed
+    )
+
+    # label scale, so the MAE numbers are interpretable: predicting zero
+    # force scores ~force_label_mean_abs; a fitted model must land well
+    # below it
+    all_f = np.concatenate([g.forces for grp in groups for g in grp])
+    force_label_stats = {
+        "mean_abs": round(float(np.abs(all_f).mean()), 4),
+        "std": round(float(all_f.std()), 4),
+    }
+
+    # ---- stage 3: train (end-to-end timed per epoch) ------------------
+    model = ForceFieldCGCNN(atom_fea_len=64, n_conv=3, h_fea_len=64,
+                            dmin=cfg.dmin, dmax=cfg.radius, step=cfg.step,
+                            dense_m=cfg.max_num_nbr)
+    tx = make_optimizer(optim="adam", lr=1e-3, lr_milestones=[10**9])
+    normalizer = Normalizer.fit(np.stack([g.target for g in train_g]))
+
+    from cgnn_tpu.data.graph import batch_iterator, capacities_for
+
+    nc, ec = capacities_for(train_g, args.batch_size,
+                            dense_m=cfg.max_num_nbr, snug=True)
+    example = next(batch_iterator(train_g, args.batch_size, nc, ec,
+                                  dense_m=cfg.max_num_nbr, snug=True))
+    state = create_train_state(model, example, tx, normalizer,
+                               rng=jax.random.key(args.seed))
+
+    epoch_s: list[float] = []
+    curve: list[dict] = []
+    last = [time.perf_counter()]
+
+    def on_metrics(epoch, train_m, val_m):
+        now = time.perf_counter()
+        epoch_s.append(round(now - last[0], 1))
+        last[0] = now
+        curve.append({
+            "epoch": epoch,
+            "train_loss": round(float(train_m.get("loss", np.nan)), 5),
+            "val_force_mae": round(float(val_m.get("force_mae", np.nan)), 5),
+            "val_energy_mae": round(float(val_m.get("mae", np.nan)), 5),
+        })
+
+    state, result = fit(
+        state, train_g, val_g, epochs=args.epochs,
+        batch_size=args.batch_size, node_cap=nc, edge_cap=ec,
+        seed=args.seed, print_freq=0,
+        train_step_fn=make_force_train_step(),
+        eval_step_fn=make_force_eval_step(),
+        best_metric="force_mae", buckets=args.buckets, snug=True,
+        dense_m=cfg.max_num_nbr, scan_epochs=not args.no_scan,
+        on_epoch_metrics=on_metrics,
+    )
+
+    # ---- stage 4: held-out test force MAE -----------------------------
+    from cgnn_tpu.train.loop import run_epoch
+
+    eval_jit = jax.jit(make_force_eval_step())
+    _, test_m = run_epoch(
+        eval_jit, state,
+        batch_iterator(test_g, args.batch_size, nc, ec,
+                       dense_m=cfg.max_num_nbr, snug=True, in_cap=0),
+        train=False, log_fn=lambda *a, **k: None,
+    )
+
+    steady = sorted(epoch_s[1:])[len(epoch_s[1:]) // 2] if len(epoch_s) > 1 \
+        else epoch_s[0]
+    out = {
+        "metric": "force_scale_proof",
+        "n_frames": n_frames,
+        "n_train": len(train_g),
+        "trajectories": args.trajectories,
+        "atoms_per_frame": sizes,
+        "epochs": args.epochs,
+        "batch_size": args.batch_size,
+        "buckets": args.buckets,
+        "scan_epochs": not args.no_scan,
+        "layout": "dense",
+        "featurize_s": round(featurize_s, 1),
+        "epoch_s": epoch_s,
+        "steady_epoch_s": steady,
+        "end_to_end_frames_per_sec": round(len(train_g) / steady, 1),
+        "curve": curve,
+        "force_label_stats": force_label_stats,
+        "best_val_force_mae": round(float(result["best"]), 5),
+        "test_force_mae": round(float(test_m.get("force_mae", np.nan)), 5),
+        "test_energy_mae": round(float(test_m.get("mae", np.nan)), 5),
+        "compile_cache_warm": compile_cache_warm,
+        "device": getattr(jax.devices()[0], "device_kind",
+                          jax.devices()[0].platform),
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

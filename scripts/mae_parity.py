@@ -286,6 +286,10 @@ def main(argv=None) -> int:
     mean_torch = float(np.mean([r["torch_test_mae"] for r in runs]))
     mean_jax = float(np.mean([r["jax_test_mae"] for r in runs]))
     ratio = mean_jax / mean_torch
+    # per-seed ratio band: the pooled ratio alone invites over-reading a
+    # lucky 2-3-seed draw as superiority (VERDICT r4 weak #3) — report
+    # mean +/- sample std so the claim strength is visible in the artifact
+    per_seed = [r["jax_test_mae"] / r["torch_test_mae"] for r in runs]
     print(json.dumps({
         "metric": "formation_energy_mae_parity",
         "dataset": args.dataset,
@@ -293,6 +297,11 @@ def main(argv=None) -> int:
         "torch_oracle_test_mae": round(mean_torch, 5),
         "jax_test_mae": round(mean_jax, 5),
         "ratio": round(ratio, 4),
+        "per_seed_ratios": [round(r, 4) for r in per_seed],
+        "ratio_mean": round(float(np.mean(per_seed)), 4),
+        "ratio_std": round(
+            float(np.std(per_seed, ddof=1)) if len(per_seed) > 1 else 0.0,
+            4),
         "repeats": args.repeats,
         "runs": runs,
         "n_structures": len(full),
