@@ -350,10 +350,16 @@ def main() -> None:
                 "atoms_per_sec": mp["atoms_per_sec"],
                 "mfu": mp["mfu"],
                 # production ScanEpochDriver at bench scale, per-epoch
-                # metric semantics (residual vs the sync-free step loop is
-                # one link round trip per epoch — SCAN_COST.json)
+                # metric semantics. The ratio's denominator is THIS
+                # bench's best-of-3 step rate — a different (stricter)
+                # baseline than SCAN_COST.json's sync-free in-process
+                # loop, which is why the two artifacts' ratios differ by
+                # construction (r4 weak #4); the key now names its
+                # denominator so the same-named-quantity ambiguity is
+                # gone. The physical residual is one link round trip per
+                # epoch either way (SCAN_COST.json breakdown).
                 "epoch_driver_structs_per_sec": round(epoch_rate, 1),
-                "epoch_driver_vs_step": round(
+                "epoch_driver_vs_best_step_bench": round(
                     epoch_rate / max(value, 1.0), 3),
                 # forward-only inference (predict.py fast path): device
                 # rate over staged batches (train-bench convention) and
