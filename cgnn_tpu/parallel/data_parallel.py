@@ -343,10 +343,10 @@ def fit_data_parallel(
     if dense_m is not None:
         edge_cap = node_cap * dense_m
     graph_shards = int(mesh.shape.get("graph", 1))
-    if graph_shards > 1 and (scan_epochs or profile_steps):
+    if graph_shards > 1 and profile_steps:
         raise NotImplementedError(
-            "--scan-epochs/--profile are not supported with edge-sharded "
-            "('graph') meshes; use a pure data mesh"
+            "--profile is not supported with edge-sharded ('graph') "
+            "meshes; use a pure data mesh"
         )
     if graph_shards > 1 and buckets > 1 and dense_m is None:
         raise NotImplementedError(
@@ -451,15 +451,57 @@ def fit_data_parallel(
 
         train_list = list(make_train_it())
         val_list = list(make_val_it())
-        staged_bytes = staged_nbytes(train_list + val_list)
-        # the stacked [D, ...] device axis shards over the mesh, so the
-        # per-device share is total / n_dev
-        if check_device_resident_fit(staged_bytes, n_devices=n_dev,
-                                     log_fn=log_fn):
+        # per-device share for the precheck: the stacked device axis
+        # splits everything over the data shards; under graph sharding
+        # the edge leaves (the dominant bytes: [N, M, G] stacks and the
+        # per-shard transpose mappings) additionally split over 'graph',
+        # while node/graph leaves replicate across it — dividing the
+        # whole total by data shards alone would overestimate the share
+        # by up to graph_shards x and spuriously kick sharded runs off
+        # the scan fast path
+        if graph_shards > 1:
+            import dataclasses as _dc
+
+            from cgnn_tpu.parallel.edge_parallel import (
+                _DENSE_ONLY_FIELDS,
+                EDGE_FIELDS,
+            )
+
+            sharded_fields = set(EDGE_FIELDS) | set(_DENSE_ONLY_FIELDS)
+            e_bytes = o_bytes = 0
+            for b in train_list + val_list:
+                for f in _dc.fields(b):
+                    x = getattr(b, f.name)
+                    if x is None:
+                        continue
+                    if f.name in sharded_fields:
+                        e_bytes += x.nbytes
+                    else:
+                        o_bytes += x.nbytes
+            per_device = (e_bytes / (n_dev * graph_shards)
+                          + o_bytes / n_dev)
+            fits = check_device_resident_fit(int(per_device), n_devices=1,
+                                             log_fn=log_fn)
+        else:
+            staged_bytes = staged_nbytes(train_list + val_list)
+            fits = check_device_resident_fit(staged_bytes, n_devices=n_dev,
+                                             log_fn=log_fn)
+        if fits:
+            if graph_shards > 1:
+                # 2-D staging: edge leaves + per-shard transpose stacks
+                # split over 'graph' inside each data shard; the scan
+                # body's dynamic index preserves the inner shardings, so
+                # the shard_map step sees the per-step path's layout
+                from cgnn_tpu.parallel.edge_parallel import (
+                    shard_scan_stack_2d,
+                )
+
+                stage = lambda t: shard_scan_stack_2d(t, mesh)  # noqa: E731
+            else:
+                stage = lambda t: shard_scan_stack(t, mesh)  # noqa: E731
             driver = ScanEpochDriver(
                 train_step, eval_step, train_list, val_list,
-                rng, stage=lambda t: shard_scan_stack(t, mesh),
-                chunk_steps=chunk_steps,
+                rng, stage=stage, chunk_steps=chunk_steps,
             )
         else:
             # loud fallback (see check_device_resident_fit): host-side

@@ -181,6 +181,36 @@ def prepare_dense_sharded(
     )
 
 
+def _auto_specs(
+    batch: GraphBatch,
+    graph_axis: str,
+    data_axis: str | None,
+    dense_rank: int,
+) -> GraphBatch:
+    """The ONE dense/COO spec dispatch: dense layouts are detected by the
+    edges leaf's rank (``dense_rank`` = 3 + one per leading stack axis),
+    and dense batches' transpose fields follow their presence (train
+    batches carry per-shard mappings, eval batches dropped theirs)."""
+    if np.ndim(batch.edges) == dense_rank:
+        return dense_batch_specs(
+            graph_axis=graph_axis, data_axis=data_axis,
+            with_transpose=batch.in_slots is not None,
+        )
+    return batch_specs(graph_axis=graph_axis, data_axis=data_axis)
+
+
+def _put_specs(tree, mesh: Mesh, specs, prefix: tuple = ()):
+    """device_put every leaf per its spec, with ``prefix`` axes prepended
+    (the scan staging's replicated step axis)."""
+
+    def put(x, s):
+        return jax.device_put(x, NamedSharding(mesh, P(*prefix, *s)))
+
+    return jax.tree_util.tree_map(
+        put, tree, specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
 def shard_batch(
     batch: GraphBatch,
     mesh: Mesh,
@@ -191,21 +221,9 @@ def shard_batch(
     when ``data_axis`` is given, every leaf's leading stacked-device axis
     split over it). Dense-layout batches ([N, M, G] edges, optionally
     prepared by ``prepare_dense_sharded``) get the dense spec set."""
-    dense_rank = 4 if data_axis else 3
-    if np.ndim(batch.edges) == dense_rank:
-        specs = dense_batch_specs(
-            graph_axis=graph_axis, data_axis=data_axis,
-            with_transpose=batch.in_slots is not None,
-        )
-    else:
-        specs = batch_specs(graph_axis=graph_axis, data_axis=data_axis)
-
-    def put(x, s):
-        return jax.device_put(x, NamedSharding(mesh, s))
-
-    return jax.tree_util.tree_map(
-        put, batch, specs, is_leaf=lambda x: isinstance(x, P)
-    )
+    specs = _auto_specs(batch, graph_axis, data_axis,
+                        dense_rank=4 if data_axis else 3)
+    return _put_specs(batch, mesh, specs)
 
 
 def _specs(graph_axis, data_axis=None, dense=False, with_transpose=True):
@@ -338,3 +356,24 @@ def shard_stacked_batch(
     return shard_batch(
         stacked, mesh, graph_axis=graph_axis, data_axis=data_axis
     )
+
+
+def shard_scan_stack_2d(
+    tree: GraphBatch,
+    mesh: Mesh,
+    data_axis: str = "data",
+    graph_axis: str = "graph",
+):
+    """device_put a STACK of device-stacked batches ([B, D, ...] leaves)
+    onto a ('data','graph') mesh — the ScanEpochDriver staging for
+    graph-sharded runs (the 2-D twin of data_parallel.shard_scan_stack).
+
+    Axis 0 is the scan/step axis (replicated); axis 1 the data-device
+    axis; edge leaves and per-shard transpose stacks additionally split
+    over 'graph' on their own axes. The scan body's dynamic index along
+    axis 0 preserves the inner shardings, so the shard_map step inside
+    the scan sees exactly the per-step path's layout. COO stacks
+    ([B, D, E, G] edges) and dense stacks ([B, D, N, M, G]) are
+    distinguished by rank, like shard_batch."""
+    specs = _auto_specs(tree, graph_axis, data_axis, dense_rank=5)
+    return _put_specs(tree, mesh, specs, prefix=(None,))

@@ -321,6 +321,126 @@ def test_fit_dense_graph_sharded_buckets_snug_trains():
     assert h[-1]["train_loss"] < h[0]["train_loss"]
 
 
+def test_fit_dense_graph_sharded_scan_matches_per_step():
+    """ScanEpochDriver composes with graph sharding (r5): on the same
+    ('data','graph') mesh, the scan path reproduces the per-step
+    device-resident path exactly (single shape group, same seed)."""
+    from cgnn_tpu.parallel.data_parallel import fit_data_parallel
+    from cgnn_tpu.parallel.mesh import make_2d_mesh
+
+    graphs = load_synthetic(
+        96, FeaturizeConfig(radius=5.0, max_num_nbr=8), seed=0
+    )
+    train_g, val_g = graphs[:80], graphs[80:]
+    targets = np.stack([g.target for g in train_g])
+    tx = make_optimizer(optim="sgd", lr=0.02, lr_milestones=[100])
+    nc, ec = capacities_for(train_g, 4, dense_m=8, snug=True,
+                            node_multiple=16)
+    batch = next(batch_iterator(train_g, 4, nc, ec, dense_m=8, snug=True))
+    model_ref = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=16,
+                                    dense_m=8)
+    model_gp = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=16,
+                                   dense_m=8, edge_axis_name="graph")
+
+    def fresh():
+        return create_train_state(
+            model_ref, batch, tx, Normalizer.fit(targets),
+            rng=jax.random.key(0),
+        ).replace(apply_fn=model_gp.apply)
+
+    quiet = lambda *a, **k: None  # noqa: E731
+    mesh = make_2d_mesh(2, data_shards=4)
+    _, r1 = fit_data_parallel(
+        fresh(), train_g, val_g, epochs=2, batch_size=4, node_cap=nc,
+        edge_cap=ec, seed=5, mesh=mesh, log_fn=quiet, snug=True,
+        dense_m=8, device_resident=True,
+    )
+    _, r2 = fit_data_parallel(
+        fresh(), train_g, val_g, epochs=2, batch_size=4, node_cap=nc,
+        edge_cap=ec, seed=5, mesh=mesh, log_fn=quiet, snug=True,
+        dense_m=8, scan_epochs=True,
+    )
+    for e1, e2 in zip(r1["history"], r2["history"]):
+        assert e1["train_loss"] == pytest.approx(e2["train_loss"], rel=1e-5)
+        assert e1["val"]["mae"] == pytest.approx(e2["val"]["mae"], rel=1e-5)
+
+
+def test_fit_coo_graph_sharded_scan_matches_per_step():
+    """The COO layout's graph-sharded runs also take the scan path now
+    (train.py's device-resident scan default applies to --layout coo
+    too): scan == per-step on the same 2-D mesh."""
+    from cgnn_tpu.parallel.data_parallel import fit_data_parallel
+    from cgnn_tpu.parallel.mesh import make_2d_mesh
+
+    graphs = load_synthetic(
+        64, FeaturizeConfig(radius=5.0, max_num_nbr=8), seed=0
+    )
+    train_g, val_g = graphs[:48], graphs[48:]
+    targets = np.stack([g.target for g in train_g])
+    tx = make_optimizer(optim="sgd", lr=0.02, lr_milestones=[100])
+    nc, ec = capacities_for(train_g, 4)
+    batch = next(batch_iterator(train_g, 4, nc, ec))
+    model_ref = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=16)
+    model_gp = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=16,
+                                   edge_axis_name="graph")
+
+    def fresh():
+        return create_train_state(
+            model_ref, batch, tx, Normalizer.fit(targets),
+            rng=jax.random.key(0),
+        ).replace(apply_fn=model_gp.apply)
+
+    quiet = lambda *a, **k: None  # noqa: E731
+    mesh = make_2d_mesh(2, data_shards=4)
+    _, r1 = fit_data_parallel(
+        fresh(), train_g, val_g, epochs=2, batch_size=4, node_cap=nc,
+        edge_cap=ec, seed=5, mesh=mesh, log_fn=quiet,
+        device_resident=True,
+    )
+    _, r2 = fit_data_parallel(
+        fresh(), train_g, val_g, epochs=2, batch_size=4, node_cap=nc,
+        edge_cap=ec, seed=5, mesh=mesh, log_fn=quiet, scan_epochs=True,
+    )
+    for e1, e2 in zip(r1["history"], r2["history"]):
+        assert e1["train_loss"] == pytest.approx(e2["train_loss"], rel=1e-5)
+        assert e1["val"]["mae"] == pytest.approx(e2["val"]["mae"], rel=1e-5)
+
+
+def test_fit_dense_graph_sharded_scan_buckets_trains():
+    """The full flagship composition on a sharded mesh: scan driver + 2
+    size-class buckets + snug dense node-strip sharding trains with
+    decreasing loss across epoch boundaries."""
+    from cgnn_tpu.parallel.data_parallel import fit_data_parallel
+    from cgnn_tpu.parallel.mesh import make_2d_mesh
+
+    graphs = load_synthetic(
+        96, FeaturizeConfig(radius=5.0, max_num_nbr=8), seed=0
+    )
+    train_g, val_g = graphs[:80], graphs[80:]
+    targets = np.stack([g.target for g in train_g])
+    tx = make_optimizer(optim="sgd", lr=0.05, lr_milestones=[100])
+    nc, ec = capacities_for(train_g, 4, dense_m=8, snug=True,
+                            node_multiple=16)
+    batch = next(batch_iterator(train_g, 4, nc, ec, dense_m=8, snug=True))
+    model_ref = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=16,
+                                    dense_m=8)
+    model_gp = CrystalGraphConvNet(atom_fea_len=16, n_conv=2, h_fea_len=16,
+                                   dense_m=8, edge_axis_name="graph")
+    state = create_train_state(
+        model_ref, batch, tx, Normalizer.fit(targets), rng=jax.random.key(0)
+    ).replace(apply_fn=model_gp.apply)
+
+    quiet = lambda *a, **k: None  # noqa: E731
+    _, result = fit_data_parallel(
+        state, train_g, val_g, epochs=6, batch_size=4, node_cap=0,
+        edge_cap=0, seed=5, mesh=make_2d_mesh(2, data_shards=4),
+        log_fn=quiet, buckets=2, snug=True, dense_m=8, scan_epochs=True,
+    )
+    h = result["history"]
+    assert np.isfinite(h[-1]["train_loss"])
+    assert h[-1]["train_loss"] < h[0]["train_loss"]
+
+
 def test_2d_data_x_graph_mesh_matches_plain_dp():
     graphs, _, targets, tx = _setup(batch_size=8, n_graphs=32)
     nc, ec = capacities_for(graphs, 8)

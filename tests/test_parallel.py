@@ -257,13 +257,15 @@ class TestDPFeatureParity:
         assert np.isfinite(h_scan[1]["train_loss"])
 
     def test_graph_shards_reject_unsupported_flags(self, setup):
+        """Scan-epochs composes with graph shards since r5; per-step
+        profiling remains the one composition the scan cannot provide."""
         from cgnn_tpu.parallel import fit_data_parallel
         from cgnn_tpu.parallel.mesh import make_2d_mesh
 
         graphs, batch, model, state, (node_cap, edge_cap) = setup
-        with pytest.raises(NotImplementedError, match="scan-epochs"):
+        with pytest.raises(NotImplementedError, match="profile"):
             fit_data_parallel(
                 state, graphs, graphs[:8], epochs=1, batch_size=2,
                 node_cap=node_cap, edge_cap=edge_cap,
-                mesh=make_2d_mesh(2, data_shards=2), scan_epochs=True,
+                mesh=make_2d_mesh(2, data_shards=2), profile_steps=4,
             )

@@ -235,11 +235,11 @@ def main(argv=None) -> int:
     print(f"devices: {devices}")
 
     if (args.device_resident and not args.no_scan_epochs
-            and args.graph_shards <= 1 and not args.profile):
+            and not args.profile):
         # scan dispatch is the device-resident default since r3 (see
-        # --scan-epochs help); --no-scan-epochs restores the per-step
-        # loop. Not auto-applied when the run needs features scan cannot
-        # provide (edge-sharded meshes, per-step profiling) — those keep
+        # --scan-epochs help; composes with --graph-shards since r5);
+        # --no-scan-epochs restores the per-step loop. Not auto-applied
+        # for per-step profiling, which scan cannot provide — that keeps
         # the per-step loop rather than erroring on a flag the user
         # never passed.
         args.scan_epochs = True
@@ -490,9 +490,9 @@ def main(argv=None) -> int:
 
         mesh = None
         fit_state = state
-        if graph_shards > 1 and (args.scan_epochs or args.profile):
-            print("--scan-epochs/--profile are not supported with "
-                  "--graph-shards (edge-sharded meshes)", file=sys.stderr)
+        if graph_shards > 1 and args.profile:
+            print("--profile is not supported with --graph-shards "
+                  "(edge-sharded meshes)", file=sys.stderr)
             return 2
         if graph_shards > 1 and args.buckets > 1 and not use_dense:
             print("--buckets with --graph-shards requires the dense layout "
