@@ -648,11 +648,25 @@ def shard_transpose_slots(
     ``in_mask [D, node_cap, dense_m]``, ``over_slots/over_nodes/over_mask
     [D, over_cap]``.
     """
+    # the REAL precondition: shard boundaries must fall on whole node
+    # rows. Checking only edge-capacity divisibility let configs with
+    # dense_m % n_shards == 0 but node_cap % n_shards != 0 through (e.g.
+    # node_cap=6, dense_m=8, n_shards=4), cutting strips mid node-row and
+    # surfacing much later as an opaque shard_map/device_put error
+    # (ADVICE r5). node_cap divisibility implies edge divisibility for
+    # the dense layout (e_cap = node_cap * dense_m).
+    if node_cap % n_shards:
+        raise ValueError(
+            f"node_cap {node_cap} not divisible by {n_shards} shards "
+            f"(node-strip sharding owns whole node rows; round node_cap "
+            f"up to a multiple of the shard count)"
+        )
     e_cap = len(neighbors)
     if e_cap % n_shards:
         raise ValueError(
             f"edge capacity {e_cap} not divisible by {n_shards} shards "
-            f"(node_cap must be a multiple of the shard count)"
+            f"(expected node_cap * dense_m with node_cap a multiple of "
+            f"the shard count)"
         )
     e_s = e_cap // n_shards
     parts = [
@@ -782,14 +796,27 @@ class PaddingStats:
     slot_edges: int = 0
     batches: int = 0
     shapes: set = dataclasses.field(default_factory=set)
+    # per compiled (node_cap, edge_cap) shape: [real_nodes, real_edges,
+    # slot_nodes, slot_edges, batches] — the per-bucket breakdown the
+    # telemetry gauges report (observe.gauges.padding_gauges)
+    per_shape: dict = dataclasses.field(default_factory=dict)
 
     def update(self, batch: GraphBatch) -> None:
-        self.real_nodes += int(np.asarray(batch.node_mask).sum())
-        self.real_edges += int(np.asarray(batch.edge_mask).sum())
+        real_n = int(np.asarray(batch.node_mask).sum())
+        real_e = int(np.asarray(batch.edge_mask).sum())
+        self.real_nodes += real_n
+        self.real_edges += real_e
         self.slot_nodes += batch.node_capacity
         self.slot_edges += batch.edge_capacity
         self.batches += 1
-        self.shapes.add((batch.node_capacity, batch.edge_capacity))
+        shape = (batch.node_capacity, batch.edge_capacity)
+        self.shapes.add(shape)
+        acc = self.per_shape.setdefault(shape, [0, 0, 0, 0, 0])
+        acc[0] += real_n
+        acc[1] += real_e
+        acc[2] += batch.node_capacity
+        acc[3] += batch.edge_capacity
+        acc[4] += 1
 
     @property
     def node_efficiency(self) -> float:

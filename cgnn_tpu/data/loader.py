@@ -6,12 +6,19 @@ GraphBatches and ``jax.device_put``s them while the device runs the current
 step, keeping a small queue of ready-on-device batches ahead of the
 consumer. Packing is numpy (releases the GIL for the big copies), so one
 thread suffices to hide host latency behind multi-ms device steps.
+
+With a ``telemetry`` (observe.Telemetry), the loader reports two
+counters into the run summary: ``loader_wait_s`` — time the consumer
+blocked on an empty queue (the loader failing to hide host latency; the
+starvation signal) — and ``loader_put_s`` — producer time spent packing
++ staging (``device_put``) per run.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator
 
 import jax
@@ -25,6 +32,7 @@ def prefetch_to_device(
     batches: Iterable[GraphBatch],
     size: int = 2,
     device_put: Callable = jax.device_put,
+    telemetry=None,
 ) -> Iterator[GraphBatch]:
     """Wrap a host batch iterator with an N-deep on-device prefetch queue."""
     q: queue.Queue = queue.Queue(maxsize=size)
@@ -32,8 +40,19 @@ def prefetch_to_device(
 
     def producer():
         try:
-            for b in batches:
-                q.put(device_put(b))
+            it = iter(batches)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                staged = device_put(b)
+                if telemetry is not None:
+                    telemetry.counter_add(
+                        "loader_put_s", time.perf_counter() - t0
+                    )
+                q.put(staged)
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer side
             err.append(e)
         finally:
@@ -42,7 +61,10 @@ def prefetch_to_device(
     t = threading.Thread(target=producer, daemon=True, name="cgnn-prefetch")
     t.start()
     while True:
+        t0 = time.perf_counter()
         item = q.get()
+        if telemetry is not None:
+            telemetry.counter_add("loader_wait_s", time.perf_counter() - t0)
         if item is _SENTINEL:
             break
         yield item

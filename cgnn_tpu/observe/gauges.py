@@ -1,0 +1,91 @@
+"""Gauges: padding efficiency, HBM occupancy, device inventory.
+
+PERF.md's dominant systemic cost was padding efficiency (0.685 before
+snug packing) — yet no run-time counter tracked it. ``padding_gauges``
+turns a ``data.graph.PaddingStats`` into per-bucket efficiency/occupancy
+records; ``hbm_gauges`` samples ``device.memory_stats()`` per device
+with the device-kind table fallback (this repo's tunneled runtime
+returns None from memory_stats — train/loop.py's HBM precheck shares
+the same table via ``device_hbm_table_bytes``).
+"""
+
+from __future__ import annotations
+
+# HBM per chip by device kind, for runtimes whose memory_stats() returns
+# None (the table train/loop.py's device-resident capacity precheck uses)
+_HBM_BYTES = {
+    "TPU v5 lite": 16 << 30,  # v5e
+    "TPU v5": 95 << 30,       # v5p
+    "TPU v4": 32 << 30,
+    "TPU v6 lite": 32 << 30,  # trillium
+}
+
+
+def device_hbm_table_bytes(device_kind: str) -> int | None:
+    """Total HBM bytes for a device kind, or None when unknown."""
+    return _HBM_BYTES.get(device_kind)
+
+
+def hbm_gauges(devices=None) -> list[dict]:
+    """One record per device: bytes in use / limit and the source.
+
+    ``source`` is ``"memory_stats"`` when the backend reports live
+    occupancy, ``"table"`` when only the device-kind capacity is known
+    (occupancy fields absent), ``"unknown"`` when neither is available
+    (CPU test meshes).
+    """
+    import jax
+
+    out = []
+    for d in devices if devices is not None else jax.devices():
+        rec = {
+            "device": str(d),
+            "kind": getattr(d, "device_kind", ""),
+            "platform": getattr(d, "platform", ""),
+        }
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend-dependent, best-effort
+            stats = None
+        if stats and "bytes_limit" in stats:
+            rec["source"] = "memory_stats"
+            rec["bytes_limit"] = int(stats["bytes_limit"])
+            rec["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+            rec["occupancy"] = rec["bytes_in_use"] / max(
+                rec["bytes_limit"], 1
+            )
+        else:
+            total = device_hbm_table_bytes(rec["kind"])
+            if total is not None:
+                rec["source"] = "table"
+                rec["bytes_limit"] = total
+            else:
+                rec["source"] = "unknown"
+        out.append(rec)
+    return out
+
+
+def padding_gauges(stats) -> list[dict]:
+    """Per-bucket padding efficiency/occupancy records from a
+    ``PaddingStats`` (one record per compiled (node_cap, edge_cap)
+    shape, plus an ``"overall"`` rollup)."""
+    out = []
+    for shape, acc in sorted(getattr(stats, "per_shape", {}).items()):
+        real_n, real_e, slot_n, slot_e, batches = acc
+        out.append({
+            "bucket": f"{shape[0]}n/{shape[1]}e",
+            "node_cap": int(shape[0]),
+            "edge_cap": int(shape[1]),
+            "batches": int(batches),
+            "node_efficiency": real_n / max(slot_n, 1),
+            "edge_efficiency": real_e / max(slot_e, 1),
+        })
+    out.append({
+        "bucket": "overall",
+        "batches": int(stats.batches),
+        "node_efficiency": stats.node_efficiency,
+        "edge_efficiency": stats.edge_efficiency,
+        "shapes": len(stats.shapes),
+    })
+    return out

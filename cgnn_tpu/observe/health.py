@@ -1,0 +1,63 @@
+"""In-graph gradient-health metrics (grad/update norms, NaN/Inf counts).
+
+Computed INSIDE the jitted train step (train/step.py, train/force_step.py
+call this when built with ``grad_health=True``) so they ride the existing
+metric plumbing: device-side accumulation across steps, the packed
+one-fetch epoch aggregate, and — at ``--telemetry step`` — the in-scan
+stream. Everything is derived from values the step already has (grads,
+old/new params, loss); nothing here feeds back into the update, so the
+training trajectory is bit-identical with or without it.
+
+Keys follow the (sum, count) metric convention: ``*_sum`` with a
+matching ``*_count`` of 1 per step, so epoch aggregation yields per-step
+means and the stream's single-step derivation yields the raw values.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def global_norm(tree):
+    """sqrt(sum of squares) over every leaf, accumulated in f32."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )
+
+
+def nonfinite_count(tree):
+    """Total NaN/Inf elements over every leaf (f32 scalar)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(
+        (~jnp.isfinite(x)).sum() for x in leaves
+    ).astype(jnp.float32)
+
+
+def grad_health_metrics(grads, old_params, new_params, loss=None) -> dict:
+    """The step's health metric dict (merge into the step's metrics)."""
+    one = jnp.float32(1.0)
+    out = {
+        "grad_norm_sum": global_norm(grads),
+        "grad_norm_count": one,
+        "update_norm_sum": global_norm(
+            jax.tree_util.tree_map(
+                lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+                new_params, old_params,
+            )
+        ),
+        "update_norm_count": one,
+        "nonfinite_grads_sum": nonfinite_count(grads),
+        "nonfinite_grads_count": one,
+    }
+    if loss is not None:
+        out["nonfinite_loss_sum"] = (
+            ~jnp.isfinite(jnp.asarray(loss, jnp.float32))
+        ).astype(jnp.float32)
+        out["nonfinite_loss_count"] = one
+    return out

@@ -1,0 +1,75 @@
+"""Run manifest: config + environment fingerprint, written once per run.
+
+Answers "what exactly was this run?" without scraping stdout: the full
+flag/config dict, device inventory and mesh shape, package versions, and
+the git SHA (+dirty bit) of the working tree. One JSON file
+(``manifest.json``) next to ``metrics.jsonl``/``trace.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _git_info() -> dict:
+    """Best-effort {sha, dirty} of the repo this package lives in."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=root, capture_output=True,
+            text=True, timeout=10,
+        ).stdout.strip()
+        if not sha:
+            return {}
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=root, capture_output=True,
+            text=True, timeout=10,
+        ).stdout.strip()
+        return {"git_sha": sha, "git_dirty": bool(dirty)}
+    except Exception:  # noqa: BLE001 — no git in the image / not a repo
+        return {}
+
+
+def build_manifest(config: dict | None = None, **extra) -> dict:
+    """The manifest dict (separated from the write for testability)."""
+    import jax
+
+    devices = jax.devices()
+    manifest = {
+        "time": time.time(),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "jax_version": jax.__version__,
+        "backend": devices[0].platform if devices else "none",
+        "device_count": len(devices),
+        "devices": [
+            {
+                "id": d.id,
+                "kind": getattr(d, "device_kind", ""),
+                "platform": getattr(d, "platform", ""),
+            }
+            for d in devices
+        ],
+        **_git_info(),
+    }
+    if config is not None:
+        manifest["config"] = {
+            k: v for k, v in config.items()
+            if isinstance(v, (int, float, str, bool, list, tuple, type(None)))
+        }
+    manifest.update(extra)
+    return manifest
+
+
+def write_manifest(log_dir: str, config: dict | None = None, **extra) -> str:
+    """Write manifest.json under ``log_dir``; returns the path."""
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, "manifest.json")
+    with open(path, "w") as f:
+        json.dump(build_manifest(config, **extra), f, indent=1)
+    return path

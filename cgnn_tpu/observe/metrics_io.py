@@ -1,0 +1,96 @@
+"""metrics.jsonl writer + misc observability helpers.
+
+``MetricsLogger`` is the one sink every telemetry record flows through:
+epoch aggregates (``write``), arbitrary tagged events — step streams,
+gauges, counters (``event``) — one JSON object per line, thread-safe
+(the in-scan stream's host callbacks fire from runtime threads).
+TensorBoard mirroring via ``clu.metric_writers`` stays best-effort, as
+before.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Iterator
+
+
+class MetricsLogger:
+    """Epoch/event metrics -> metrics.jsonl (+ TensorBoard when available)."""
+
+    def __init__(self, log_dir: str, use_clu: bool = True):
+        self.log_dir = log_dir = log_dir or "."
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(log_dir, "metrics.jsonl")
+        self._jsonl = open(self.path, "a", buffering=1)
+        self._lock = threading.Lock()
+        self._writer = None
+        if use_clu:
+            try:
+                from clu import metric_writers
+
+                self._writer = metric_writers.SummaryWriter(log_dir)
+            except Exception:  # noqa: BLE001 — TF backing may be absent
+                self._writer = None
+
+    def write(self, step: int, values: dict, prefix: str = "") -> None:
+        """One epoch-level record: {"step", "time", "<prefix>/<k>": v}."""
+        scalars = {
+            (f"{prefix}/{k}" if prefix else k): float(v)
+            for k, v in values.items()
+            if isinstance(v, (int, float)) and v == v  # drop NaNs
+        }
+        rec = {"step": int(step), "time": time.time(), **scalars}
+        with self._lock:
+            self._jsonl.write(json.dumps(rec) + "\n")
+        if self._writer is not None:
+            self._writer.write_scalars(int(step), scalars)
+
+    def event(self, event: str, record: dict) -> None:
+        """One tagged record: {"event": <tag>, "time", **record}.
+
+        The tap between the in-scan stream / gauge emitters and the file;
+        callable from any thread (host callbacks run off-thread).
+        """
+        rec = {"event": event, "time": time.time(), **record}
+        with self._lock:
+            self._jsonl.write(json.dumps(rec) + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._jsonl.close()
+        if self._writer is not None:
+            self._writer.close()
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Load every record of a metrics.jsonl (schema round-trip helper)."""
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str, enabled: bool = True) -> Iterator[None]:
+    """jax.profiler.trace context (xprof/perfetto trace under log_dir)."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def enable_debug_nans() -> None:
+    """Fail fast with a traceback at the first NaN any jitted op produces."""
+    import jax
+
+    jax.config.update("jax_debug_nans", True)

@@ -1,0 +1,101 @@
+"""Host-side span tracing -> Chrome-trace/Perfetto JSON.
+
+Lightweight nested wall-clock spans for the host orchestration phases the
+device profiler cannot see (featurize, pack, stage, compile+warmup, epoch
+dispatch, checkpoint writes). ``SpanTracer.span`` is a context manager;
+nesting is tracked per thread and exported as complete events (``"ph":
+"X"``) in the Chrome trace event format, which Perfetto and
+``chrome://tracing`` open directly.
+
+Timestamps are ``time.perf_counter`` microseconds relative to tracer
+construction (Chrome traces only need a consistent monotonic base).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Iterator
+
+
+class SpanTracer:
+    """Nested host spans; ``export()`` writes trace.json (Chrome format)."""
+
+    def __init__(self, process_name: str = "cgnn-tpu host"):
+        self._t0 = time.perf_counter()
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._depth = threading.local()
+        self._tids: dict[int, int] = {}
+        self._process_name = process_name
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _tid(self) -> int:
+        # stable small ints per thread (raw thread idents overflow the
+        # int32 tid some trace viewers assume)
+        ident = threading.get_ident()
+        with self._lock:
+            return self._tids.setdefault(ident, len(self._tids))
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        """Time a block; ``args`` become the event's args dict (viewable
+        in the Perfetto detail pane)."""
+        depth = getattr(self._depth, "value", 0)
+        self._depth.value = depth + 1
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            self._depth.value = depth
+            event = {
+                "name": name,
+                "ph": "X",
+                "ts": start,
+                "dur": self._now_us() - start,
+                "pid": 0,
+                "tid": self._tid(),
+                "args": {k: v for k, v in args.items()} | {"depth": depth},
+            }
+            with self._lock:
+                self._events.append(event)
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration marker event."""
+        event = {
+            "name": name,
+            "ph": "i",
+            "ts": self._now_us(),
+            "s": "t",
+            "pid": 0,
+            "tid": self._tid(),
+            "args": dict(args),
+        }
+        with self._lock:
+            self._events.append(event)
+
+    @property
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def export(self, path: str) -> str:
+        """Write the Chrome trace JSON; returns the path."""
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 0,
+                "args": {"name": self._process_name},
+            }
+        ]
+        doc = {"traceEvents": meta + self.events, "displayTimeUnit": "ms"}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path

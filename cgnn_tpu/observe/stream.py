@@ -1,0 +1,195 @@
+"""In-scan metric streaming: per-step scalars ring out of jitted bodies.
+
+The flagship dispatch mode folds whole epochs into ``lax.scan`` over
+device-resident stacks (``train.loop.ScanEpochDriver``) — the fastest
+path, but it hides every per-step signal from the host: loss spikes,
+grad-norm blowups, and NaN onset are only visible as epoch aggregates.
+``StepStream.tap`` is the fix: called at TRACE time inside a step/scan
+body, it packs that step's scalar metrics into one f32 vector and stages
+a ``jax.debug.callback`` — an asynchronous host callback that the runtime
+invokes with the concrete values at each executed step, WITHOUT a
+host<->device fetch on the training-critical path and without touching
+the donated-buffer scan carry (the tap only reads freshly computed metric
+scalars, so trajectory parity with the untapped program is exact).
+
+Host side, each arrival becomes one ``{"event": "step"}`` record in
+``metrics.jsonl`` (per-step means derived from the step's (sum, count)
+pairs, plus an arrival-rate ``steps_per_s``) and lands in a bounded ring
+buffer for cheap in-process inspection. Callbacks may arrive from
+runtime threads and — with ``ordered=False`` — out of submission order;
+records carry the in-graph optimizer step (or an arrival sequence number
+for eval) so ordering is recoverable downstream.
+
+Nothing here stages a callback unless ``tap``/``wrap_*`` is actually
+called: with telemetry off or at epoch level the compiled HLO is
+byte-identical to an unstreamed build (the ``--telemetry off`` no-op
+guarantee, pinned by tests/test_observe.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+from typing import Callable, Iterator
+
+import numpy as np
+
+
+def _derive_means(sums: dict) -> dict:
+    """Per-step means from one step's '<name>_sum' totals (each divided
+    by its matching '<name>_count' when present, else the global
+    'count') — the single-step analog of train.metrics.means_from_sums,
+    duplicated here so cgnn_tpu.observe never imports cgnn_tpu.train."""
+    count = max(sums.get("count", 1.0), 1.0)
+    out = {
+        k[: -len("_sum")]: v
+        / max(sums.get(k[: -len("_sum")] + "_count", count), 1.0)
+        for k, v in sums.items()
+        if k.endswith("_sum")
+    }
+    out["count"] = sums.get("count", 0.0)
+    return out
+
+
+class StepStream:
+    """Per-step metric tap: jitted bodies -> ring buffer + metrics.jsonl."""
+
+    def __init__(self, logger=None, ring_size: int = 4096,
+                 rate_window: int = 32):
+        self._logger = logger
+        self.ring: collections.deque = collections.deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+        self._callbacks: dict = {}
+        self._seq: dict[str, int] = {}
+        self._arrivals: dict[str, collections.deque] = {}
+        self._rate_window = rate_window
+        self._muted = 0
+        self.dropped = 0  # records lost to host-side callback errors
+
+    # ---- trace-time API (called inside jit/scan tracing) ----
+
+    def tap(self, metrics: dict, phase: str, step=None) -> None:
+        """Stage the async host callback carrying this step's scalars.
+
+        ``metrics`` is the step's (sum, count) dict; non-scalar entries
+        are skipped. ``step`` is the in-graph optimizer step (traced
+        int) for training taps; eval taps pass None and records fall
+        back to an arrival sequence number.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        scalars = {k: v for k, v in metrics.items() if jnp.ndim(v) == 0}
+        if not scalars:
+            return
+        keys = tuple(sorted(scalars))
+        packed = jnp.stack(
+            [jnp.asarray(scalars[k], jnp.float32) for k in keys]
+        )
+        step_no = jnp.asarray(-1 if step is None else step, jnp.int32)
+        # unordered: the callback must not serialize scan iterations —
+        # records are tagged with the step number instead
+        jax.debug.callback(
+            self._callback_for(phase, keys), step_no, packed, ordered=False
+        )
+
+    def wrap_train(self, body: Callable, phase: str = "train") -> Callable:
+        """(state, batch) -> (state, metrics) body with the tap staged."""
+
+        def wrapped(state, batch):
+            new_state, metrics = body(state, batch)
+            self.tap(metrics, phase, step=new_state.step)
+            return new_state, metrics
+
+        return wrapped
+
+    def wrap_eval(self, body: Callable, phase: str = "eval") -> Callable:
+        """(state, batch) -> metrics body with the tap staged."""
+
+        def wrapped(state, batch):
+            metrics = body(state, batch)
+            self.tap(metrics, phase)
+            return metrics
+
+        return wrapped
+
+    # ---- host side ----
+
+    def _callback_for(self, phase: str, keys: tuple) -> Callable:
+        # one host function per (phase, metric-key layout); cached so
+        # scan re-traces reuse the same callable
+        ck = (phase, keys)
+        with self._lock:
+            cb = self._callbacks.get(ck)
+            if cb is None:
+
+                def cb(step_no, packed, _phase=phase, _keys=keys):
+                    try:
+                        self._record(_phase, _keys, step_no, packed)
+                    except Exception:  # noqa: BLE001 — never kill training
+                        with self._lock:
+                            self.dropped += 1
+
+                self._callbacks[ck] = cb
+        return cb
+
+    def _record(self, phase: str, keys: tuple, step_no, packed) -> None:
+        vals = np.asarray(packed, dtype=np.float64)
+        step_no = int(np.asarray(step_no))
+        now = time.perf_counter()
+        with self._lock:
+            if self._muted:
+                return
+            seq = self._seq.get(phase, 0)
+            self._seq[phase] = seq + 1
+            arr = self._arrivals.setdefault(
+                phase, collections.deque(maxlen=self._rate_window)
+            )
+            arr.append(now)
+            rate = (
+                (len(arr) - 1) / (arr[-1] - arr[0])
+                if len(arr) > 1 and arr[-1] > arr[0]
+                else float("nan")
+            )
+        rec = {
+            "phase": phase,
+            "step": step_no if step_no >= 0 else seq,
+            **_derive_means(dict(zip(keys, map(float, vals)))),
+        }
+        if rate == rate:
+            rec["steps_per_s"] = rate
+        with self._lock:
+            self.ring.append(rec)
+        if self._logger is not None:
+            self._logger.event("step", rec)
+
+    @contextlib.contextmanager
+    def muted(self) -> Iterator[None]:
+        """Drop arrivals inside the context (warmup/compile dispatches
+        run the same compiled programs; their records are not training
+        signal). Unmuting drains in-flight callbacks first
+        (``jax.effects_barrier``): they run on runtime threads, so
+        without the barrier a late warmup arrival could land after the
+        mute lifts and masquerade as a real step record."""
+        with self._lock:
+            self._muted += 1
+        try:
+            yield
+        finally:
+            try:
+                import jax
+
+                jax.effects_barrier()
+            except Exception:  # noqa: BLE001 — jax may be torn down
+                pass
+            with self._lock:
+                self._muted -= 1
+
+    def records(self, phase: str | None = None) -> list[dict]:
+        with self._lock:
+            recs = list(self.ring)
+        return recs if phase is None else [
+            r for r in recs if r["phase"] == phase
+        ]

@@ -198,6 +198,7 @@ def make_parallel_train_step(
     classification: bool = False,
     loss_fn: Callable | None = None,
     inner_step: Callable | None = None,
+    grad_health: bool = False,
 ) -> Callable:
     """shard_map-wrapped train step: (replicated state, [D,...] batch).
 
@@ -207,7 +208,9 @@ def make_parallel_train_step(
 
     ``inner_step`` overrides the default step body entirely (it must already
     be built with ``axis_name='data'`` — e.g. the force-task step; only
-    supported on 1-D data meshes).
+    supported on 1-D data meshes). ``grad_health`` adds the in-graph
+    grad/update-norm and NaN/Inf metrics to the default body
+    (train.step.make_train_step); extra outputs only.
     """
     axes = _replica_axes(mesh)
     if inner_step is not None and axes != ("data",):
@@ -215,7 +218,8 @@ def make_parallel_train_step(
             f"custom step bodies assume axis_name='data'; mesh has {axes}"
         )
     inner = inner_step or make_train_step(
-        classification, axis_name=axes, loss_fn=loss_fn
+        classification, axis_name=axes, loss_fn=loss_fn,
+        grad_health=grad_health,
     )
 
     def body(state: TrainState, stacked: GraphBatch):
@@ -313,6 +317,7 @@ def fit_data_parallel(
     profile_dir: str = "",
     edge_dtype=np.float32,
     chunk_steps: int | None = None,
+    telemetry=None,
 ) -> tuple[TrainState, dict]:
     """DP twin of train.loop.fit; ``batch_size`` is per device.
 
@@ -336,9 +341,17 @@ def fit_data_parallel(
     ``pack_once`` / ``device_resident`` mirror train.loop.fit: pack (and,
     for device_resident, mesh-shard into HBM) the stacked batches once,
     reshuffling stacked-batch order across epochs.
+
+    ``telemetry`` mirrors train.loop.fit: spans, padding/HBM gauges, and
+    — with ``scan_epochs`` at step level — the in-scan per-step stream
+    (the driver taps the post-shard_map metrics, one callback per step).
+    The DP PER-STEP loop does not stream (its metrics live inside the
+    shard_map body); epoch aggregates and gauges still flow.
     """
+    from cgnn_tpu.observe import Telemetry
     from cgnn_tpu.parallel.mesh import make_mesh
 
+    telemetry = telemetry or Telemetry.disabled()
     mesh = mesh or make_mesh()
     if dense_m is not None:
         edge_cap = node_cap * dense_m
@@ -397,7 +410,8 @@ def fit_data_parallel(
     else:
         n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
         train_step = make_parallel_train_step(
-            mesh, classification, inner_step=train_step_fn
+            mesh, classification, inner_step=train_step_fn,
+            grad_health=telemetry.step_level,
         )
         eval_step = make_parallel_eval_step(
             mesh, classification, inner_step=eval_step_fn
@@ -449,8 +463,9 @@ def fit_data_parallel(
             staged_nbytes,
         )
 
-        train_list = list(make_train_it())
-        val_list = list(make_val_it())
+        with telemetry.span("pack"):
+            train_list = list(make_train_it())
+            val_list = list(make_val_it())
         # per-device share for the precheck: the stacked device axis
         # splits everything over the data shards; under graph sharding
         # the edge leaves (the dominant bytes: [N, M, G] stacks and the
@@ -499,10 +514,13 @@ def fit_data_parallel(
                 stage = lambda t: shard_scan_stack_2d(t, mesh)  # noqa: E731
             else:
                 stage = lambda t: shard_scan_stack(t, mesh)  # noqa: E731
-            driver = ScanEpochDriver(
-                train_step, eval_step, train_list, val_list,
-                rng, stage=stage, chunk_steps=chunk_steps,
-            )
+            with telemetry.span("stage_scan_stacks"):
+                driver = ScanEpochDriver(
+                    train_step, eval_step, train_list, val_list,
+                    rng, stage=stage, chunk_steps=chunk_steps,
+                    telemetry=telemetry,
+                )
+            telemetry.sample_hbm("post_staging")
         else:
             # loud fallback (see check_device_resident_fit): host-side
             # pack-once, mesh-sharded restaging per epoch
@@ -522,12 +540,27 @@ def fit_data_parallel(
         else None
     )
 
+    telemetry.observe_padding(pad_stats)
+    if telemetry.step_level and driver is None:
+        log_fn(
+            "telemetry step: the data-parallel per-step loop does not "
+            "stream per-step records (metrics live inside the shard_map "
+            "body); epoch aggregates and gauges are still recorded — use "
+            "--scan-epochs for in-scan streaming under DP"
+        )
+    if telemetry.step_level and graph_shards > 1:
+        log_fn(
+            "telemetry step: grad-health metrics are not computed by the "
+            "edge-sharded ('graph' mesh) step bodies yet — step records "
+            "carry loss/counts only on this path"
+        )
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         if driver is not None:
-            state, train_m, val_m = driver.run_epoch_pair(
-                state, first=epoch == start_epoch
-            )
+            with telemetry.span("epoch", epoch=epoch, driver="scan"):
+                state, train_m, val_m = driver.run_epoch_pair(
+                    state, first=epoch == start_epoch
+                )
             if epoch == start_epoch:
                 log_fn(pad_stats.summary())
         else:
@@ -537,21 +570,26 @@ def fit_data_parallel(
                     train_it, val_it = epoch_train, epoch_val
                 else:
                     train_it = prefetch_to_device(
-                        epoch_train, device_put=shard_put)
-                    val_it = prefetch_to_device(epoch_val, device_put=shard_put)
+                        epoch_train, device_put=shard_put,
+                        telemetry=telemetry)
+                    val_it = prefetch_to_device(
+                        epoch_val, device_put=shard_put, telemetry=telemetry)
             else:
                 train_it = prefetch_to_device(
-                    make_train_it(), device_put=shard_put
+                    make_train_it(), device_put=shard_put, telemetry=telemetry
                 )
-                val_it = prefetch_to_device(make_val_it(), device_put=shard_put)
+                val_it = prefetch_to_device(
+                    make_val_it(), device_put=shard_put, telemetry=telemetry)
             if epoch == start_epoch and profile_steps:
                 train_it = profile_wrap(
                     train_it, profile_steps, profile_dir, log_fn
                 )
-            state, train_m = run_epoch(
-                train_step, state, train_it, train=True,
-                print_freq=print_freq, epoch=epoch, log_fn=log_fn,
-            )
+            with telemetry.span("epoch", epoch=epoch, driver="per_step"):
+                state, train_m = run_epoch(
+                    train_step, state, train_it, train=True,
+                    print_freq=print_freq, epoch=epoch, log_fn=log_fn,
+                    telemetry=telemetry,
+                )
             if epoch == start_epoch:
                 log_fn(pad_stats.summary())
         if train_m["steps"] == 0:
@@ -566,10 +604,11 @@ def fit_data_parallel(
         train_loss = train_m.get("loss", np.nan)
 
         if driver is None:
-            _, val_m = run_epoch(
-                eval_step, state, val_it, train=False, epoch=epoch,
-                log_fn=log_fn,
-            )
+            with telemetry.span("eval", epoch=epoch):
+                _, val_m = run_epoch(
+                    eval_step, state, val_it, train=False, epoch=epoch,
+                    log_fn=log_fn, telemetry=telemetry,
+                )
         best_key = best_metric or ("correct" if classification else "mae")
         metric = val_m.get(best_key, np.nan)
         is_best = metric > best if classification else metric < best

@@ -34,12 +34,22 @@ def _state_pytree(state: TrainState) -> dict:
 
 
 class CheckpointManager:
-    """Latest + best checkpoint pair with JSON metadata, async saves."""
+    """Latest + best checkpoint pair with JSON metadata, async saves.
 
-    def __init__(self, directory: str):
+    ``telemetry`` (an ``observe.Telemetry``) wraps the host-side part of
+    saves/restores in spans — saves are async (orbax writes in a
+    background thread), so the span covers the device_get + dispatch,
+    which is exactly the part that stalls training.
+    """
+
+    def __init__(self, directory: str, telemetry=None):
+        from cgnn_tpu.observe import Telemetry
+
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer()
+        # Telemetry.span is already a nullcontext at level 'off'
+        self._telemetry = telemetry or Telemetry.disabled()
 
     def _path(self, tag: str) -> str:
         return os.path.join(self.directory, tag)
@@ -63,11 +73,12 @@ class CheckpointManager:
         sharded run must restore in a single-chip predict/resume process
         (orbax would otherwise bake the save-time sharding into the
         checkpoint and refuse topology-less restores)."""
-        tree = jax.device_get(_state_pytree(state))
-        for tag in [_LATEST] + ([_BEST] if is_best else []):
-            self._ckptr.save(self._path(tag), tree, force=True)
-            with open(self._meta_path(tag), "w") as f:
-                json.dump(meta, f, indent=1)
+        with self._telemetry.span("checkpoint_save", is_best=is_best):
+            tree = jax.device_get(_state_pytree(state))
+            for tag in [_LATEST] + ([_BEST] if is_best else []):
+                self._ckptr.save(self._path(tag), tree, force=True)
+                with open(self._meta_path(tag), "w") as f:
+                    json.dump(meta, f, indent=1)
 
     def wait(self):
         self._ckptr.wait_until_finished()
@@ -78,7 +89,8 @@ class CheckpointManager:
     def restore(self, state: TrainState, tag: str = _LATEST) -> tuple[TrainState, dict]:
         """Restore into the structure of ``state`` -> (state, meta)."""
         self.wait()
-        tree = self._ckptr.restore(self._path(tag), _state_pytree(state))
+        with self._telemetry.span("checkpoint_restore", tag=tag):
+            tree = self._ckptr.restore(self._path(tag), _state_pytree(state))
         from cgnn_tpu.train.normalizer import Normalizer
 
         restored = state.replace(

@@ -86,8 +86,17 @@ def make_force_train_step(
     w_energy: float = 1.0,
     w_force: float = 10.0,
     axis_name: str | None = None,
+    grad_health: bool = False,
 ) -> Callable:
-    """(state, batch) -> (state, metrics); energy+force composite objective."""
+    """(state, batch) -> (state, metrics); energy+force composite objective.
+
+    ``grad_health`` adds in-graph grad/update-norm and NaN/Inf-count
+    metrics (observe.health) — extra outputs only; the update and hence
+    the trajectory are identical with it on or off. Especially relevant
+    here: the force task's second-order differentiation is the likeliest
+    NaN source in the codebase, and under the epoch scan its onset used
+    to be invisible until the epoch aggregate came back.
+    """
 
     def train_step(state: TrainState, batch: GraphBatch):
         def loss_with_aux(params):
@@ -101,14 +110,27 @@ def make_force_train_step(
             )
             return loss, (metrics, new_stats)
 
-        (_, (metrics, new_stats)), grads = jax.value_and_grad(
+        (loss, (metrics, new_stats)), grads = jax.value_and_grad(
             loss_with_aux, has_aux=True
         )(state.params)
         if axis_name is not None:
             grads = lax.pmean(grads, axis_name)
             new_stats = lax.pmean(new_stats, axis_name)
             metrics = lax.psum(metrics, axis_name)
-        return state.apply_gradients(grads, new_stats), metrics
+        new_state = state.apply_gradients(grads, new_stats)
+        if grad_health:
+            from cgnn_tpu.observe.health import grad_health_metrics
+
+            # per-shard loss under axis_name: reduce before the NaN
+            # check (see train.step.make_train_step) — a NaN on any
+            # shard must be visible, not just shard 0's value
+            health_loss = (
+                loss if axis_name is None else lax.pmean(loss, axis_name)
+            )
+            metrics = metrics | grad_health_metrics(
+                grads, state.params, new_state.params, loss=health_loss
+            )
+        return new_state, metrics
 
     return train_step
 

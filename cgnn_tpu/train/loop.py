@@ -9,6 +9,7 @@ reference's console output.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Callable, Iterable, Sequence
@@ -54,19 +55,15 @@ if _WINDOW < 1:
 
     warnings.warn("CGNN_TPU_WINDOW must be >= 1; clamping to 1")
     _WINDOW = 1
+from cgnn_tpu.observe import Telemetry
+from cgnn_tpu.observe.gauges import device_hbm_table_bytes
 from cgnn_tpu.train.state import TrainState
 from cgnn_tpu.train.step import make_eval_step, make_train_step
 
-# HBM per chip by device kind, for the device-resident capacity precheck
-# (jax's memory_stats() returns None on this runtime, so a table it is)
-_HBM_BYTES = {
-    "TPU v5 lite": 16 << 30,  # v5e
-    "TPU v5": 95 << 30,       # v5p
-    "TPU v4": 32 << 30,
-    "TPU v6 lite": 32 << 30,  # trillium
-}
 # fraction of HBM the staged dataset may claim — the rest is params, opt
 # state, activations, XLA workspace, and the scan driver's staged perms
+# (the per-kind capacity table lives in observe.gauges, shared with the
+# HBM gauges; jax's memory_stats() returns None on this runtime)
 _STAGE_FRACTION = 0.8
 
 
@@ -90,7 +87,7 @@ def device_hbm_budget(device=None) -> int | None:
     if stats and "bytes_limit" in stats:
         free = int(stats["bytes_limit"]) - int(stats.get("bytes_in_use", 0))
         return int(free * _STAGE_FRACTION)
-    total = _HBM_BYTES.get(getattr(device, "device_kind", ""), None)
+    total = device_hbm_table_bytes(getattr(device, "device_kind", ""))
     return None if total is None else int(total * _STAGE_FRACTION)
 
 
@@ -131,6 +128,7 @@ def run_epoch(
     print_freq: int = 0,
     epoch: int = 0,
     log_fn: Callable = print,
+    telemetry: Telemetry | None = None,
 ) -> tuple[TrainState, dict]:
     """Drive one epoch; returns (state, aggregated metric means).
 
@@ -215,6 +213,10 @@ def run_epoch(
             log_fn("  ".join(parts))
     sums = fetch_device_sums(dev_sums)
     _sync_window(time.perf_counter())
+    if telemetry is not None:
+        # dispatch-share + host-wait counters (flushed in the run summary)
+        telemetry.counter_add("per_step_steps", it + 1)
+        telemetry.counter_add("data_wait_s", meters["data_time"].sum)
     return state, means_from_sums(sums, it + 1)
 
 
@@ -297,7 +299,8 @@ class ScanEpochDriver:
                  train_batches: list, val_batches: list,
                  rng: np.random.Generator, stage: Callable | None = None,
                  expand: Callable | None = None,
-                 chunk_steps: int | None = None):
+                 chunk_steps: int | None = None,
+                 telemetry: Telemetry | None = None):
         """``stage`` places each stacked group on device (default
         ``jax.device_put``); data-parallel callers pass a mesh-sharding
         stage so the per-step device axis (axis 1 of the stack) lands
@@ -306,7 +309,14 @@ class ScanEpochDriver:
         ``expand`` (compact staging, data/compact.py) maps each scanned
         batch to the full GraphBatch INSIDE the jitted scan body — the
         stacked groups then hold the ~12x smaller raw form in HBM and the
-        table-gather + Gaussian expansion fuse into each step."""
+        table-gather + Gaussian expansion fuse into each step.
+
+        ``telemetry`` at step level stages the in-scan metric tap
+        (observe.stream) into every scan body: per-step scalars ring out
+        to the host via an async callback with no fetch on the dispatch
+        path and no effect on the donated-carry trajectory. Below step
+        level NOTHING is staged — the scanned HLO is identical to a
+        telemetry-free build."""
         from cgnn_tpu.data import invariants
 
         if expand is not None:
@@ -325,6 +335,13 @@ class ScanEpochDriver:
         for b in val_batches:
             invariants.maybe_check_any(b)
         self._rng = rng
+        self._telemetry = telemetry
+        # the tap is staged into scan bodies ONLY at step-level telemetry
+        self._tap = (
+            telemetry.tap_metrics
+            if telemetry is not None and telemetry.stream is not None
+            else None
+        )
         self._stage = stage if stage is not None else jax.device_put
         # per-phase wall-clock accounting (scripts/scan_cost.py reads this
         # to attribute the driver's fixed costs); keys are cumulative
@@ -376,8 +393,15 @@ class ScanEpochDriver:
                     batch = jax.tree_util.tree_map(lambda x: x[i], stacked)
                     if train:
                         carry, metrics = body(carry, batch)
+                        if self._tap is not None:
+                            # per-step scalars ring out to the host from
+                            # INSIDE the scan (async callback; no fetch,
+                            # no change to the donated carry)
+                            self._tap(metrics, "train", step=carry.step)
                     else:
                         metrics = body(carry, batch)
+                        if self._tap is not None:
+                            self._tap(metrics, "eval")
                     return carry, metrics
 
                 state2, ms = jax.lax.scan(step, state, perm)
@@ -484,25 +508,41 @@ class ScanEpochDriver:
         """
         # Real buffers, not aliases: the train bodies donate their state
         # argument, so passing the caller's arrays would invalidate them.
+        # Copy-THEN-place: jnp.array(x) alone makes the copy but relies on
+        # it implicitly keeping x's layout, and jax.device_put(x,
+        # x.sharding) alone ALIASES the buffer (measured: same
+        # unsafe_buffer_pointer, donation kills the original) — the
+        # device_put onto the source sharding makes the replicated/sharded
+        # layout explicit on a buffer that is already a fresh copy.
         scratch = jax.tree_util.tree_map(
-            lambda x: jnp.array(x) if isinstance(x, jax.Array) else x, state
+            lambda x: jax.device_put(jnp.array(x), x.sharding)
+            if isinstance(x, jax.Array) else x,
+            state,
         )
         c = self.chunk_steps
         lengths = sorted(set(range(1, max(2, c // 2 + 1))) | {c, 2 * c})
-        for key, stacked in self._train_groups.items():
-            n = int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
-            for ln in lengths:
-                if ln > n:
-                    continue
-                fn = self._scan_fn(
-                    self._train_scans, (key, ln), self._train_body, True
-                )
-                perm = jax.device_put(
-                    np.arange(ln, dtype=np.int32) % n
-                )
-                scratch, _ = fn(scratch, stacked, perm)
-        # eval programs + the pair plumbing compile on a normal epoch
-        self.run_epoch_pair(scratch, first=True)
+        # warmup dispatches run the REAL compiled programs — mute the
+        # step stream so compile-time executions don't pollute the
+        # per-step record stream
+        warm_ctx = (
+            self._telemetry.warmup() if self._telemetry is not None
+            else contextlib.nullcontext()
+        )
+        with warm_ctx:
+            for key, stacked in self._train_groups.items():
+                n = int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
+                for ln in lengths:
+                    if ln > n:
+                        continue
+                    fn = self._scan_fn(
+                        self._train_scans, (key, ln), self._train_body, True
+                    )
+                    perm = jax.device_put(
+                        np.arange(ln, dtype=np.int32) % n
+                    )
+                    scratch, _ = fn(scratch, stacked, perm)
+            # eval programs + the pair plumbing compile on a normal epoch
+            self.run_epoch_pair(scratch, first=True)
         return state
 
     def _drive(self, state: TrainState, groups, scans, body, train, first):
@@ -594,6 +634,9 @@ class ScanEpochDriver:
             + (t_prebuild - t_tail)
         tm[f"{phase}_dispatches"] = tm.get(f"{phase}_dispatches", 0.0) \
             + n_chunks
+        if self._telemetry is not None:
+            self._telemetry.counter_add("scan_steps", steps)
+            self._telemetry.counter_add(f"scan_{phase}_dispatches", n_chunks)
         return state, dev_sums, steps
 
     def train_epoch(self, state: TrainState, first: bool):
@@ -672,6 +715,7 @@ def fit(
     edge_dtype=np.float32,
     compact=None,
     chunk_steps: int | None = None,
+    telemetry: Telemetry | None = None,
 ) -> tuple[TrainState, dict]:
     """Reference ``main()`` loop: train/validate per epoch, track best.
 
@@ -705,6 +749,12 @@ def fit(
     inside the jitted scan body (data/compact.py). Cuts device-resident
     H2D staging and HBM footprint ~12x; measured neutral on steady-state
     step time (the expansion fuses into the step).
+
+    ``telemetry`` (an ``observe.Telemetry``) wires spans around the
+    staging/epoch phases, padding + dispatch gauges, and — at step level
+    — the in-scan per-step metric stream plus in-graph grad-health
+    metrics. None (or level 'off') changes nothing: no wrapper is applied
+    to any step body and no callback is staged into any compiled program.
 
     ``scan_epochs`` (implies device_resident) folds the epoch into one
     ``lax.scan`` dispatch per bucket shape (ScanEpochDriver) — measured
@@ -764,10 +814,19 @@ def fit(
             in_cap=0, snug=snug, edge_dtype=edge_dtype, pack_fn=pack_fn,
         )
 
-    train_step = jax.jit(
-        train_step_fn or make_train_step(classification), donate_argnums=0
+    telemetry = telemetry or Telemetry.disabled()
+    # raw step BODIES (shared by the per-step jits below and the scan
+    # driver, which stages its own in-scan tap); default steps compute
+    # grad health in-graph at step-level telemetry — extra metric outputs
+    # only, so the trajectory is unchanged
+    base_train = train_step_fn or make_train_step(
+        classification, grad_health=telemetry.step_level
     )
-    eval_step = jax.jit(eval_step_fn or make_eval_step(classification))
+    base_eval = eval_step_fn or make_eval_step(classification)
+    train_step = jax.jit(
+        telemetry.wrap_train_body(base_train), donate_argnums=0
+    )
+    eval_step = jax.jit(telemetry.wrap_eval_body(base_eval))
     best_key = best_metric or ("correct" if classification else "mae")
     best = -np.inf if classification else np.inf
     history = []
@@ -799,22 +858,27 @@ def fit(
 
             expand = make_expander(compact)
         t_pack = time.perf_counter()
-        train_list = list(train_batches(rng))
-        val_list = list(val_batches())
+        with telemetry.span("pack"):
+            train_list = list(train_batches(rng))
+            val_list = list(val_batches())
         staging["pack_s"] = round(time.perf_counter() - t_pack, 2)
         staged_bytes = staged_nbytes(train_list + val_list)
         staging["staged_mb"] = round(staged_bytes / 1e6, 1)
         staging["compact"] = compact is not None
         if check_device_resident_fit(staged_bytes, log_fn=log_fn):
-            driver = ScanEpochDriver(
-                train_step_fn or make_train_step(classification),
-                eval_step_fn or make_eval_step(classification),
-                train_list,
-                val_list,
-                rng,
-                expand=expand,
-                chunk_steps=chunk_steps,
-            )
+            with telemetry.span("stage_scan_stacks",
+                                staged_mb=staging["staged_mb"]):
+                driver = ScanEpochDriver(
+                    base_train,
+                    base_eval,
+                    train_list,
+                    val_list,
+                    rng,
+                    expand=expand,
+                    chunk_steps=chunk_steps,
+                    telemetry=telemetry,
+                )
+            telemetry.sample_hbm("post_staging")
             staging["stack_stage_dispatch_s"] = round(
                 driver.timings["init_stack_stage_s"], 2
             )
@@ -829,12 +893,17 @@ def fit(
             if expand is not None:
                 # the per-step loop sees CompactBatches: expansion moves
                 # into the jitted step bodies
-                tb = train_step_fn or make_train_step(classification)
-                eb = eval_step_fn or make_eval_step(classification)
                 train_step = jax.jit(
-                    lambda s, b: tb(s, expand(b)), donate_argnums=0
+                    telemetry.wrap_train_body(
+                        lambda s, b: base_train(s, expand(b))
+                    ),
+                    donate_argnums=0,
                 )
-                eval_step = jax.jit(lambda s, b: eb(s, expand(b)))
+                eval_step = jax.jit(
+                    telemetry.wrap_eval_body(
+                        lambda s, b: base_eval(s, expand(b))
+                    )
+                )
     plan = (
         PackOncePlan(
             (lambda: packed_lists[0]) if packed_lists is not None
@@ -847,12 +916,14 @@ def fit(
         if pack_once and driver is None
         else None
     )
+    telemetry.observe_padding(pad_stats)
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         if driver is not None:
-            state, train_m, val_m = driver.run_epoch_pair(
-                state, first=epoch == start_epoch
-            )
+            with telemetry.span("epoch", epoch=epoch, driver="scan"):
+                state, train_m, val_m = driver.run_epoch_pair(
+                    state, first=epoch == start_epoch
+                )
         else:
             if plan is not None:
                 epoch_train, epoch_val = plan.epoch_iterators()
@@ -861,24 +932,31 @@ def fit(
                 epoch_val = val_batches()
             # device-resident batches need no staging; re-putting them
             # through the prefetch thread would only add overhead
-            stage = (lambda it: it) if device_resident else prefetch_to_device
-            state, train_m = run_epoch(
-                train_step,
-                state,
-                _with_profile(stage(epoch_train), epoch),
-                train=True,
-                print_freq=print_freq,
-                epoch=epoch,
-                log_fn=log_fn,
+            stage = (
+                (lambda it: it) if device_resident
+                else (lambda it: prefetch_to_device(it, telemetry=telemetry))
             )
-            _, val_m = run_epoch(
-                eval_step,
-                state,
-                stage(epoch_val),
-                train=False,
-                epoch=epoch,
-                log_fn=log_fn,
-            )
+            with telemetry.span("epoch", epoch=epoch, driver="per_step"):
+                state, train_m = run_epoch(
+                    train_step,
+                    state,
+                    _with_profile(stage(epoch_train), epoch),
+                    train=True,
+                    print_freq=print_freq,
+                    epoch=epoch,
+                    log_fn=log_fn,
+                    telemetry=telemetry,
+                )
+            with telemetry.span("eval", epoch=epoch):
+                _, val_m = run_epoch(
+                    eval_step,
+                    state,
+                    stage(epoch_val),
+                    train=False,
+                    epoch=epoch,
+                    log_fn=log_fn,
+                    telemetry=telemetry,
+                )
         if epoch == start_epoch:
             log_fn(pad_stats.summary())
         metric = val_m.get(best_key, np.nan)

@@ -1,76 +1,12 @@
-"""Observability: machine-readable metrics, profiling, NaN debugging.
+"""Compatibility shim: observability moved to the ``cgnn_tpu.observe``
+package (in-scan metric streaming, span tracing, gauges, run manifest —
+see its module docs). The names historically importable from here keep
+working."""
 
-SURVEY.md §5 prescribes clu.metric_writers -> stdout + TSV/TensorBoard,
-a jax.profiler harness, and a debug-nans flag on top of the reference's
-print-only logging. ``MetricsLogger`` writes:
+from cgnn_tpu.observe.metrics_io import (  # noqa: F401
+    MetricsLogger,
+    enable_debug_nans,
+    profile_trace,
+)
 
-- ``metrics.jsonl`` — one JSON object per epoch/event (always; no deps)
-- TensorBoard event files via ``clu.metric_writers.SummaryWriter`` when clu
-  (+ its TF backing) is importable; degraded silently otherwise
-
-``profile_trace`` wraps a step range in ``jax.profiler.trace`` producing an
-xprof/perfetto trace under the log dir.
-"""
-
-from __future__ import annotations
-
-import contextlib
-import json
-import os
-import time
-from typing import Iterator
-
-
-class MetricsLogger:
-    """Epoch/event metrics -> metrics.jsonl (+ TensorBoard when available)."""
-
-    def __init__(self, log_dir: str, use_clu: bool = True):
-        self.log_dir = log_dir
-        os.makedirs(log_dir, exist_ok=True)
-        self._jsonl = open(
-            os.path.join(log_dir, "metrics.jsonl"), "a", buffering=1
-        )
-        self._writer = None
-        if use_clu:
-            try:
-                from clu import metric_writers
-
-                self._writer = metric_writers.SummaryWriter(log_dir)
-            except Exception:  # noqa: BLE001 — TF backing may be absent
-                self._writer = None
-
-    def write(self, step: int, values: dict, prefix: str = "") -> None:
-        scalars = {
-            (f"{prefix}/{k}" if prefix else k): float(v)
-            for k, v in values.items()
-            if isinstance(v, (int, float)) and v == v  # drop NaNs
-        }
-        rec = {"step": int(step), "time": time.time(), **scalars}
-        self._jsonl.write(json.dumps(rec) + "\n")
-        if self._writer is not None:
-            self._writer.write_scalars(int(step), scalars)
-
-    def close(self) -> None:
-        self._jsonl.close()
-        if self._writer is not None:
-            self._writer.close()
-
-
-@contextlib.contextmanager
-def profile_trace(log_dir: str, enabled: bool = True) -> Iterator[None]:
-    """jax.profiler.trace context (xprof/perfetto trace under log_dir)."""
-    if not enabled:
-        yield
-        return
-    import jax
-
-    os.makedirs(log_dir, exist_ok=True)
-    with jax.profiler.trace(log_dir):
-        yield
-
-
-def enable_debug_nans() -> None:
-    """Fail fast with a traceback at the first NaN any jitted op produces."""
-    import jax
-
-    jax.config.update("jax_debug_nans", True)
+__all__ = ["MetricsLogger", "enable_debug_nans", "profile_trace"]

@@ -61,6 +61,7 @@ def make_train_step(
     loss_fn: Callable | None = None,
     loss_scale: float = 1.0,
     pmean_grads: bool = True,
+    grad_health: bool = False,
 ) -> Callable:
     """Build the (state, batch) -> (state, metrics) step body.
 
@@ -75,6 +76,13 @@ def make_train_step(
     ON, where the transpose already psums parameter cotangents over every
     mesh axis: scaling by 1/axis_size turns that sum into the DDP mean
     (cgnn_tpu.parallel.edge_parallel 2-D mesh step).
+
+    ``grad_health`` adds in-graph grad-norm / update-norm / NaN-Inf-count
+    metrics (observe.health) — extra metric OUTPUTS only, computed from
+    the applied (post-``pmean``) grads; the update itself is untouched,
+    so the training trajectory is identical with it on or off. Not psum-ed
+    under ``axis_name``: post-pmean grads are replicated, so the values
+    (and their per-step counts of 1) are already consistent across shards.
     """
     compute_loss = loss_fn or (classification_loss if classification else regression_loss)
 
@@ -92,7 +100,7 @@ def make_train_step(
             loss, metrics = compute_loss(out, batch, state.normalizer)
             return loss * loss_scale, (metrics, mutated["batch_stats"])
 
-        (_, (metrics, new_stats)), grads = jax.value_and_grad(
+        (loss, (metrics, new_stats)), grads = jax.value_and_grad(
             loss_with_aux, has_aux=True
         )(state.params)
         if axis_name is not None:
@@ -103,7 +111,21 @@ def make_train_step(
                 grads = lax.pmean(grads, axis_name)
             new_stats = lax.pmean(new_stats, axis_name)
             metrics = lax.psum(metrics, axis_name)
-        return state.apply_gradients(grads, new_stats), metrics
+        new_state = state.apply_gradients(grads, new_stats)
+        if grad_health:
+            from cgnn_tpu.observe.health import grad_health_metrics
+
+            # the raw loss is per-shard under axis_name (unlike the
+            # post-pmean grads): reduce it first so a NaN on ANY shard is
+            # visible everywhere instead of shard 0's value escaping the
+            # shard_map as the replicated output
+            health_loss = (
+                loss if axis_name is None else lax.pmean(loss, axis_name)
+            )
+            metrics = metrics | grad_health_metrics(
+                grads, state.params, new_state.params, loss=health_loss
+            )
+        return new_state, metrics
 
     return train_step
 

@@ -176,6 +176,24 @@ def _dense_setup(n_graphs=16, batch_size=16, n_shards=4):
     return graphs, batch, targets, tx, model_ref, model_gp
 
 
+def test_shard_transpose_slots_checks_node_cap_divisibility():
+    """The raise fires at the REAL precondition (node_cap % n_shards) with
+    a message that matches it — not only when the edge capacity happens to
+    be indivisible too (ADVICE r5: node_cap=6, dense_m=8, n_shards=4 has
+    e_cap=48 divisible by 4, yet strips would cut mid node-row and die
+    later as an opaque shard_map error)."""
+    from cgnn_tpu.data.graph import shard_transpose_slots
+
+    node_cap, dense_m, n_shards = 6, 8, 4
+    e_cap = node_cap * dense_m
+    assert e_cap % n_shards == 0  # the case the old check let through
+    neighbors = np.zeros(e_cap, np.int32)
+    edge_real = np.zeros(e_cap, bool)
+    with pytest.raises(ValueError, match="node_cap 6 not divisible"):
+        shard_transpose_slots(neighbors, edge_real, node_cap, dense_m,
+                              n_shards, over_cap=8)
+
+
 def test_shard_transpose_mapping_is_complete():
     """Per-shard mappings pass the same completeness invariant as the flat
     mapping (invariants._check_transpose_mapping understands both), and a

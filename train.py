@@ -76,7 +76,18 @@ def build_parser() -> argparse.ArgumentParser:
     # runtime
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ckpt-dir", type=str, default="checkpoints")
-    # observability (SURVEY.md §5)
+    # observability (SURVEY.md §5; cgnn_tpu.observe)
+    p.add_argument("--telemetry", choices=["off", "epoch", "step"],
+                   default="epoch",
+                   help="telemetry level (cgnn_tpu.observe). 'epoch' "
+                        "(default, zero per-step overhead): epoch records "
+                        "in metrics.jsonl + host span trace (trace.json, "
+                        "open in Perfetto) + run manifest (manifest.json) "
+                        "+ padding/HBM/dispatch gauges. 'step' adds "
+                        "per-step loss/grad-norm/NaN streaming from "
+                        "INSIDE the epoch scan (async host callback; scan "
+                        "trajectory unchanged) and in-graph grad-health "
+                        "metrics. 'off' writes nothing")
     p.add_argument("--log-dir", type=str, default="",
                    help="metrics dir (metrics.jsonl + TensorBoard when "
                         "available); default: <ckpt-dir>/logs")
@@ -219,7 +230,7 @@ def main(argv=None) -> int:
     from cgnn_tpu.train.loop import capacities_for, evaluate, fit
 
     if args.debug_nans:
-        from cgnn_tpu.train.observe import enable_debug_nans
+        from cgnn_tpu.observe import enable_debug_nans
 
         enable_debug_nans()
     if args.check_invariants:
@@ -233,6 +244,11 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     print(f"devices: {devices}")
+
+    from cgnn_tpu.observe import Telemetry
+
+    log_dir = args.log_dir or os.path.join(args.ckpt_dir, "logs")
+    telemetry = Telemetry(args.telemetry, log_dir)
 
     if (args.device_resident and not args.no_scan_epochs
             and not args.profile):
@@ -259,7 +275,8 @@ def main(argv=None) -> int:
     if args.cache and os.path.exists(args.cache):
         from cgnn_tpu.data.cache import load_graph_cache
 
-        graphs = load_graph_cache(args.cache)
+        with telemetry.span("load_cache", path=args.cache):
+            graphs = load_graph_cache(args.cache)
         print(f"loaded {len(graphs)} graphs from {args.cache} "
               f"in {time.perf_counter() - t0:.1f}s")
         if args.task == "force":
@@ -311,14 +328,17 @@ def main(argv=None) -> int:
         if args.workers != 1:
             from cgnn_tpu.data.cache import featurize_directory_parallel
 
-            graphs, failures = featurize_directory_parallel(
-                args.root_dir, data_cfg.featurize_config(),
-                workers=args.workers or None,
-            )
+            with telemetry.span("featurize", root=args.root_dir):
+                graphs, failures = featurize_directory_parallel(
+                    args.root_dir, data_cfg.featurize_config(),
+                    workers=args.workers or None,
+                )
             for cif_id, err in failures[:10]:
                 print(f"skipped {cif_id}: {err}", file=sys.stderr)
         else:
-            graphs = load_cif_directory(args.root_dir, data_cfg.featurize_config())
+            with telemetry.span("featurize", root=args.root_dir):
+                graphs = load_cif_directory(
+                    args.root_dir, data_cfg.featurize_config())
     else:
         print("either DATA_DIR or --synthetic N is required", file=sys.stderr)
         return 2
@@ -439,10 +459,11 @@ def main(argv=None) -> int:
     example = next(batch_iterator(train_g, args.batch_size, node_cap, edge_cap,
                                   dense_m=layout_m, snug=snug,
                                   edge_dtype=edge_dtype))
-    state = create_train_state(model, example, tx, normalizer,
-                               rng=jax.random.key(args.seed))
+    with telemetry.span("state_init"):
+        state = create_train_state(model, example, tx, normalizer,
+                                   rng=jax.random.key(args.seed))
 
-    ckpt = CheckpointManager(args.ckpt_dir)
+    ckpt = CheckpointManager(args.ckpt_dir, telemetry=telemetry)
     start_epoch = args.start_epoch
     if args.resume:
         resume_mgr = ckpt if os.path.abspath(args.resume) == ckpt.directory \
@@ -459,14 +480,17 @@ def main(argv=None) -> int:
         s, dict(meta_base, epoch=e, best_mae=m.get(sel_key, -1.0)), is_best=b
     )
 
-    from cgnn_tpu.train.observe import MetricsLogger
-
-    log_dir = args.log_dir or os.path.join(args.ckpt_dir, "logs")
-    mlog = MetricsLogger(log_dir)
-
-    def log_epoch_metrics(epoch, train_m, val_m):
-        mlog.write(epoch, train_m, prefix="train")
-        mlog.write(epoch, val_m, prefix="val")
+    # run manifest: config + device/mesh inventory + git SHA, written once
+    telemetry.write_manifest(
+        vars(args),
+        task=args.task,
+        mesh_shape={
+            "data": (len(devices) // graph_shards
+                     if args.data_parallel else 1),
+            "graph": graph_shards,
+        },
+    )
+    log_epoch_metrics = telemetry.write_epoch
 
     step_overrides = {}
     eval_step_fn = None
@@ -514,7 +538,8 @@ def main(argv=None) -> int:
         if force_task:
             step_overrides |= {
                 "train_step_fn": make_force_train_step(
-                    args.energy_weight, args.force_weight, axis_name="data"
+                    args.energy_weight, args.force_weight, axis_name="data",
+                    grad_health=telemetry.step_level,
                 ),
                 "eval_step_fn": make_force_eval_step(
                     args.energy_weight, args.force_weight, axis_name="data"
@@ -531,7 +556,7 @@ def main(argv=None) -> int:
             dense_m=layout_m, buckets=args.buckets, snug=snug,
             scan_epochs=args.scan_epochs, profile_steps=args.profile,
             profile_dir=log_dir, edge_dtype=edge_dtype,
-            chunk_steps=args.chunk_steps,
+            chunk_steps=args.chunk_steps, telemetry=telemetry,
             **step_overrides,
         )
         state = fit_state.replace(apply_fn=state.apply_fn)
@@ -539,7 +564,8 @@ def main(argv=None) -> int:
         if force_task:
             step_overrides |= {
                 "train_step_fn": make_force_train_step(
-                    args.energy_weight, args.force_weight
+                    args.energy_weight, args.force_weight,
+                    grad_health=telemetry.step_level,
                 ),
                 "eval_step_fn": eval_step_fn,
             }
@@ -575,12 +601,14 @@ def main(argv=None) -> int:
             pack_once=args.pack_once, device_resident=args.device_resident,
             dense_m=layout_m, scan_epochs=args.scan_epochs, snug=snug,
             edge_dtype=edge_dtype, chunk_steps=args.chunk_steps,
+            telemetry=telemetry,
             **step_overrides,
         )
 
-    test_m = evaluate(state, test_g, args.batch_size, node_cap, edge_cap,
-                      classification, eval_step_fn=eval_step_fn,
-                      dense_m=layout_m, snug=snug, edge_dtype=edge_dtype)
+    with telemetry.span("test_eval"):
+        test_m = evaluate(state, test_g, args.batch_size, node_cap, edge_cap,
+                          classification, eval_step_fn=eval_step_fn,
+                          dense_m=layout_m, snug=snug, edge_dtype=edge_dtype)
     print(f"** test {sel_key}: {test_m.get(sel_key, float('nan')):.4f} "
           f"(best val: {result['best']:.4f})")
     if force_task:
@@ -616,8 +644,9 @@ def main(argv=None) -> int:
         print("** test " + "  ".join(
             f"{k} {v:.4f}" for k, v in cls.items() if v == v))
 
-    mlog.write(args.epochs, test_m, prefix="test")
-    mlog.close()
+    telemetry.write_scalars(args.epochs, test_m, prefix="test")
+    telemetry.sample_hbm("end_of_run")
+    telemetry.close()  # flushes gauges/counters; exports trace.json
     ckpt.close()
     return 0
 
