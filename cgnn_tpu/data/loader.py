@@ -33,15 +33,35 @@ def prefetch_to_device(
     size: int = 2,
     device_put: Callable = jax.device_put,
     telemetry=None,
+    join_timeout: float = 5.0,
 ) -> Iterator[GraphBatch]:
-    """Wrap a host batch iterator with an N-deep on-device prefetch queue."""
+    """Wrap a host batch iterator with an N-deep on-device prefetch queue.
+
+    The producer shuts down when the CONSUMER abandons the iterator
+    mid-epoch too (an exception in the train loop closes the generator):
+    every queue put is bounded by a stop event the generator's
+    ``finally`` sets, so the thread can never block forever on a full
+    queue holding staged device buffers alive. Normal completion and
+    producer-error propagation are unchanged.
+    """
     q: queue.Queue = queue.Queue(maxsize=size)
     err: list[BaseException] = []
+    stop = threading.Event()
+
+    def bounded_put(item) -> bool:
+        """put that gives up when the consumer is gone -> False."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
             it = iter(batches)
-            while True:
+            while not stop.is_set():
                 t0 = time.perf_counter()
                 try:
                     b = next(it)
@@ -52,22 +72,31 @@ def prefetch_to_device(
                     telemetry.counter_add(
                         "loader_put_s", time.perf_counter() - t0
                     )
-                q.put(staged)
+                if not bounded_put(staged):
+                    return  # consumer abandoned mid-epoch
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer side
             err.append(e)
         finally:
-            q.put(_SENTINEL)
+            bounded_put(_SENTINEL)
 
     t = threading.Thread(target=producer, daemon=True, name="cgnn-prefetch")
     t.start()
-    while True:
-        t0 = time.perf_counter()
-        item = q.get()
-        if telemetry is not None:
-            telemetry.counter_add("loader_wait_s", time.perf_counter() - t0)
-        if item is _SENTINEL:
-            break
-        yield item
-    t.join()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            item = q.get()
+            if telemetry is not None:
+                telemetry.counter_add(
+                    "loader_wait_s", time.perf_counter() - t0
+                )
+            if item is _SENTINEL:
+                break
+            yield item
+    finally:
+        # reached on normal exhaustion AND on generator close (consumer
+        # exception/abandonment): release the producer, then join — the
+        # bounded puts guarantee it exits within one timeout tick
+        stop.set()
+        t.join(join_timeout)
     if err:
         raise err[0]

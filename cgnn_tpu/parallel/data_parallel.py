@@ -28,6 +28,7 @@ from cgnn_tpu.data.graph import (
     batch_shape_key,
     bucketed_batch_iterator,
 )
+from cgnn_tpu.resilience import faultinject
 from cgnn_tpu.train.state import TrainState
 from cgnn_tpu.train.step import make_eval_step, make_train_step
 
@@ -199,6 +200,7 @@ def make_parallel_train_step(
     loss_fn: Callable | None = None,
     inner_step: Callable | None = None,
     grad_health: bool = False,
+    guard: bool = False,
 ) -> Callable:
     """shard_map-wrapped train step: (replicated state, [D,...] batch).
 
@@ -210,7 +212,10 @@ def make_parallel_train_step(
     be built with ``axis_name='data'`` — e.g. the force-task step; only
     supported on 1-D data meshes). ``grad_health`` adds the in-graph
     grad/update-norm and NaN/Inf metrics to the default body
-    (train.step.make_train_step); extra outputs only.
+    (train.step.make_train_step); extra outputs only. ``guard`` wraps the
+    body with the in-graph divergence guard (resilience.guard): the
+    post-pmean params it checks are replicated, so every device takes the
+    same keep-or-skip branch.
     """
     axes = _replica_axes(mesh)
     if inner_step is not None and axes != ("data",):
@@ -221,6 +226,10 @@ def make_parallel_train_step(
         classification, axis_name=axes, loss_fn=loss_fn,
         grad_health=grad_health,
     )
+    if guard:
+        from cgnn_tpu.resilience.guard import guard_step
+
+        inner = guard_step(inner)
 
     def body(state: TrainState, stacked: GraphBatch):
         return inner(state, _squeeze0(stacked))
@@ -318,6 +327,9 @@ def fit_data_parallel(
     edge_dtype=np.float32,
     chunk_steps: int | None = None,
     telemetry=None,
+    guard: bool = False,
+    monitor=None,
+    preempt=None,
 ) -> tuple[TrainState, dict]:
     """DP twin of train.loop.fit; ``batch_size`` is per device.
 
@@ -347,6 +359,13 @@ def fit_data_parallel(
     (the driver taps the post-shard_map metrics, one callback per step).
     The DP PER-STEP loop does not stream (its metrics live inside the
     shard_map body); epoch aggregates and gauges still flow.
+
+    ``guard``/``monitor``/``preempt`` mirror train.loop.fit (the
+    resilience layer; see that docstring). The guard wraps the step
+    INSIDE shard_map — its keep-or-skip condition reads replicated
+    post-pmean values, so every device selects the same branch. A
+    monitor rollback re-replicates the restored state over the mesh
+    automatically.
     """
     from cgnn_tpu.observe import Telemetry
     from cgnn_tpu.parallel.mesh import make_mesh
@@ -397,21 +416,24 @@ def fit_data_parallel(
             prep_val = lambda b: prepare_dense_sharded(  # noqa: E731
                 b, graph_shards, train=False)
             train_step = make_dp_edge_parallel_train_step(
-                mesh, classification, dense=True)
+                mesh, classification, dense=True,
+                grad_health=telemetry.step_level, guard=guard)
             eval_step = make_dp_edge_parallel_eval_step(
                 mesh, classification, dense=True)
         else:
             # pack at a shard-divisible edge capacity up front (cheaper
             # than re-padding every batch after the fact)
             edge_cap = -(-edge_cap // graph_shards) * graph_shards
-            train_step = make_dp_edge_parallel_train_step(mesh, classification)
+            train_step = make_dp_edge_parallel_train_step(
+                mesh, classification,
+                grad_health=telemetry.step_level, guard=guard)
             eval_step = make_dp_edge_parallel_eval_step(mesh, classification)
         shard_put = lambda b: shard_stacked_batch(b, mesh)  # noqa: E731
     else:
         n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
         train_step = make_parallel_train_step(
             mesh, classification, inner_step=train_step_fn,
-            grad_health=telemetry.step_level,
+            grad_health=telemetry.step_level, guard=guard,
         )
         eval_step = make_parallel_eval_step(
             mesh, classification, inner_step=eval_step_fn
@@ -426,7 +448,9 @@ def fit_data_parallel(
         PackOncePlan,
         ScanEpochDriver,
         profile_wrap,
+        resilience_epoch_end,
         run_epoch,
+        save_preempted_mid_epoch,
     )
 
     device_resident = device_resident or scan_epochs
@@ -434,13 +458,17 @@ def fit_data_parallel(
     pad_stats = PaddingStats()
 
     def make_train_it():
-        return parallel_batches(
+        # env-gated deterministic fault injection (NaN batches, loader
+        # exceptions); unwrapped when no plan is active. Wrapped AROUND
+        # parallel_batches, so a poisoned batch is a full stacked device
+        # group — every shard sees the fault, like a real bad record
+        return faultinject.poison_batches(parallel_batches(
             train_graphs, n_dev, batch_size, node_cap, edge_cap,
             shuffle=True, rng=rng, dense_m=dense_m, buckets=buckets,
             snug=snug, stats=pad_stats, edge_dtype=edge_dtype,
             prep_fn=prep_train, node_multiple=node_multiple,
             transpose_shards=transpose_shards,
-        )
+        ))
 
     def make_val_it():
         return parallel_batches(
@@ -518,7 +546,7 @@ def fit_data_parallel(
                 driver = ScanEpochDriver(
                     train_step, eval_step, train_list, val_list,
                     rng, stage=stage, chunk_steps=chunk_steps,
-                    telemetry=telemetry,
+                    telemetry=telemetry, preempt=preempt,
                 )
             telemetry.sample_hbm("post_staging")
         else:
@@ -548,12 +576,11 @@ def fit_data_parallel(
             "body); epoch aggregates and gauges are still recorded — use "
             "--scan-epochs for in-scan streaming under DP"
         )
-    if telemetry.step_level and graph_shards > 1:
-        log_fn(
-            "telemetry step: grad-health metrics are not computed by the "
-            "edge-sharded ('graph' mesh) step bodies yet — step records "
-            "carry loss/counts only on this path"
-        )
+    if monitor is not None and monitor.post_restore is None:
+        # a rollback restores onto the default device; re-place it
+        # replicated over the mesh before the next sharded step
+        monitor.post_restore = lambda s: replicate_state(s, mesh)
+    preempted = False
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         if driver is not None:
@@ -561,6 +588,10 @@ def fit_data_parallel(
                 state, train_m, val_m = driver.run_epoch_pair(
                     state, first=epoch == start_epoch
                 )
+            if driver.aborted:
+                save_preempted_mid_epoch(state, epoch, on_epoch_end, log_fn)
+                preempted = True
+                break
             if epoch == start_epoch:
                 log_fn(pad_stats.summary())
         else:
@@ -612,6 +643,10 @@ def fit_data_parallel(
         best_key = best_metric or ("correct" if classification else "mae")
         metric = val_m.get(best_key, np.nan)
         is_best = metric > best if classification else metric < best
+        if driver is not None and driver.eval_truncated:
+            # preemption cut eval short: the metric covers a fraction of
+            # the validation set — never let it repoint 'best'
+            is_best = False
         if is_best:
             best = metric
         history.append({"epoch": epoch, "train_loss": train_loss, "val": val_m})
@@ -627,6 +662,13 @@ def fit_data_parallel(
             on_epoch_metrics(
                 epoch, {"loss": train_loss, "count": train_count}, val_m
             )
-        if on_epoch_end is not None:
-            on_epoch_end(state, epoch, val_m, is_best)
-    return state, {"best": best, "history": history}
+        state, _, preempted = resilience_epoch_end(
+            state, epoch, train_m, val_m, is_best, monitor=monitor,
+            on_epoch_end=on_epoch_end, preempt=preempt, log_fn=log_fn,
+        )
+        if preempted:
+            break
+    out = {"best": best, "history": history}
+    if preempted:
+        out["preempted"] = True
+    return state, out

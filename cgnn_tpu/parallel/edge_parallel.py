@@ -236,11 +236,25 @@ def _specs(graph_axis, data_axis=None, dense=False, with_transpose=True):
     return batch_specs(graph_axis=graph_axis, data_axis=data_axis)
 
 
+def _harden(inner: Callable, guard: bool) -> Callable:
+    """Optionally wrap an edge-sharded train body with the divergence
+    guard. Safe under replication checking: the guard's keep-or-skip
+    condition reads post-transpose-psum grads/params, which are already
+    replicated over 'graph', so its selects and skip metrics are too."""
+    if not guard:
+        return inner
+    from cgnn_tpu.resilience.guard import guard_step
+
+    return guard_step(inner)
+
+
 def make_edge_parallel_train_step(
     mesh: Mesh,
     classification: bool = False,
     graph_axis: str = "graph",
     dense: bool = False,
+    grad_health: bool = False,
+    guard: bool = False,
 ) -> Callable:
     """(replicated state, edge-sharded batch) -> (state, metrics).
 
@@ -249,8 +263,17 @@ def make_edge_parallel_train_step(
     ``dense_m``; batches via ``prepare_dense_sharded``). Replication
     checking stays ON so the parameter-gradient psum over the graph axis
     is inserted by transpose.
+
+    ``grad_health`` adds the in-graph grad/update-norm and NaN/Inf
+    metrics (observe.health) — the PR-1 known gap, closed: the values
+    derive from the post-transpose-psum grads and the model's own
+    psum-complete loss, both replicated over 'graph', so they pass
+    replication checking without extra collectives. ``guard`` wraps the
+    body with the divergence guard (see ``_harden``).
     """
-    inner = make_train_step(classification)
+    inner = _harden(
+        make_train_step(classification, grad_health=grad_health), guard
+    )
 
     smapped = jax.shard_map(
         inner,
@@ -283,6 +306,8 @@ def make_dp_edge_parallel_train_step(
     data_axis: str = "data",
     graph_axis: str = "graph",
     dense: bool = False,
+    grad_health: bool = False,
+    guard: bool = False,
 ) -> Callable:
     """2-D mesh step: batches stacked over 'data', edges sharded over
     'graph' within each data shard. Input leaves: [D, ...] with edge leaves
@@ -295,14 +320,23 @@ def make_dp_edge_parallel_train_step(
     mean — an explicit pmean here would be an identity on the already
     reduced value (it arrives axis-invariant), silently leaving grads
     n_data times too large.
+
+    ``grad_health``/``guard`` as in ``make_edge_parallel_train_step``;
+    the health loss is additionally pmean-ed over 'data' by the inner
+    step (any shard's NaN must be visible everywhere, not just shard 0's
+    escaping value).
     """
     from cgnn_tpu.parallel.data_parallel import _squeeze0
 
-    inner = make_train_step(
-        classification,
-        axis_name=data_axis,
-        loss_scale=1.0 / mesh.shape[data_axis],
-        pmean_grads=False,
+    inner = _harden(
+        make_train_step(
+            classification,
+            axis_name=data_axis,
+            loss_scale=1.0 / mesh.shape[data_axis],
+            pmean_grads=False,
+            grad_health=grad_health,
+        ),
+        guard,
     )
 
     def body(state: TrainState, stacked: GraphBatch):

@@ -1,0 +1,53 @@
+"""Fault-tolerant training subsystem (ISSUE 2; ROADMAP production scale).
+
+PR 1 built the eyes (``cgnn_tpu.observe`` per-step NaN/grad-health
+telemetry); this package is the reflexes — detect a fault, recover to a
+known-good state, keep training without a human in the loop. Four
+cooperating layers:
+
+- **integrity**: per-leaf shape/dtype/checksum manifests — the commit
+  marker and verification substrate for crash-safe checkpoints
+  (``train.checkpoint.CheckpointManager`` writes/verifies them).
+- **preempt**: SIGTERM/SIGINT -> checkpoint at the next epoch boundary
+  (next chunk boundary inside the epoch scan), flush telemetry, exit
+  with the distinct resumable code ``RESUMABLE_EXIT_CODE`` so schedulers
+  can requeue with ``--resume auto``.
+- **guard**: in-graph divergence guard — non-finite updates are skipped
+  ON DEVICE (a ``jnp.where`` select of old-vs-new state, safe inside the
+  donated-carry epoch scans; trajectory bit-identical when no fault
+  fires), plus a host-side monitor that rolls back to the last good
+  checkpoint with an LR cut after too many skipped steps.
+- **faultinject**: deterministic, env-gated injection of the faults the
+  layers above must survive — corrupted/truncated checkpoints, NaN
+  batches, loader exceptions, mid-run SIGTERM, mid-save crashes. The
+  test substrate for all of the above.
+"""
+
+from cgnn_tpu.resilience.guard import (
+    DivergenceError,
+    DivergenceMonitor,
+    guard_step,
+    scale_updates,
+)
+from cgnn_tpu.resilience.integrity import (
+    IntegrityError,
+    read_manifest,
+    tree_manifest,
+    verify_tree,
+    write_manifest,
+)
+from cgnn_tpu.resilience.preempt import RESUMABLE_EXIT_CODE, PreemptionHandler
+
+__all__ = [
+    "DivergenceError",
+    "DivergenceMonitor",
+    "IntegrityError",
+    "PreemptionHandler",
+    "RESUMABLE_EXIT_CODE",
+    "guard_step",
+    "read_manifest",
+    "scale_updates",
+    "tree_manifest",
+    "verify_tree",
+    "write_manifest",
+]

@@ -1,0 +1,234 @@
+"""Deterministic fault injection — the test substrate for resilience.
+
+Faults are declared in the ``CGNN_TPU_FAULTS`` environment variable (or
+programmatically via ``set_plan``) as ``;``-separated ``key=value``
+pairs, and fire at exact, countable points, so every failure a test
+provokes is reproducible:
+
+- ``nan_batch=N``    — poison the N-th (0-based) training batch of the
+  run with NaN targets (exercises the divergence guard, incl. inside
+  the epoch scan);
+- ``sigterm_epoch=N``— deliver SIGTERM to this process at the end of
+  epoch N (exercises graceful preemption end to end);
+- ``crash=POINT:N``  — raise ``InjectedCrash`` at the N-th (1-based)
+  hit of the named checkpoint crash point (``after_write`` /
+  ``before_commit`` / ``after_commit`` in the checkpoint finalizer);
+  append ``:exit`` (``crash=POINT:N:exit``) to die with ``os._exit(137)``
+  instead — indistinguishable from ``kill -9`` for the filesystem;
+- ``loader_exc=N``   — raise ``InjectedLoaderError`` in place of the
+  N-th training batch (exercises producer-thread shutdown).
+
+With the variable unset every hook is a cheap no-op: ``plan()`` is
+``None`` and iterators are returned unwrapped.
+
+``corrupt_checkpoint`` is the host-side half of the harness: it
+truncates or bit-flips files of a *committed* save in place, the way
+real disk faults present, to drive the restore fallback chain in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Iterable, Iterator
+
+import numpy as np
+
+ENV_VAR = "CGNN_TPU_FAULTS"
+
+
+class InjectedCrash(RuntimeError):
+    """A crash point fired (simulated mid-save process death)."""
+
+
+class InjectedLoaderError(RuntimeError):
+    """An injected data-loader failure."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    nan_batch: int | None = None
+    sigterm_epoch: int | None = None
+    crash_point: str | None = None
+    crash_hit: int = 1
+    crash_exit: bool = False
+    loader_exc: int | None = None
+    # mutable hit counters (the determinism bookkeeping)
+    _crash_hits: dict = dataclasses.field(default_factory=dict)
+    _batches_seen: int = 0
+    _sigterm_fired: bool = False
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        plan = cls()
+        for part in filter(None, (p.strip() for p in spec.split(";"))):
+            key, _, value = part.partition("=")
+            if key == "nan_batch":
+                plan.nan_batch = int(value)
+            elif key == "sigterm_epoch":
+                plan.sigterm_epoch = int(value)
+            elif key == "loader_exc":
+                plan.loader_exc = int(value)
+            elif key == "crash":
+                fields = value.split(":")
+                plan.crash_point = fields[0]
+                if len(fields) > 1 and fields[1]:
+                    plan.crash_hit = int(fields[1])
+                plan.crash_exit = len(fields) > 2 and fields[2] == "exit"
+            else:
+                raise ValueError(
+                    f"unknown fault key {key!r} in {ENV_VAR}={spec!r}"
+                )
+        return plan
+
+    def describe(self) -> str:
+        parts = []
+        if self.nan_batch is not None:
+            parts.append(f"NaN batch @{self.nan_batch}")
+        if self.sigterm_epoch is not None:
+            parts.append(f"SIGTERM @epoch {self.sigterm_epoch}")
+        if self.crash_point is not None:
+            how = "os._exit(137)" if self.crash_exit else "InjectedCrash"
+            parts.append(
+                f"{how} @{self.crash_point} hit {self.crash_hit}"
+            )
+        if self.loader_exc is not None:
+            parts.append(f"loader exception @batch {self.loader_exc}")
+        return ", ".join(parts) or "none"
+
+
+_plan: FaultPlan | None = None
+_parsed_env: str | None = None
+
+
+def set_plan(plan: FaultPlan | None) -> None:
+    """Install a plan programmatically (tests); None clears it AND
+    re-enables environment-variable parsing (a sticky override would
+    silently disable every later env-configured fault in the process)."""
+    global _plan, _parsed_env
+    _plan = plan
+    _parsed_env = "<programmatic>" if plan is not None else None
+
+
+def plan() -> FaultPlan | None:
+    """The active plan (parsed from the environment once), or None."""
+    global _plan, _parsed_env
+    spec = os.environ.get(ENV_VAR, "")
+    if _parsed_env == "<programmatic>":
+        return _plan
+    if spec != _parsed_env:
+        _parsed_env = spec
+        _plan = FaultPlan.parse(spec) if spec else None
+    return _plan
+
+
+# ---- hooks (each a no-op without an active plan) ----
+
+
+def crash_point(name: str) -> None:
+    """Die here if the plan says so (checkpoint finalizer instrumentation)."""
+    p = plan()
+    if p is None or p.crash_point != name:
+        return
+    hits = p._crash_hits.get(name, 0) + 1
+    p._crash_hits[name] = hits
+    if hits != p.crash_hit:
+        return
+    if p.crash_exit:
+        os._exit(137)  # the kill -9 twin: no cleanup, no atexit, no flush
+    raise InjectedCrash(f"injected crash at {name!r} (hit {hits})")
+
+
+def maybe_sigterm(epoch: int) -> None:
+    """Deliver SIGTERM to ourselves at the configured epoch boundary."""
+    p = plan()
+    if p is None or p.sigterm_epoch != epoch or p._sigterm_fired:
+        return
+    p._sigterm_fired = True
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def poison_nan(batch):
+    """The batch with NaN targets AND NaN node features.
+
+    Targets alone would be a silent no-op for classification (labels go
+    through ``astype(int32)``, turning NaN into the valid label 0); NaN
+    node features propagate through the network to the loss on every
+    task. Node poisoning is skipped for staged forms whose node leaf is
+    integral (compact staging stores vocabulary indices) — their float
+    targets still carry the fault for the regression tasks compact
+    staging supports.
+    """
+    updates = {"targets": np.full_like(np.asarray(batch.targets), np.nan)}
+    nodes = getattr(batch, "nodes", None)
+    if nodes is not None and np.issubdtype(
+        np.asarray(nodes).dtype, np.floating
+    ):
+        updates["nodes"] = np.full_like(np.asarray(nodes), np.nan)
+    return dataclasses.replace(batch, **updates)
+
+
+def poison_batches(batches: Iterable) -> Iterator:
+    """Wrap a training-batch iterator with the plan's batch faults.
+
+    Counts batches ACROSS epochs/iterators (one counter per run), so
+    ``nan_batch=N`` lands mid-scan when the N-th batch falls in a later
+    chunk. Returned unwrapped when no batch fault is configured.
+    """
+    p = plan()
+    if p is None or (p.nan_batch is None and p.loader_exc is None):
+        return iter(batches)
+
+    def wrapped():
+        for b in batches:
+            i = p._batches_seen
+            p._batches_seen += 1
+            if p.loader_exc is not None and i == p.loader_exc:
+                raise InjectedLoaderError(
+                    f"injected loader failure at batch {i}"
+                )
+            yield poison_nan(b) if i == p.nan_batch else b
+
+    return wrapped()
+
+
+# ---- host-side corruption (test utility; no plan needed) ----
+
+
+def corrupt_checkpoint(save_dir: str, mode: str = "garble") -> str:
+    """Corrupt a committed save in place; returns the damaged file.
+
+    ``garble`` bit-flips a span in the middle of the largest data file
+    (caught by the manifest crc32 even when deserialization succeeds);
+    ``truncate`` cuts the largest file in half (deserialization error);
+    ``meta`` overwrites ``meta.json`` with non-JSON bytes.
+    """
+    if mode == "meta":
+        path = os.path.join(save_dir, "meta.json")
+        with open(path, "w") as f:
+            f.write("{not json")
+        return path
+    largest, size = None, -1
+    for root, _, files in os.walk(save_dir):
+        for name in files:
+            if name in ("meta.json", "MANIFEST.json"):
+                continue
+            p = os.path.join(root, name)
+            s = os.path.getsize(p)
+            if s > size:
+                largest, size = p, s
+    if largest is None:
+        raise FileNotFoundError(f"no data files under {save_dir}")
+    if mode == "truncate":
+        with open(largest, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif mode == "garble":
+        with open(largest, "r+b") as f:
+            f.seek(size // 2)
+            span = f.read(64) or b"\x00"
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in span))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return largest

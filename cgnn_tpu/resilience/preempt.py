@@ -1,0 +1,102 @@
+"""Preemption handling: SIGTERM/SIGINT -> graceful checkpoint + resume.
+
+Production schedulers (k8s eviction, TPU preemption notices, slurm)
+deliver SIGTERM with a grace window. The handler converts the signal
+into a *request* flag that the training loops poll at safe points — the
+epoch boundary in the per-step loops, the chunk boundary inside
+``ScanEpochDriver._drive`` (a whole-epoch scan can run minutes; chunk
+granularity keeps the grace window honored). The loop then saves a
+resumable checkpoint, flushes telemetry, and ``train.py`` exits with
+``RESUMABLE_EXIT_CODE`` so the scheduler can distinguish "requeue me
+with --resume auto" from a real failure.
+
+A second signal restores the default disposition and re-raises it — a
+stuck save must not make the process unkillable (and a double Ctrl-C
+still interrupts immediately).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable
+
+# EX_TEMPFAIL: "temporary failure, retry" — the conventional sysexits
+# code closest to "preempted; resume me", and distinct from both success
+# (0) and the argument/data errors train.py already returns (2)
+RESUMABLE_EXIT_CODE = 75
+
+_DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class PreemptionHandler:
+    """Latches termination signals into a pollable checkpoint request."""
+
+    def __init__(self, log_fn: Callable = print):
+        self._event = threading.Event()
+        self._log = log_fn
+        self._installed: dict[int, object] = {}
+        self._signal_no: int | None = None
+        self.requested_at: float | None = None
+
+    # ---- the flag the training loops poll ----
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def request(self, signum: int | None = None) -> None:
+        """Latch a checkpoint-and-exit request (signal handlers and the
+        fault injector call this; tests may call it directly)."""
+        if not self._event.is_set():
+            self.requested_at = time.monotonic()
+            self._signal_no = signum
+            self._event.set()
+
+    # ---- signal plumbing ----
+
+    def _on_signal(self, signum, frame):  # noqa: ARG002 — signal API
+        if self._event.is_set():
+            # second signal: stop being graceful — restore the default
+            # disposition and re-deliver so the process dies now
+            self._log(
+                f"second signal {signal.Signals(signum).name}: exiting "
+                f"immediately (graceful checkpoint abandoned)"
+            )
+            self.uninstall()
+            signal.raise_signal(signum)
+            return
+        self._log(
+            f"{signal.Signals(signum).name} received: checkpoint requested "
+            f"at the next epoch/chunk boundary (send again to exit now)"
+        )
+        self.request(signum)
+
+    def install(self, signals=_DEFAULT_SIGNALS) -> "PreemptionHandler":
+        """Install handlers (main thread only — signal module rule)."""
+        for sig in signals:
+            self._installed[sig] = signal.signal(sig, self._on_signal)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._installed.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):  # non-main thread / teardown
+                pass
+        self._installed.clear()
+
+    @classmethod
+    def installed(cls, log_fn: Callable = print) -> "PreemptionHandler":
+        return cls(log_fn=log_fn).install()
+
+
+def resumable_exit(log_fn: Callable = print) -> int:
+    """Log the resume instructions and return the resumable exit code."""
+    log_fn(
+        f"preempted: resumable checkpoint saved — rerun with "
+        f"--resume auto (exit code {RESUMABLE_EXIT_CODE}, pid {os.getpid()})"
+    )
+    return RESUMABLE_EXIT_CODE

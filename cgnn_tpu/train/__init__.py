@@ -10,10 +10,11 @@ from cgnn_tpu.train.normalizer import Normalizer
 from cgnn_tpu.train.state import TrainState, create_train_state, make_optimizer
 from cgnn_tpu.train.step import make_train_step, make_eval_step
 from cgnn_tpu.train.metrics import AverageMeter, mae, class_eval
-from cgnn_tpu.train.checkpoint import CheckpointManager
+from cgnn_tpu.train.checkpoint import CheckpointManager, CheckpointRestoreError
 from cgnn_tpu.train.loop import fit, evaluate
 
 __all__ = [
+    "CheckpointRestoreError",
     "Normalizer",
     "TrainState",
     "create_train_state",

@@ -1,25 +1,81 @@
-"""Checkpoint/resume on orbax (SURVEY.md §2 component 10, §5).
+"""Crash-safe checkpoint/resume on orbax (SURVEY.md §2 component 10, §5).
 
-Same semantics as the reference's ``save_checkpoint``/``--resume``: every
-epoch saves the full training state (params, BatchNorm stats, optimizer
-state, step, Normalizer, RNG) plus metadata (config dict, epoch, best
-metric); the best-so-far checkpoint is retained alongside the latest
-(``model_best.pth.tar`` equivalent). Saves are async — orbax writes in a
-background thread while training continues.
+Semantics follow the reference's ``save_checkpoint``/``--resume`` —
+every epoch saves the full training state (params, BatchNorm stats,
+optimizer state, step, Normalizer, RNG) plus metadata (config dict,
+epoch, best metric) — but the on-disk protocol is built for processes
+that die mid-save (ISSUE 2):
+
+- every save goes to a FRESH versioned directory (``ckpt-00000012``),
+  written under a dot-temp name and atomically ``os.replace``-d into
+  place. Nothing is ever overwritten, so a kill -9 at any instant
+  leaves every previously committed checkpoint intact (the old
+  ``force=True`` overwrite of ``latest/`` corrupted the only resume
+  point);
+- a sidecar integrity manifest (per-leaf shape/dtype/crc32;
+  ``resilience.integrity``) is written LAST inside the temp directory —
+  it doubles as the commit marker: a directory without one is an
+  uncommitted save and is never offered for restore;
+- restore walks a fallback chain (newest committed -> older -> best)
+  verifying each candidate against its manifest, and reports every
+  candidate it skipped and why (``last_restore_report``);
+- retention keeps the newest ``keep`` saves plus the best-pointer
+  target; ``best`` is an atomically updated pointer file
+  (``best.json``), not a second copy of the tree.
+
+Saves stay async: the caller's thread only pays for the device fetch;
+an ordered background finalizer does the orbax write, manifest, commit
+rename, and retention. Trees are host-localized (numpy) before saving
+so checkpoints carry no device-mesh shardings — a state saved from an
+8-device run restores in a single-chip predict/resume process.
+
+The pre-ISSUE-2 tag layout (``latest/``/``best/`` dirs +
+``meta-<tag>.json``) is still readable, as a last-resort link in the
+fallback chain (no manifest, so no verification).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
+import queue
+import re
+import shutil
+import sys
+import threading
+from typing import Callable
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
+from cgnn_tpu.resilience import faultinject
+from cgnn_tpu.resilience.integrity import (
+    read_manifest,
+    tree_manifest,
+    verify_tree,
+    write_manifest,
+)
 from cgnn_tpu.train.state import TrainState
 
 _LATEST = "latest"
 _BEST = "best"
+_PREVIOUS = "previous"
+_SAVE_RE = re.compile(r"^ckpt-(\d{8})$")
+_TMP_PREFIX = ".tmp-"
+_BEST_POINTER = "best.json"
+
+
+class CheckpointRestoreError(RuntimeError):
+    """No candidate in the restore fallback chain was usable."""
+
+    def __init__(self, tag: str, attempts: list[str]):
+        self.attempts = attempts
+        detail = "; ".join(attempts) if attempts else "no checkpoints found"
+        super().__init__(
+            f"no restorable {tag!r} checkpoint: {detail}"
+        )
 
 
 def _state_pytree(state: TrainState) -> dict:
@@ -33,64 +89,322 @@ def _state_pytree(state: TrainState) -> dict:
     }
 
 
+@dataclasses.dataclass(frozen=True)
+class _Candidate:
+    """One restorable location: a committed versioned save or a legacy
+    tag directory (``manifest_dir`` None = legacy, unverifiable)."""
+
+    name: str
+    state_path: str
+    meta_path: str
+    manifest_dir: str | None
+
+
 class CheckpointManager:
-    """Latest + best checkpoint pair with JSON metadata, async saves.
+    """Versioned atomic saves + fallback-chain restores (module docstring).
 
     ``telemetry`` (an ``observe.Telemetry``) wraps the host-side part of
-    saves/restores in spans — saves are async (orbax writes in a
-    background thread), so the span covers the device_get + dispatch,
-    which is exactly the part that stalls training.
+    saves/restores in spans — the save span covers the device fetch +
+    finalizer dispatch, which is exactly the part that stalls training.
+    ``keep`` bounds retention (newest ``keep`` saves + the best target;
+    ``keep=0`` retains everything).
     """
 
-    def __init__(self, directory: str, telemetry=None):
+    def __init__(self, directory: str, telemetry=None, keep: int = 3,
+                 log_fn: Callable | None = None):
         from cgnn_tpu.observe import Telemetry
 
+        # default log sink is stderr: restore-fallback reports are
+        # operator-facing diagnostics, not program output
+        self._log = log_fn or (
+            lambda msg: print(msg, file=sys.stderr)
+        )
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self.keep = keep
         self._ckptr = ocp.StandardCheckpointer()
         # Telemetry.span is already a nullcontext at level 'off'
         self._telemetry = telemetry or Telemetry.disabled()
+        self._lock = threading.Lock()
+        self._jobs: queue.Queue = queue.Queue()
+        self._worker: threading.Thread | None = None
+        self._errors: list[BaseException] = []
+        self.last_restore_report: list[str] = []
+        self._swept_tmp = False
+        self._next_seq = 1 + max(
+            (int(m.group(1)) for m in map(_SAVE_RE.match,
+                                          os.listdir(self.directory)) if m),
+            default=-1,
+        )
 
-    def _path(self, tag: str) -> str:
-        return os.path.join(self.directory, tag)
+    # ---- directory inventory ----
 
-    def _meta_path(self, tag: str) -> str:
-        return os.path.join(self.directory, f"meta-{tag}.json")
+    def _committed_saves(self) -> list[str]:
+        """Committed (manifest-bearing) save names, newest first."""
+        names = [
+            n for n in os.listdir(self.directory)
+            if _SAVE_RE.match(n)
+            and read_manifest(os.path.join(self.directory, n)) is not None
+        ]
+        return sorted(names, reverse=True)
+
+    def _best_target(self) -> str | None:
+        try:
+            with open(os.path.join(self.directory, _BEST_POINTER)) as f:
+                name = json.load(f).get("save")
+        except (OSError, ValueError):
+            return None
+        if name and _SAVE_RE.match(name) and os.path.isdir(
+            os.path.join(self.directory, name)
+        ):
+            return name
+        return None
+
+    def _save_candidate(self, name: str) -> _Candidate:
+        d = os.path.join(self.directory, name)
+        return _Candidate(
+            name=name,
+            state_path=os.path.join(d, "state"),
+            meta_path=os.path.join(d, "meta.json"),
+            manifest_dir=d,
+        )
+
+    def _legacy_candidate(self, tag: str) -> _Candidate | None:
+        d = os.path.join(self.directory, tag)
+        if not os.path.isdir(d):
+            return None
+        return _Candidate(
+            name=f"legacy:{tag}",
+            state_path=d,
+            meta_path=os.path.join(self.directory, f"meta-{tag}.json"),
+            manifest_dir=None,
+        )
+
+    def _candidates(self, tag: str) -> list[_Candidate]:
+        """The restore fallback chain for ``tag``, best-first."""
+        saves = self._committed_saves()
+        best = self._best_target()
+        if tag == _BEST:
+            ordered = [best] if best else []
+        elif tag == _PREVIOUS:
+            ordered = saves[1:]
+        elif tag == _LATEST:  # newest -> older -> best
+            ordered = list(saves)
+            if best and best not in ordered:
+                ordered.append(best)
+        else:
+            # arbitrary tag: only ever existed as a legacy tag directory
+            # (the old layout saved to <dir>/<tag>); no versioned chain
+            ordered = []
+        chain = [self._save_candidate(n) for n in ordered]
+        # legacy tag dirs only back up their own tag (and 'best' backs up
+        # 'latest' as the chain's last resort); 'previous' has no legacy
+        # equivalent — the old layout kept a single overwritten 'latest'
+        legacy_tags = {
+            _LATEST: (_LATEST, _BEST), _BEST: (_BEST,), _PREVIOUS: (),
+        }.get(tag, (tag,))
+        for t in legacy_tags:
+            cand = self._legacy_candidate(t)
+            if cand is not None:
+                chain.append(cand)
+        return chain
+
+    # ---- metadata ----
 
     def read_meta(self, tag: str = _LATEST) -> dict:
-        if not os.path.exists(self._meta_path(tag)):
-            return {}
-        with open(self._meta_path(tag)) as f:
-            return json.load(f)
-
-    def save(self, state: TrainState, meta: dict, is_best: bool = False):
-        """Save 'latest' (and 'best' when ``is_best``); meta rides alongside
-        as JSON (orbax pytrees are arrays-only; config strings go to JSON,
-        mirroring the reference's checkpoint-embedded ``args``).
-
-        The tree is host-localized (numpy) first so checkpoints carry no
-        device-mesh shardings: a state saved from an 8-device DP/graph-
-        sharded run must restore in a single-chip predict/resume process
-        (orbax would otherwise bake the save-time sharding into the
-        checkpoint and refuse topology-less restores)."""
-        with self._telemetry.span("checkpoint_save", is_best=is_best):
-            tree = jax.device_get(_state_pytree(state))
-            for tag in [_LATEST] + ([_BEST] if is_best else []):
-                self._ckptr.save(self._path(tag), tree, force=True)
-                with open(self._meta_path(tag), "w") as f:
-                    json.dump(meta, f, indent=1)
-
-    def wait(self):
-        self._ckptr.wait_until_finished()
+        for cand in self._candidates(tag):
+            try:
+                with open(cand.meta_path) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                continue
+        return {}
 
     def exists(self, tag: str = _LATEST) -> bool:
-        return os.path.isdir(self._path(tag))
+        return bool(self._candidates(tag))
+
+    # ---- save path ----
+
+    def save(self, state: TrainState, meta: dict, is_best: bool = False):
+        """Atomically commit a new versioned save (async finalizer).
+
+        The calling thread pays only for the device fetch; the write,
+        manifest, commit rename, best-pointer update, and retention run
+        on the ordered background finalizer. Failures surface at the
+        next ``wait()``/``restore()``/``close()``.
+        """
+        with self._telemetry.span("checkpoint_save", is_best=is_best):
+            tree = jax.device_get(_state_pytree(state))
+            if jax.default_backend() == "cpu":
+                # CPU device_get is NOT a snapshot: it returns numpy
+                # views ALIASING the device buffers, which the donated
+                # train steps then mutate while the finalizer is still
+                # serializing — silent checkpoint corruption (caught by
+                # the integrity manifest: the crc of the written bytes
+                # diverged from the re-read ones under load). Real
+                # accelerators already materialize fresh host memory;
+                # copying there would double the blocking save cost.
+                tree = jax.tree_util.tree_map(lambda x: np.array(x), tree)
+            with self._lock:
+                seq = self._next_seq
+                self._next_seq += 1
+            self._sweep_stale_tmp()
+            self._ensure_worker()
+            self._jobs.put((seq, tree, dict(meta), is_best))
+
+    def _sweep_stale_tmp(self):
+        """Remove uncommitted temp dirs a crashed predecessor left —
+        garbage by construction (never offered for restore). Called from
+        the first SAVE only: a manager that merely reads (predict.py, a
+        resume probe) must not delete a concurrently-running trainer's
+        in-progress save out from under its finalizer."""
+        if self._swept_tmp:
+            return
+        self._swept_tmp = True
+        for entry in os.listdir(self.directory):
+            if entry.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.directory, entry),
+                              ignore_errors=True)
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._drain_jobs, daemon=True, name="cgnn-ckpt"
+            )
+            self._worker.start()
+
+    def _drain_jobs(self):
+        while True:
+            job = self._jobs.get()
+            try:
+                if job is None:
+                    return
+                self._finalize(*job)
+            except BaseException as e:  # noqa: BLE001 — surfaced at wait()
+                self._errors.append(e)
+                print(f"checkpoint save failed: {e!r}", file=sys.stderr)
+            finally:
+                self._jobs.task_done()
+
+    def _finalize(self, seq: int, tree: dict, meta: dict, is_best: bool):
+        name = f"ckpt-{seq:08d}"
+        final = os.path.join(self.directory, name)
+        tmp = os.path.join(self.directory, f"{_TMP_PREFIX}{name}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        # a failure anywhere before the os.replace leaves the temp dir
+        # behind, exactly as a crash would — it is invisible to restore
+        # either way, and the next manager on this directory sweeps it
+        self._ckptr.save(os.path.join(tmp, "state"), tree)
+        self._ckptr.wait_until_finished()
+        faultinject.crash_point("after_write")
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        # manifest LAST: it is the commit marker (see integrity)
+        write_manifest(tmp, tree_manifest(tree))
+        faultinject.crash_point("before_commit")
+        os.replace(tmp, final)
+        faultinject.crash_point("after_commit")
+        if is_best:
+            self._point_best(name, meta)
+        self._apply_retention()
+
+    def _point_best(self, name: str, meta: dict):
+        pointer = os.path.join(self.directory, _BEST_POINTER)
+        tmp = pointer + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"save": name, "meta": meta}, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, pointer)
+
+    def _apply_retention(self):
+        if self.keep <= 0:
+            return
+        saves = self._committed_saves()
+        protected = set(saves[: self.keep])
+        best = self._best_target()
+        if best:
+            protected.add(best)
+        for name in saves[self.keep:]:
+            if name not in protected:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    def wait(self):
+        """Block until every dispatched save committed; raise the first
+        finalizer failure (the rest are dropped — they are almost always
+        the same root cause repeating)."""
+        # queue.join() implies the worker finished its per-save orbax
+        # wait_until_finished too — no cross-thread orbax call needed here
+        self._jobs.join()
+        if self._errors:
+            err = self._errors[0]
+            self._errors.clear()
+            raise err
+
+    # ---- restore path ----
+
+    def _verified_restore(self, cand: _Candidate, restore_fn: Callable):
+        """restore_fn(state_path) -> tree, manifest-verified, with meta."""
+        tree = restore_fn(cand.state_path)
+        if cand.manifest_dir is not None:
+            manifest = read_manifest(cand.manifest_dir)
+            if manifest is None:
+                raise RuntimeError(
+                    "integrity manifest missing (uncommitted save?)"
+                )
+            verify_tree(jax.device_get(tree), manifest)
+        try:
+            with open(cand.meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            raise RuntimeError(
+                f"checkpoint meta unreadable ({cand.meta_path}): {e} — "
+                f"refusing to resume blind (a silent epoch-0 restart "
+                f"would retrain over the checkpoint)"
+            ) from None
+        if not isinstance(meta, dict) or not meta:
+            raise RuntimeError(
+                f"checkpoint meta empty ({cand.meta_path}) — refusing to "
+                f"resume blind"
+            )
+        return tree, meta
+
+    def _restore_chain(self, tag: str, restore_fn: Callable):
+        """Walk the fallback chain; -> (candidate, tree, meta)."""
+        self.wait()
+        self.last_restore_report = []
+        chain = self._candidates(tag)
+        for i, cand in enumerate(chain):
+            try:
+                tree, meta = self._verified_restore(cand, restore_fn)
+            except Exception as e:  # noqa: BLE001 — chain to next candidate
+                msg = f"{cand.name}: {type(e).__name__}: {e}"
+                self.last_restore_report.append(msg)
+                self._log(f"checkpoint restore: skipping {msg}")
+                continue
+            if i > 0:
+                self._log(
+                    f"checkpoint restore: fell back to {cand.name} "
+                    f"({i} newer candidate(s) skipped — see above)"
+                )
+            return cand, tree, meta
+        raise CheckpointRestoreError(tag, self.last_restore_report)
 
     def restore(self, state: TrainState, tag: str = _LATEST) -> tuple[TrainState, dict]:
-        """Restore into the structure of ``state`` -> (state, meta)."""
-        self.wait()
+        """Restore into the structure of ``state`` -> (state, meta).
+
+        Falls back newest -> older -> best, verifying each candidate's
+        integrity manifest; raises ``CheckpointRestoreError`` when the
+        whole chain is exhausted.
+        """
         with self._telemetry.span("checkpoint_restore", tag=tag):
-            tree = self._ckptr.restore(self._path(tag), _state_pytree(state))
+            template = _state_pytree(state)
+            cand, tree, meta = self._restore_chain(
+                tag, lambda path: self._ckptr.restore(path, template)
+            )
         from cgnn_tpu.train.normalizer import Normalizer
 
         restored = state.replace(
@@ -101,13 +415,12 @@ class CheckpointManager:
             normalizer=Normalizer.from_state_dict(tree["normalizer"]),
             rng=jax.random.wrap_key_data(tree["rng"]),
         )
-        return restored, self.read_meta(tag)
+        return restored, meta
 
     def restore_for_inference(self, state: TrainState, tag: str = _LATEST):
         """Restore params/stats/normalizer only (no optimizer template)."""
-        self.wait()
         with ocp.PyTreeCheckpointer() as ckptr:
-            raw = ckptr.restore(self._path(tag))
+            _, raw, _ = self._restore_chain(tag, ckptr.restore)
         from cgnn_tpu.train.normalizer import Normalizer
 
         return state.replace(
@@ -117,5 +430,10 @@ class CheckpointManager:
         )
 
     def close(self):
-        self.wait()
-        self._ckptr.close()
+        try:
+            self.wait()
+        finally:
+            if self._worker is not None and self._worker.is_alive():
+                self._jobs.put(None)
+                self._worker.join(timeout=30)
+            self._ckptr.close()

@@ -57,6 +57,7 @@ if _WINDOW < 1:
     _WINDOW = 1
 from cgnn_tpu.observe import Telemetry
 from cgnn_tpu.observe.gauges import device_hbm_table_bytes
+from cgnn_tpu.resilience import faultinject
 from cgnn_tpu.train.state import TrainState
 from cgnn_tpu.train.step import make_eval_step, make_train_step
 
@@ -118,6 +119,53 @@ def check_device_resident_fit(staged_bytes: int, n_devices: int = 1,
         f"smaller dataset/batch capacity."
     )
     return False
+
+
+def save_preempted_mid_epoch(state, epoch: int, on_epoch_end,
+                             log_fn: Callable) -> None:
+    """Chunk-boundary preemption: the epoch is partial, so checkpoint
+    the CURRENT weights under the last COMPLETED epoch — resume then
+    redoes this epoch instead of skipping its unseen tail. Shared by
+    ``fit`` and ``parallel.fit_data_parallel`` (the recovery protocol
+    must not diverge between the single-host and DP loops)."""
+    log_fn(
+        f"preemption: epoch {epoch} stopped at a chunk boundary; saving "
+        f"resumable checkpoint (epoch {epoch - 1})"
+    )
+    if on_epoch_end is not None:
+        on_epoch_end(state, epoch - 1, {}, False)
+
+
+def resilience_epoch_end(state, epoch: int, train_m: dict, val_m: dict,
+                         is_best: bool, *, monitor, on_epoch_end, preempt,
+                         log_fn: Callable):
+    """The epoch-boundary resilience protocol shared by ``fit`` and
+    ``parallel.fit_data_parallel``: divergence check BEFORE the save (a
+    diverged epoch's state must not overwrite the last good checkpoint),
+    the save itself, injected-SIGTERM delivery, and the preemption poll.
+    -> (state, rolled_back, preempted)."""
+    rolled_back = False
+    if monitor is not None:
+        state, rolled_back = monitor.observe(state, epoch, train_m)
+    if on_epoch_end is not None and not rolled_back:
+        on_epoch_end(state, epoch, val_m, is_best)
+    faultinject.maybe_sigterm(epoch)
+    preempted = preempt is not None and preempt.requested
+    if preempted:
+        if rolled_back:
+            # the diverged epoch was NOT saved (by design) — don't tell
+            # the operator a boundary checkpoint exists for it
+            log_fn(
+                f"preemption: stopping after epoch {epoch} — the epoch "
+                f"diverged and was not saved; resume restarts from the "
+                f"last good checkpoint"
+            )
+        else:
+            log_fn(
+                f"preemption: stopping after epoch {epoch} (checkpoint "
+                f"saved at the epoch boundary)"
+            )
+    return state, rolled_back, preempted
 
 
 def run_epoch(
@@ -300,7 +348,8 @@ class ScanEpochDriver:
                  rng: np.random.Generator, stage: Callable | None = None,
                  expand: Callable | None = None,
                  chunk_steps: int | None = None,
-                 telemetry: Telemetry | None = None):
+                 telemetry: Telemetry | None = None,
+                 preempt=None):
         """``stage`` places each stacked group on device (default
         ``jax.device_put``); data-parallel callers pass a mesh-sharding
         stage so the per-step device axis (axis 1 of the stack) lands
@@ -316,7 +365,13 @@ class ScanEpochDriver:
         to the host via an async callback with no fetch on the dispatch
         path and no effect on the donated-carry trajectory. Below step
         level NOTHING is staged — the scanned HLO is identical to a
-        telemetry-free build."""
+        telemetry-free build.
+
+        ``preempt`` (a ``resilience.PreemptionHandler``) is polled at
+        every CHUNK boundary while driving an epoch: a whole-epoch scan
+        can outlast a preemption grace window, so the driver stops
+        dispatching further chunks as soon as a checkpoint is requested
+        and sets ``self.aborted`` for the caller to save-and-exit."""
         from cgnn_tpu.data import invariants
 
         if expand is not None:
@@ -336,6 +391,13 @@ class ScanEpochDriver:
             invariants.maybe_check_any(b)
         self._rng = rng
         self._telemetry = telemetry
+        self._preempt = preempt
+        # True when the LAST driven epoch stopped early at a chunk
+        # boundary on a preemption request (reset per public drive call)
+        self.aborted = False
+        # True when the last run_epoch_pair's EVAL phase was cut short
+        # by preemption (its val means cover only the chunks that ran)
+        self.eval_truncated = False
         # the tap is staged into scan bodies ONLY at step-level telemetry
         self._tap = (
             telemetry.tap_metrics
@@ -563,7 +625,7 @@ class ScanEpochDriver:
             if sched is None:
                 sched = self._build_sched(groups, train, first)
                 self._sched_cache[sched_key] = sched
-        queues, tails, steps = sched
+        queues, tails, _planned_steps = sched
         # run_queues consumes the chunk lists (pop/remove): work on
         # shallow copies so the cached eval schedule survives reuse
         queues = [(k, st, list(ch)) for k, st, ch in queues]
@@ -578,11 +640,19 @@ class ScanEpochDriver:
         # (SCAN_COST.json r4; metrics.fetch_device_sums)
         dev_sums: dict | None = None
         n_chunks = 0
+        executed = 0
 
         def run_queues(qs, weighted):
-            nonlocal state, dev_sums, n_chunks
+            nonlocal state, dev_sums, n_chunks, executed
             rr = 0
             while qs:
+                if self._preempt is not None and self._preempt.requested:
+                    # chunk-boundary preemption: stop dispatching; the
+                    # caller saves the (mid-epoch) state and exits with
+                    # the resumable code. Metric denominators use the
+                    # executed step count, not the planned one.
+                    self.aborted = True
+                    return
                 if weighted and len(qs) > 1:
                     w = np.array([
                         float(sum(len(ch) for ch in entry[2]))
@@ -605,6 +675,7 @@ class ScanEpochDriver:
                 state, chunk_sums = fn(state, stacked, chunk)
                 dev_sums = accumulate_on_device(dev_sums, chunk_sums)
                 n_chunks += 1
+                executed += int(chunk.shape[0])
                 if not chunks:
                     qs.remove(entry)
 
@@ -618,7 +689,7 @@ class ScanEpochDriver:
         # along the in-flight work instead of stalling the next epoch's
         # first scan. (If the run ends here the prebuild is unused — a few
         # rng draws consumed in the same order a further epoch would have.)
-        if train:
+        if train and not self.aborted:
             self._sched_cache[(id(groups), True, False)] = \
                 self._build_sched(groups, True, False)
         t_prebuild = time.perf_counter()
@@ -635,11 +706,12 @@ class ScanEpochDriver:
         tm[f"{phase}_dispatches"] = tm.get(f"{phase}_dispatches", 0.0) \
             + n_chunks
         if self._telemetry is not None:
-            self._telemetry.counter_add("scan_steps", steps)
+            self._telemetry.counter_add("scan_steps", executed)
             self._telemetry.counter_add(f"scan_{phase}_dispatches", n_chunks)
-        return state, dev_sums, steps
+        return state, dev_sums, executed
 
     def train_epoch(self, state: TrainState, first: bool):
+        self.aborted = False
         state, dev_sums, steps = self._drive(
             state, self._train_groups, self._train_scans,
             self._train_body, train=True, first=first,
@@ -647,6 +719,7 @@ class ScanEpochDriver:
         return state, means_from_sums(fetch_device_sums(dev_sums), steps)
 
     def eval_epoch(self, state: TrainState):
+        self.aborted = False
         _, dev_sums, steps = self._drive(
             state, self._val_groups, self._eval_scans,
             self._eval_body, train=False, first=True,
@@ -663,16 +736,30 @@ class ScanEpochDriver:
         halving the per-epoch sync count. -> (state, train_means,
         val_means).
         """
+        self.aborted = False
+        self.eval_truncated = False
         state, tr_sums, tr_steps = self._drive(
             state, self._train_groups, self._train_scans,
             self._train_body, train=True, first=first,
         )
+        train_aborted = self.aborted
         ev_sums, ev_steps = None, 0
-        if self._val_groups:
+        if self._val_groups and not train_aborted:
+            # a preempted train epoch skips eval outright: the grace
+            # window is for the checkpoint, not for scoring a half epoch
             _, ev_sums, ev_steps = self._drive(
                 state, self._val_groups, self._eval_scans,
                 self._eval_body, train=False, first=True,
             )
+            # a preemption that lands during EVAL must not mark the
+            # (fully completed) train epoch aborted — the caller would
+            # checkpoint it under epoch-1 and retrain the whole epoch on
+            # resume. The epoch completes; eval_truncated tells the
+            # caller its val means cover only the eval chunks that ran
+            # (so a lucky partial score must not repoint 'best'), and
+            # the epoch-boundary preempt check exits after the save.
+            self.eval_truncated = self.aborted
+            self.aborted = train_aborted
         combined = {f"t:{k}": v for k, v in (tr_sums or {}).items()}
         combined |= {f"e:{k}": v for k, v in (ev_sums or {}).items()}
         t0 = time.perf_counter()
@@ -716,6 +803,9 @@ def fit(
     compact=None,
     chunk_steps: int | None = None,
     telemetry: Telemetry | None = None,
+    guard: bool = False,
+    monitor=None,
+    preempt=None,
 ) -> tuple[TrainState, dict]:
     """Reference ``main()`` loop: train/validate per epoch, track best.
 
@@ -756,6 +846,16 @@ def fit(
     metrics. None (or level 'off') changes nothing: no wrapper is applied
     to any step body and no callback is staged into any compiled program.
 
+    ``guard`` wraps the train body with the in-graph divergence guard
+    (``resilience.guard.guard_step``): non-finite updates are skipped on
+    device; trajectory bit-identical when nothing fires. ``monitor`` (a
+    ``resilience.DivergenceMonitor``) is consulted once per epoch and may
+    roll the state back to the last good checkpoint with an LR cut.
+    ``preempt`` (a ``resilience.PreemptionHandler``) is polled at epoch
+    boundaries (chunk boundaries inside the epoch scan): when a signal
+    arrived, the loop saves a resumable checkpoint via ``on_epoch_end``,
+    stops, and marks the result ``{"preempted": True}``.
+
     ``scan_epochs`` (implies device_resident) folds the epoch into one
     ``lax.scan`` dispatch per bucket shape (ScanEpochDriver) — measured
     5.5s vs 29s per MP-146k epoch through a high-latency tunnel.
@@ -789,18 +889,22 @@ def fit(
 
     def train_batches(rng):
         if buckets > 1:
-            return bucketed_batch_iterator(
+            it = bucketed_batch_iterator(
                 train_graphs, batch_size, buckets, shuffle=True, rng=rng,
                 stats=pad_stats, dense_m=dense_m, snug=snug,
                 edge_dtype=edge_dtype, pack_fn=pack_fn,
             )
-        return pad_stats.wrap(
-            batch_iterator(
-                train_graphs, batch_size, node_cap, edge_cap,
-                shuffle=True, rng=rng, dense_m=dense_m, snug=snug,
-                edge_dtype=edge_dtype, pack_fn=pack_fn,
+        else:
+            it = pad_stats.wrap(
+                batch_iterator(
+                    train_graphs, batch_size, node_cap, edge_cap,
+                    shuffle=True, rng=rng, dense_m=dense_m, snug=snug,
+                    edge_dtype=edge_dtype, pack_fn=pack_fn,
+                )
             )
-        )
+        # env-gated deterministic fault injection (NaN batches, loader
+        # exceptions); returns `it` unwrapped when no plan is active
+        return faultinject.poison_batches(it)
 
     def val_batches():
         # in_cap=0: eval has no backward, so skip transpose-slot packing
@@ -822,6 +926,12 @@ def fit(
     base_train = train_step_fn or make_train_step(
         classification, grad_health=telemetry.step_level
     )
+    if guard:
+        # in-graph divergence guard INSIDE the jit/scan bodies (and
+        # inside the telemetry tap below, so the stream sees skip flags)
+        from cgnn_tpu.resilience.guard import guard_step
+
+        base_train = guard_step(base_train)
     base_eval = eval_step_fn or make_eval_step(classification)
     train_step = jax.jit(
         telemetry.wrap_train_body(base_train), donate_argnums=0
@@ -877,6 +987,7 @@ def fit(
                     expand=expand,
                     chunk_steps=chunk_steps,
                     telemetry=telemetry,
+                    preempt=preempt,
                 )
             telemetry.sample_hbm("post_staging")
             staging["stack_stage_dispatch_s"] = round(
@@ -917,6 +1028,7 @@ def fit(
         else None
     )
     telemetry.observe_padding(pad_stats)
+    preempted = False
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         if driver is not None:
@@ -924,6 +1036,10 @@ def fit(
                 state, train_m, val_m = driver.run_epoch_pair(
                     state, first=epoch == start_epoch
                 )
+            if driver.aborted:
+                save_preempted_mid_epoch(state, epoch, on_epoch_end, log_fn)
+                preempted = True
+                break
         else:
             if plan is not None:
                 epoch_train, epoch_val = plan.epoch_iterators()
@@ -961,6 +1077,10 @@ def fit(
             log_fn(pad_stats.summary())
         metric = val_m.get(best_key, np.nan)
         is_best = metric > best if classification else metric < best
+        if driver is not None and driver.eval_truncated:
+            # preemption cut eval short: the metric covers a fraction of
+            # the validation set — never let it repoint 'best'
+            is_best = False
         if is_best:
             best = metric
         history.append({"epoch": epoch, "train": train_m, "val": val_m})
@@ -971,9 +1091,15 @@ def fit(
         )
         if on_epoch_metrics is not None:
             on_epoch_metrics(epoch, train_m, val_m)
-        if on_epoch_end is not None:
-            on_epoch_end(state, epoch, val_m, is_best)
+        state, _, preempted = resilience_epoch_end(
+            state, epoch, train_m, val_m, is_best, monitor=monitor,
+            on_epoch_end=on_epoch_end, preempt=preempt, log_fn=log_fn,
+        )
+        if preempted:
+            break
     out = {"best": best, "history": history}
+    if preempted:
+        out["preempted"] = True
     if staging:
         out["staging"] = staging
     return state, out

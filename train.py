@@ -49,7 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optim", choices=["SGD", "Adam", "AdamW"], default="SGD")
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--resume", type=str, default="",
-                   help="checkpoint dir to resume from")
+                   help="checkpoint dir to resume from, or 'auto': resume "
+                        "from --ckpt-dir when a valid checkpoint exists, "
+                        "start fresh otherwise (the requeue-after-"
+                        "preemption mode; see README Fault tolerance)")
     p.add_argument("--train-ratio", type=float, default=0.8)
     p.add_argument("--val-ratio", type=float, default=0.1)
     # model hyperparams (reference names)
@@ -76,6 +79,29 @@ def build_parser() -> argparse.ArgumentParser:
     # runtime
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ckpt-dir", type=str, default="checkpoints")
+    # fault tolerance (cgnn_tpu.resilience; README "Fault tolerance")
+    p.add_argument("--keep-ckpts", type=int, default=3, metavar="K",
+                   help="checkpoint retention: newest K versioned saves "
+                        "plus the best-pointer target (0 keeps all)")
+    p.add_argument("--guard", choices=["off", "skip", "rollback"],
+                   default="skip",
+                   help="divergence guard. 'skip' (default): non-finite "
+                        "updates are skipped ON DEVICE (jnp.where select; "
+                        "trajectory bit-identical when nothing fires). "
+                        "'rollback' additionally restores the last good "
+                        "checkpoint with an LR cut when >= "
+                        "--guard-max-skips steps of one epoch were "
+                        "skipped. 'off' disables both")
+    p.add_argument("--guard-max-skips", type=int, default=3, metavar="K",
+                   help="skipped steps per epoch that count as divergence "
+                        "(--guard rollback)")
+    p.add_argument("--guard-lr-cut", type=float, default=0.5,
+                   help="LR multiplier applied per rollback")
+    p.add_argument("--guard-max-rollbacks", type=int, default=3,
+                   help="rollback budget before the run fails for real")
+    p.add_argument("--no-preempt-handler", action="store_true",
+                   help="do not trap SIGTERM/SIGINT for graceful "
+                        "checkpoint-and-resume (exit code 75)")
     # observability (SURVEY.md §5; cgnn_tpu.observe)
     p.add_argument("--telemetry", choices=["off", "epoch", "step"],
                    default="epoch",
@@ -246,9 +272,20 @@ def main(argv=None) -> int:
     print(f"devices: {devices}")
 
     from cgnn_tpu.observe import Telemetry
+    from cgnn_tpu.resilience import PreemptionHandler, faultinject
 
     log_dir = args.log_dir or os.path.join(args.ckpt_dir, "logs")
     telemetry = Telemetry(args.telemetry, log_dir)
+
+    # SIGTERM/SIGINT -> checkpoint at the next epoch/chunk boundary and
+    # exit resumable (75); a second signal kills immediately
+    preempt = None
+    if not args.no_preempt_handler:
+        preempt = PreemptionHandler.installed(log_fn=print)
+    fault_plan = faultinject.plan()
+    if fault_plan is not None:
+        print(f"FAULT INJECTION ACTIVE: {fault_plan.describe()}",
+              file=sys.stderr)
 
     if (args.device_resident and not args.no_scan_epochs
             and not args.profile):
@@ -463,22 +500,80 @@ def main(argv=None) -> int:
         state = create_train_state(model, example, tx, normalizer,
                                    rng=jax.random.key(args.seed))
 
-    ckpt = CheckpointManager(args.ckpt_dir, telemetry=telemetry)
+    ckpt = CheckpointManager(args.ckpt_dir, telemetry=telemetry,
+                             keep=args.keep_ckpts)
     start_epoch = args.start_epoch
+    resume_meta = None
     if args.resume:
-        resume_mgr = ckpt if os.path.abspath(args.resume) == ckpt.directory \
-            else CheckpointManager(args.resume)
-        state, meta = resume_mgr.restore(state)
-        start_epoch = int(meta.get("epoch", -1)) + 1
-        print(f"resumed from {args.resume} at epoch {start_epoch}")
+        from cgnn_tpu.train.checkpoint import CheckpointRestoreError
+
+        auto = args.resume == "auto"
+        resume_dir = args.ckpt_dir if auto else args.resume
+        resume_mgr = ckpt if os.path.abspath(resume_dir) == ckpt.directory \
+            else CheckpointManager(resume_dir)
+        if auto and not resume_mgr.exists():
+            print(f"--resume auto: no checkpoint under {resume_dir}; "
+                  f"starting fresh")
+        else:
+            try:
+                state, meta = resume_mgr.restore(state)
+            except CheckpointRestoreError as e:
+                print(f"cannot resume from {resume_dir}: {e}",
+                      file=sys.stderr)
+                if auto:
+                    # checkpoints exist but none restored: refusing to
+                    # "start fresh" on top of them — that would retrain
+                    # from epoch 0 over (and eventually rotate out) a
+                    # run's remains; a human should inspect or remove
+                    # the directory
+                    print("--resume auto: checkpoint directory is "
+                          "non-empty but unrestorable; inspect or remove "
+                          f"{resume_dir} to start fresh", file=sys.stderr)
+                return 2
+            if "epoch" not in meta:
+                # refusing to guess: silently computing start_epoch = 0
+                # would retrain over (and eventually rotate out) the
+                # checkpoint the user asked to resume from
+                print(f"checkpoint meta under {resume_dir} lacks 'epoch' "
+                      f"({meta!r}) — cannot determine the resume point; "
+                      f"aborting instead of restarting at epoch 0",
+                      file=sys.stderr)
+                return 2
+            start_epoch = int(meta["epoch"]) + 1
+            resume_meta = meta
+            print(f"resumed from {resume_dir} at epoch {start_epoch}")
 
     meta_base = {"model": model_cfg.to_meta(), "data": data_cfg.to_meta(),
                  "task": args.task}
     sel_key = "force_mae" if force_task else (
         "correct" if classification else "mae")
-    save_cb = lambda s, e, m, b: ckpt.save(  # noqa: E731
-        s, dict(meta_base, epoch=e, best_mae=m.get(sel_key, -1.0)), is_best=b
-    )
+
+    guard_enabled = args.guard != "off"
+    monitor = None
+    if args.guard == "rollback":
+        from cgnn_tpu.resilience import DivergenceMonitor
+
+        monitor = DivergenceMonitor(
+            ckpt, max_skips=args.guard_max_skips, lr_cut=args.guard_lr_cut,
+            max_rollbacks=args.guard_max_rollbacks, log_fn=print,
+        )
+        if resume_meta is not None:
+            # resumed: reapply any persisted LR cut / rollback budget —
+            # otherwise every preemption requeue restarts at the
+            # full-strength LR that caused the divergence with a fresh
+            # retry budget (an unbounded diverge->rollback->preempt loop)
+            state = monitor.resume_from_meta(state, resume_meta)
+    resilience_kw = {
+        "guard": guard_enabled, "monitor": monitor, "preempt": preempt,
+    }
+
+    def save_cb(s, e, m, b):
+        extra = monitor.meta() if monitor is not None else {}
+        ckpt.save(
+            s, dict(meta_base, epoch=e, best_mae=m.get(sel_key, -1.0),
+                    **extra),
+            is_best=b,
+        )
 
     # run manifest: config + device/mesh inventory + git SHA, written once
     telemetry.write_manifest(
@@ -557,7 +652,7 @@ def main(argv=None) -> int:
             scan_epochs=args.scan_epochs, profile_steps=args.profile,
             profile_dir=log_dir, edge_dtype=edge_dtype,
             chunk_steps=args.chunk_steps, telemetry=telemetry,
-            **step_overrides,
+            **resilience_kw, **step_overrides,
         )
         state = fit_state.replace(apply_fn=state.apply_fn)
     else:
@@ -602,8 +697,19 @@ def main(argv=None) -> int:
             dense_m=layout_m, scan_epochs=args.scan_epochs, snug=snug,
             edge_dtype=edge_dtype, chunk_steps=args.chunk_steps,
             telemetry=telemetry,
-            **step_overrides,
+            **resilience_kw, **step_overrides,
         )
+
+    if result.get("preempted"):
+        # the loop already saved a resumable checkpoint at the boundary;
+        # surface any failed save LOUDLY (a silent one would strand the
+        # requeue), flush telemetry, and exit with the resumable code
+        from cgnn_tpu.resilience.preempt import resumable_exit
+
+        ckpt.close()
+        telemetry.sample_hbm("preempted")
+        telemetry.close()
+        return resumable_exit(print)
 
     with telemetry.span("test_eval"):
         test_m = evaluate(state, test_g, args.batch_size, node_cap, edge_cap,
