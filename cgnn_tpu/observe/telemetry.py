@@ -55,6 +55,7 @@ class Telemetry:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
+        self._series: dict = {}
         self._pending_events: list[tuple[str, dict]] = []
         self._padding_stats = None
         self._warmups = 0
@@ -119,6 +120,40 @@ class Telemetry:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def observe_value(self, name: str, value: float,
+                      keep: int = 8192) -> None:
+        """Append one sample to a bounded value series (latencies, batch
+        occupancies). At close the series flushes as p50/p95/p99 + mean +
+        count gauges in the run summary — the serving SLO numbers. The
+        deque bound keeps a long-running server's memory flat; quantiles
+        then cover the most recent ``keep`` samples, which is the window
+        an SLO report wants anyway."""
+        if not self.enabled:
+            return
+        import collections
+
+        with self._lock:
+            series = self._series.get(name)
+            if series is None or series.maxlen != keep:
+                series = collections.deque(series or (), maxlen=keep)
+                self._series[name] = series
+            series.append(float(value))
+
+    def series_quantiles(self, name: str) -> dict:
+        """{p50, p95, p99, mean, count} for one series ({} if empty)."""
+        import numpy as np
+
+        with self._lock:
+            vals = list(self._series.get(name, ()))
+        if not vals:
+            return {}
+        arr = np.asarray(vals, np.float64)
+        p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+        return {
+            "p50": float(p50), "p95": float(p95), "p99": float(p99),
+            "mean": float(arr.mean()), "count": len(vals),
+        }
+
     def observe_padding(self, stats) -> None:
         """Remember the run's PaddingStats; per-bucket gauges are derived
         at close (the stats object keeps accumulating until then)."""
@@ -180,6 +215,11 @@ class Telemetry:
             pending, self._pending_events = self._pending_events, []
             counters = dict(self._counters)
             gauges = dict(self._gauges)
+            series_names = list(self._series)
+        for name in series_names:
+            q = self.series_quantiles(name)
+            for stat, v in q.items():
+                gauges[f"{name}_{stat}"] = v
         for name, rec in pending:
             self.logger.event(name, rec)
         if self._padding_stats is not None:

@@ -570,12 +570,18 @@ def fit_data_parallel(
 
     telemetry.observe_padding(pad_stats)
     if telemetry.step_level and driver is None:
-        log_fn(
-            "telemetry step: the data-parallel per-step loop does not "
-            "stream per-step records (metrics live inside the shard_map "
-            "body); epoch aggregates and gauges are still recorded — use "
-            "--scan-epochs for in-scan streaming under DP"
-        )
+        # the PR-1 known gap, closed (ISSUE 3): the DP per-step loop now
+        # streams step records like the scan path. The tap cannot live
+        # INSIDE the shard_map body (per-shard callbacks would emit one
+        # partial record per device), but by the time metrics exit the
+        # shard_map they are replicated psum totals — so wrap the whole
+        # sharded step in an outer jit that stages ONE async callback per
+        # step carrying the global sums. The scan driver is excluded on
+        # purpose: it stages its own in-scan tap (wrapping here too would
+        # double-record every step).
+        train_step = jax.jit(telemetry.wrap_train_body(train_step),
+                             donate_argnums=0)
+        eval_step = jax.jit(telemetry.wrap_eval_body(eval_step))
     if monitor is not None and monitor.post_restore is None:
         # a rollback restores onto the default device; re-place it
         # replicated over the mesh before the next sharded step

@@ -34,11 +34,17 @@ _DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 class PreemptionHandler:
     """Latches termination signals into a pollable checkpoint request."""
 
-    def __init__(self, log_fn: Callable = print):
+    # what a first signal triggers — the log line's action clause;
+    # trainers keep the default, the serving drain overrides it
+    DEFAULT_ACTION = "checkpoint requested at the next epoch/chunk boundary"
+
+    def __init__(self, log_fn: Callable = print, action: str | None = None):
         self._event = threading.Event()
         self._log = log_fn
+        self._action = action or self.DEFAULT_ACTION
         self._installed: dict[int, object] = {}
         self._signal_no: int | None = None
+        self._callbacks: list[Callable] = []
         self.requested_at: float | None = None
 
     # ---- the flag the training loops poll ----
@@ -47,6 +53,15 @@ class PreemptionHandler:
     def requested(self) -> bool:
         return self._event.is_set()
 
+    def add_callback(self, fn: Callable) -> None:
+        """Run ``fn()`` once when a request latches — for consumers with
+        no natural poll point (the serving drain kicks its batcher shut
+        the moment SIGTERM lands instead of waiting out a poll interval).
+        Callbacks fire from the latching thread (usually the signal
+        handler on the main thread), so they must be quick and non-raising
+        — set a flag, close a queue; never block on the work itself."""
+        self._callbacks.append(fn)
+
     def request(self, signum: int | None = None) -> None:
         """Latch a checkpoint-and-exit request (signal handlers and the
         fault injector call this; tests may call it directly)."""
@@ -54,6 +69,11 @@ class PreemptionHandler:
             self.requested_at = time.monotonic()
             self._signal_no = signum
             self._event.set()
+            for fn in self._callbacks:
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001 — never mask the latch
+                    self._log(f"preemption callback failed: {e!r}")
 
     # ---- signal plumbing ----
 
@@ -69,8 +89,8 @@ class PreemptionHandler:
             signal.raise_signal(signum)
             return
         self._log(
-            f"{signal.Signals(signum).name} received: checkpoint requested "
-            f"at the next epoch/chunk boundary (send again to exit now)"
+            f"{signal.Signals(signum).name} received: {self._action} "
+            f"(send again to exit now)"
         )
         self.request(signum)
 

@@ -1,0 +1,51 @@
+"""Online serving subsystem (ISSUE 3): the production front door.
+
+``predict.py`` covers offline batch jobs; this package serves live
+traffic — single-structure requests, coalesced by a deadline-driven
+micro-batcher into a FIXED precompiled shape ladder (zero recompiles
+after warmup), with bounded-queue backpressure, per-request deadlines,
+hot checkpoint reload (zero-drop param swaps between batches), an LRU
+result cache, and graceful SIGTERM drain. The core is socket-free
+(server.InferenceServer); http.py is the thin stdlib front-end and
+../serve.py the entrypoint.
+"""
+
+from cgnn_tpu.serve.batcher import (
+    MALFORMED,
+    OVERSIZE,
+    QUEUE_FULL,
+    SHUTDOWN,
+    TIMEOUT,
+    Flush,
+    MicroBatcher,
+    Request,
+    RequestFuture,
+    ServeRejection,
+)
+from cgnn_tpu.serve.cache import ResultCache, structure_fingerprint
+from cgnn_tpu.serve.reload import CheckpointWatcher, ParamStore
+from cgnn_tpu.serve.server import InferenceServer, ServeResult, load_server
+from cgnn_tpu.serve.shapes import BatchShape, ShapeSet, plan_shape_set
+
+__all__ = [
+    "BatchShape",
+    "CheckpointWatcher",
+    "Flush",
+    "InferenceServer",
+    "MALFORMED",
+    "MicroBatcher",
+    "OVERSIZE",
+    "ParamStore",
+    "QUEUE_FULL",
+    "Request",
+    "RequestFuture",
+    "ResultCache",
+    "SHUTDOWN",
+    "ServeRejection",
+    "ServeResult",
+    "ShapeSet",
+    "TIMEOUT",
+    "load_server",
+    "plan_shape_set",
+    "structure_fingerprint",
+]

@@ -1,0 +1,255 @@
+"""Dynamic micro-batcher: coalesce single-structure requests into
+fixed-shape batches under a latency deadline.
+
+The queueing policy in one sentence: FIFO requests accumulate until the
+head batch would overflow the LARGEST precompiled shape ("shape-full")
+or the OLDEST queued request has waited ``max_wait_ms`` ("deadline"),
+whichever comes first — so under load batches run full (throughput) and
+under trickle traffic no request waits more than one flush interval
+(latency), and in neither case does packing ever leave the warm shape
+set (shapes.py), so no request ever waits on a recompile.
+
+Admission control happens at ``offer``:
+
+- bounded queue (``max_queue``): a full queue REJECTS instead of
+  buffering unboundedly — the client sees backpressure (HTTP 429) while
+  the server keeps serving its current load at its current latency;
+- oversize structures (don't fit the largest shape even alone) are
+  rejected with the observed sizes — queueing one would wedge the head
+  of the FIFO forever;
+- a closed (draining) batcher rejects new work but keeps flushing what
+  it already accepted — the SIGTERM drain path.
+
+Per-request deadlines are enforced at flush time: a request whose
+deadline passed while queued is returned in ``Flush.expired`` (never
+packed) so the caller can fail it promptly — serving a reply the client
+already gave up on wastes a batch slot.
+
+Everything here is pure host-side data-structure logic with an
+injectable clock: the decision core (``poll``) is synchronously testable
+with a fake clock; ``next_flush`` adds the blocking condition-variable
+loop the server's worker thread runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+from cgnn_tpu.data.graph import CrystalGraph
+from cgnn_tpu.serve.shapes import BatchShape, ShapeSet
+
+# rejection reasons (stable strings: telemetry counter suffixes and HTTP
+# error payloads key on them)
+QUEUE_FULL = "queue_full"
+OVERSIZE = "oversize"
+TIMEOUT = "timeout"
+SHUTDOWN = "shutdown"
+MALFORMED = "malformed"
+
+
+class ServeRejection(RuntimeError):
+    """A request the server declines to process; ``reason`` is one of the
+    module-level rejection constants."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(detail or reason)
+
+
+class RequestFuture:
+    """One request's pending result (threading.Event + slot)."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result = None
+        self._error: BaseException | None = None
+
+    def set_result(self, result) -> None:
+        self._result = result
+        self._done.set()
+
+    def set_error(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclasses.dataclass
+class Request:
+    """A queued single-structure prediction request."""
+
+    graph: CrystalGraph
+    enqueued: float  # monotonic seconds
+    deadline: float | None  # absolute monotonic; None = no deadline
+    future: RequestFuture = dataclasses.field(default_factory=RequestFuture)
+    fingerprint: str | None = None
+    # slot budget under the shape set's layout, computed once at admission
+    nodes: int = 0
+    edges: int = 0
+
+
+@dataclasses.dataclass
+class Flush:
+    """One batcher decision: requests to pack (into ``shape``) plus any
+    requests whose deadline expired while queued."""
+
+    requests: list
+    shape: BatchShape | None
+    expired: list
+    reason: str = ""  # 'shape_full' | 'deadline' | 'drain' | ''
+
+    def __bool__(self) -> bool:
+        return bool(self.requests or self.expired)
+
+
+class MicroBatcher:
+    """Bounded FIFO + the flush policy described in the module docstring."""
+
+    def __init__(
+        self,
+        shape_set: ShapeSet,
+        *,
+        max_queue: int = 256,
+        max_wait_ms: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.shape_set = shape_set
+        self.max_queue = max_queue
+        self.max_wait = max_wait_ms / 1000.0
+        self._clock = clock
+        self._queue: list[Request] = []
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # ---- admission ----
+
+    def offer(self, request: Request) -> None:
+        """Admit or reject (raises ServeRejection; never blocks)."""
+        n, e = self.shape_set.graph_counts(request.graph)
+        request.nodes, request.edges = n, e
+        if not self.shape_set.largest.fits(1, n, e):
+            raise ServeRejection(
+                OVERSIZE, self.shape_set.oversize_detail(request.graph)
+            )
+        with self._cond:
+            if self._closed:
+                raise ServeRejection(SHUTDOWN, "server is draining")
+            if len(self._queue) >= self.max_queue:
+                raise ServeRejection(
+                    QUEUE_FULL,
+                    f"request queue at capacity ({self.max_queue})",
+                )
+            self._queue.append(request)
+            self._cond.notify_all()
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # ---- flush policy ----
+
+    def _take(self, now: float) -> tuple[list, list, bool]:
+        """(batchable FIFO prefix, expired, hit-shape-full). Lock held."""
+        big = self.shape_set.largest
+        take: list[Request] = []
+        expired: list[Request] = []
+        n_nodes = n_edges = 0
+        full = False
+        for req in self._queue:
+            if req.deadline is not None and now >= req.deadline:
+                expired.append(req)
+                continue
+            if not big.fits(len(take) + 1, n_nodes + req.nodes,
+                            n_edges + req.edges):
+                full = True
+                break
+            take.append(req)
+            n_nodes += req.nodes
+            n_edges += req.edges
+        # graph slots saturated = full even with nothing else queued (a
+        # later arrival could never join this batch anyway)
+        return take, expired, full or len(take) >= big.graph_cap
+
+    def poll(self, now: float | None = None) -> Flush | None:
+        """Non-blocking flush decision at time ``now``.
+
+        Returns a Flush when the policy says fire (shape-full, oldest
+        waited past ``max_wait``, draining, or deadline expiries need
+        delivering), else None. Pure given the clock — the unit-testable
+        core of the batcher."""
+        now = self._clock() if now is None else now
+        with self._cond:
+            take, expired, full = self._take(now)
+            waited = (
+                take and now - min(r.enqueued for r in take) >= self.max_wait
+            )
+            if full or waited or (self._closed and take):
+                reason = ("shape_full" if full
+                          else "deadline" if waited else "drain")
+                fired = take
+            elif expired:
+                # nothing to pack yet, but expiries must not sit until
+                # the next natural flush — deliver them now
+                reason, fired = "", []
+            else:
+                return None
+            drop = set(map(id, fired)) | set(map(id, expired))
+            self._queue = [r for r in self._queue if id(r) not in drop]
+            shape = None
+            if fired:
+                shape = self.shape_set.shape_for(
+                    len(fired),
+                    sum(r.nodes for r in fired),
+                    sum(r.edges for r in fired),
+                )
+            return Flush(fired, shape, expired, reason)
+
+    def next_flush(self) -> Flush | None:
+        """Block until the policy fires (worker-thread API).
+
+        Returns None exactly once the batcher is closed AND empty — the
+        worker's signal to exit after the drain is complete."""
+        while True:
+            with self._cond:
+                if self._closed and not self._queue:
+                    return None
+                if not self._queue:
+                    self._cond.wait(timeout=self.max_wait)
+                    continue
+                oldest = min(r.enqueued for r in self._queue)
+                remaining = self.max_wait - (self._clock() - oldest)
+            if remaining > 0 and not self._closed:
+                # sleep until the deadline can fire (a new arrival that
+                # makes the batch shape-full wakes us early)
+                with self._cond:
+                    self._cond.wait(timeout=remaining)
+            flush = self.poll()
+            if flush is not None:
+                return flush
+
+    # ---- drain ----
+
+    def close(self) -> None:
+        """Stop admitting; queued work still flushes (graceful drain)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

@@ -1,0 +1,88 @@
+"""LRU result cache keyed by a structure fingerprint.
+
+Production graph-property traffic is heavily repeated (the same
+trending structures queried by many users), and the forward pass is
+deterministic given (params, structure) — so identical queries within
+one param version can be answered from memory. The fingerprint hashes
+the FEATURIZED arrays (atom features, edge features, connectivity), not
+object identity, so equal structures hit regardless of which client
+sent them.
+
+Staleness across hot param swaps is handled in TWO layers, both load-
+bearing (server.py): entries are stored version-tagged, ``(row,
+param_version)``, and REVALIDATED against the live version at hit time
+— this is the correctness guarantee, because a micro-batch in flight
+across a swap writes its old-version rows AFTER the swap fires; the
+swap's ``cache.clear()`` (reload.py on_swap) is only bulk eviction so
+dead entries stop occupying LRU slots. Do not remove the hit-time
+version check in favor of the clear — that reintroduces the in-flight-
+writer race (pinned by tests/test_serve.py hot-reload atomicity).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+
+import numpy as np
+
+from cgnn_tpu.data.graph import CrystalGraph
+
+
+def structure_fingerprint(graph: CrystalGraph) -> str:
+    """Content hash of a featurized structure (layout-qualified)."""
+    h = hashlib.sha1()
+    for arr in (graph.atom_fea, graph.edge_fea, graph.centers,
+                graph.neighbors):
+        a = np.ascontiguousarray(arr)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class ResultCache:
+    """Thread-safe bounded LRU: fingerprint -> prediction row."""
+
+    def __init__(self, capacity: int = 1024):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._data: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str):
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+                self.hits += 1
+                return self._data[key]
+            self.misses += 1
+            return None
+
+    def put(self, key: str, value) -> None:
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "size": len(self._data),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+            }

@@ -1,0 +1,169 @@
+"""Thin stdlib HTTP front-end over the in-process InferenceServer.
+
+Deliberately minimal: ``http.server.ThreadingHTTPServer`` + JSON, no
+framework dependency (the container bakes in the jax stack, nothing
+else). All serving logic — batching, deadlines, backpressure, reload —
+lives in server.py; this module only translates wire <-> core:
+
+- ``POST /predict``  body ``{"graph": {...}}`` (featurized arrays:
+  atom_fea [N,D], edge_fea [E,G], centers [E], neighbors [E]) or
+  ``{"structure": {...}}`` (lattice [3,3], frac_coords [N,3], numbers
+  [N]) featurized server-side with the checkpoint's config. Response:
+  ``{"prediction": [T], "param_version", "latency_ms", "cached"}``.
+- ``GET /healthz``   liveness + current param version.
+- ``GET /stats``     the server's full stats() dict (SLO numbers).
+
+Rejections map to the HTTP codes clients expect from a loaded service:
+429 queue-full (back off), 413 oversize (never retry), 504 deadline
+exceeded, 503 draining (connection: retry elsewhere).
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+import numpy as np
+
+from cgnn_tpu.data.graph import CrystalGraph
+from cgnn_tpu.serve.batcher import (
+    MALFORMED,
+    OVERSIZE,
+    QUEUE_FULL,
+    SHUTDOWN,
+    TIMEOUT,
+    ServeRejection,
+)
+from cgnn_tpu.serve.server import InferenceServer
+
+_REJECT_STATUS = {
+    MALFORMED: 400,
+    QUEUE_FULL: 429,
+    OVERSIZE: 413,
+    TIMEOUT: 504,
+    SHUTDOWN: 503,
+}
+
+
+def graph_from_json(payload: dict) -> CrystalGraph:
+    """Rebuild a featurized CrystalGraph from its JSON arrays."""
+    try:
+        return CrystalGraph(
+            atom_fea=np.asarray(payload["atom_fea"], np.float32),
+            edge_fea=np.asarray(payload["edge_fea"], np.float32),
+            centers=np.asarray(payload["centers"], np.int32),
+            neighbors=np.asarray(payload["neighbors"], np.int32),
+            target=np.zeros(1, np.float32),
+            cif_id=str(payload.get("id", "")),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed graph payload: {e}") from None
+
+
+def make_structure_featurizer(data_cfg) -> Callable[[dict], CrystalGraph]:
+    """JSON structure dict -> CrystalGraph via the checkpoint's
+    featurization config (so online requests are featurized exactly like
+    the training data was)."""
+    from cgnn_tpu.data.dataset import featurize_structure
+    from cgnn_tpu.data.structure import Structure
+
+    cfg = data_cfg.featurize_config()
+    gdf = cfg.gdf()
+
+    def featurize(payload: dict) -> CrystalGraph:
+        try:
+            s = Structure(
+                np.asarray(payload["lattice"], np.float64),
+                np.asarray(payload["frac_coords"], np.float64),
+                np.asarray(payload["numbers"], np.int32),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed structure payload: {e}") from None
+        return featurize_structure(
+            s, np.zeros(1, np.float32), cfg, str(payload.get("id", "")), gdf
+        )
+
+    return featurize
+
+
+def make_handler(server: InferenceServer,
+                 featurize: Callable | None = None):
+    """Build the request-handler class bound to ``server``."""
+
+    class ServeHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # quiet: per-request stderr lines are not operator signal under
+        # load; telemetry carries the aggregates
+        def log_message(self, fmt, *args):  # noqa: ARG002
+            pass
+
+        def _reply(self, status: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "ok": True,
+                    "param_version": server.param_store.version,
+                    "draining": server.stats()["draining"],
+                })
+            elif self.path == "/stats":
+                self._reply(200, server.stats())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/predict":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if "graph" in payload:
+                    graph = graph_from_json(payload["graph"])
+                elif "structure" in payload and featurize is not None:
+                    graph = featurize(payload["structure"])
+                else:
+                    raise ValueError(
+                        "payload needs 'graph' (featurized arrays)"
+                        + (" or 'structure'" if featurize else "")
+                    )
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            timeout_ms = payload.get("timeout_ms")
+            try:
+                result = server.predict(graph, timeout_ms=timeout_ms)
+            except ServeRejection as e:
+                self._reply(_REJECT_STATUS.get(e.reason, 500), {
+                    "error": str(e), "reason": e.reason,
+                })
+                return
+            except TimeoutError:
+                self._reply(504, {"error": "result wait timed out",
+                                  "reason": TIMEOUT})
+                return
+            self._reply(200, {
+                "prediction": result.prediction.tolist(),
+                "param_version": result.param_version,
+                "latency_ms": result.latency_ms,
+                "cached": result.cached,
+                "batch_occupancy": result.batch_occupancy,
+            })
+
+    return ServeHandler
+
+
+def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
+                     port: int = 8437,
+                     featurize: Callable | None = None) -> ThreadingHTTPServer:
+    """Bind the front-end (call ``.serve_forever()`` on the result;
+    ``.shutdown()`` from another thread stops it — the drain path)."""
+    return ThreadingHTTPServer((host, port), make_handler(server, featurize))

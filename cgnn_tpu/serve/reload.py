@@ -1,0 +1,149 @@
+"""Hot checkpoint reload: pick up newly trained params without a restart.
+
+A trainer keeps committing versioned saves (``ckpt-%08d/`` —
+train/checkpoint.py) into a directory the server watches. The watcher
+polls for a newer COMMITTED save (manifest present = committed, the
+PR-2 protocol), restores it through the integrity-verifying chain
+(``restore_for_inference`` on the explicit save name — crc-checked
+against the manifest, never a blind load), and atomically swaps the
+:class:`ParamStore` reference between batches.
+
+Atomicity is by publication, not locking-the-world: the serving worker
+reads ``(state, version)`` ONCE per micro-batch, so a swap landing
+mid-batch changes nothing for that batch — in-flight requests finish on
+the params they started with, zero drops, and every response records the
+param version that computed it (the loadgen's hot-swap assertion keys on
+exactly this).
+
+A save that fails verification is skipped with a logged report and
+remembered, so a corrupt upload neither takes the server down nor gets
+retried in a hot loop; the next good save supersedes it.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Callable
+
+from cgnn_tpu.train.checkpoint import CheckpointManager
+
+
+class ParamStore:
+    """Atomic (state, version) holder the serving worker reads per batch."""
+
+    def __init__(self, state, version: str = "init"):
+        self._lock = threading.Lock()
+        self._state = state
+        self._version = version
+
+    def get(self):
+        """-> (state, version), a consistent pair."""
+        with self._lock:
+            return self._state, self._version
+
+    @property
+    def version(self) -> str:
+        with self._lock:
+            return self._version
+
+    def swap(self, state, version: str) -> None:
+        with self._lock:
+            self._state = state
+            self._version = version
+
+
+class CheckpointWatcher:
+    """Polls a checkpoint directory and hot-swaps verified params.
+
+    ``poll_once`` is the synchronous, testable unit; ``start`` runs it on
+    a daemon thread every ``poll_interval_s``. ``template_state`` is any
+    state with the right pytree structure (the serving state itself) —
+    restores build a fresh state from it, never mutate it.
+    """
+
+    def __init__(
+        self,
+        manager: CheckpointManager,
+        store: ParamStore,
+        template_state,
+        *,
+        poll_interval_s: float = 2.0,
+        telemetry=None,
+        on_swap: Callable | None = None,
+        log_fn: Callable | None = None,
+    ):
+        self._mgr = manager
+        self._store = store
+        self._template = template_state
+        self.poll_interval = poll_interval_s
+        self._telemetry = telemetry
+        self._on_swap = on_swap
+        self._log = log_fn or (lambda m: print(m, file=sys.stderr))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # verified-bad saves: never retried (a corrupt file stays corrupt)
+        self._skipped: set[str] = set()
+        self.swaps = 0
+        self.skips = 0
+
+    # ---- the synchronous unit ----
+
+    def poll_once(self) -> bool:
+        """Check for a newer committed save; swap if it verifies.
+
+        Returns True iff a swap happened. Never raises on a bad
+        checkpoint — it logs the skip report, counts it, and keeps
+        serving the current params (a corrupt upload must not take the
+        serving path down)."""
+        newest = self._mgr.newest_committed()
+        if newest is None or newest == self._store.version:
+            return False
+        if newest in self._skipped:
+            return False
+        try:
+            state = self._mgr.restore_for_inference(self._template, newest)
+        except Exception as e:  # noqa: BLE001 — skip, keep serving
+            self.skips += 1
+            self._skipped.add(newest)
+            report = "; ".join(self._mgr.last_restore_report) or repr(e)
+            self._log(
+                f"hot reload: SKIPPING {newest} (integrity/restore "
+                f"failure: {report}); still serving "
+                f"{self._store.version}"
+            )
+            if self._telemetry is not None:
+                self._telemetry.counter_add("serve_reload_skipped", 1)
+            return False
+        old = self._store.version
+        self._store.swap(state, newest)
+        self.swaps += 1
+        self._log(f"hot reload: swapped params {old} -> {newest}")
+        if self._telemetry is not None:
+            self._telemetry.counter_add("serve_reloads", 1)
+        if self._on_swap is not None:
+            self._on_swap(newest)
+        return True
+
+    # ---- the background thread ----
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="cgnn-serve-reload"
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — watcher must survive
+                self._log(f"hot reload: poll error (will retry): {e!r}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

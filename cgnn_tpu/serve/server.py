@@ -1,0 +1,542 @@
+"""The in-process online inference server (the serve/ core).
+
+``InferenceServer`` is deliberately socket-free: submit() -> future ->
+result, driven by one worker thread — the whole request path (admission,
+micro-batching, packing, dispatch, hot reload, caching, draining) is
+exercisable from a unit test or an in-process load generator with no
+ports involved. The stdlib HTTP front-end (serve/http.py) is a thin
+translation layer on top.
+
+Request lifecycle::
+
+    submit(graph)
+      -> cache hit?  resolve immediately (no queue)
+      -> batcher.offer (admission: oversize / queue-full / draining)
+    worker: batcher.next_flush()
+      -> expired requests fail with TIMEOUT
+      -> pack into the flush's precompiled shape (shapes.py)
+      -> (state, version) = param_store.get()   # hot-swap boundary
+      -> predict_step(state, batch) -> device_get
+      -> resolve each future with (row, version, latency)
+
+Hot reload safety rides on the ``param_store.get()`` placement: the pair
+is read once per batch, so a watcher swap lands cleanly between batches
+and in-flight work finishes on the params it started with. Every
+response carries ``param_version`` so clients (and the loadgen's
+hot-swap assertion) can see exactly which weights answered.
+
+``warm()`` compiles every shape in the set before the server accepts
+traffic — with the persistent XLA compile cache configured, a restart
+replays compilations from disk. After warmup the compile count is
+PINNED: the batcher only emits shapes from the warm set, so
+``predict_step`` never traces again (asserted by tests via the jit
+cache-miss counter, and re-checked per flush when telemetry is on).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from cgnn_tpu.data.graph import CrystalGraph
+from cgnn_tpu.serve.batcher import (
+    MALFORMED,
+    TIMEOUT,
+    Flush,
+    MicroBatcher,
+    Request,
+    RequestFuture,
+    ServeRejection,
+)
+from cgnn_tpu.serve.cache import ResultCache, structure_fingerprint
+from cgnn_tpu.serve.reload import CheckpointWatcher, ParamStore
+from cgnn_tpu.serve.shapes import ShapeSet, plan_shape_set
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One answered request."""
+
+    prediction: np.ndarray  # [T] denormalized
+    param_version: str
+    latency_ms: float
+    cached: bool = False
+    batch_occupancy: float = 0.0  # real graphs / graph slots of its batch
+
+
+class InferenceServer:
+    """Micro-batching online inference over a warm shape set.
+
+    ``state`` is a restored-for-inference TrainState; ``shape_set`` the
+    precompiled ladder (shapes.plan_shape_set). ``predict_step`` defaults
+    to ``jax.jit(make_predict_step())`` — inject a pre-jitted one to share
+    its compile cache with an offline predict path.
+    """
+
+    def __init__(
+        self,
+        state,
+        shape_set: ShapeSet,
+        *,
+        predict_step: Callable | None = None,
+        version: str = "init",
+        telemetry=None,
+        max_queue: int = 256,
+        max_wait_ms: float = 5.0,
+        default_timeout_ms: float | None = 1000.0,
+        cache_size: int = 1024,
+        clock: Callable[[], float] = time.monotonic,
+        log_fn: Callable = print,
+    ):
+        import jax
+
+        from cgnn_tpu.observe import Telemetry
+        from cgnn_tpu.train.step import make_predict_step
+
+        self.shape_set = shape_set
+        self.param_store = ParamStore(state, version)
+        self.predict_step = predict_step or jax.jit(make_predict_step())
+        self.telemetry = telemetry or Telemetry.disabled()
+        self.batcher = MicroBatcher(
+            shape_set, max_queue=max_queue, max_wait_ms=max_wait_ms,
+            clock=clock,
+        )
+        self.default_timeout = (
+            None if default_timeout_ms is None else default_timeout_ms / 1000.0
+        )
+        self.cache = ResultCache(cache_size) if cache_size else None
+        self._clock = clock
+        self._log = log_fn
+        self._worker: threading.Thread | None = None
+        self._watcher: CheckpointWatcher | None = None
+        self._draining = False
+        self._lock = threading.Lock()
+        # serving counters (mirrored into telemetry; kept locally so
+        # stats() works with telemetry off)
+        self.counts: dict[str, int] = {
+            "requests": 0, "responses": 0, "cache_hits": 0,
+            "reject_queue_full": 0, "reject_oversize": 0,
+            "reject_timeout": 0, "reject_shutdown": 0,
+            "reject_malformed": 0, "batches": 0,
+        }
+        self._latencies: list[float] = []  # recent, bounded (stats())
+        self._occupancies: list[float] = []
+        self.warmed = False
+        self._compiles_after_warm = 0
+        # expected per-structure feature layout, learned from the warm
+        # template: the admission gate that keeps a malformed request
+        # from poisoning a whole co-batched flush (pack would raise) or
+        # forcing a fresh trace (a recompile after warmup)
+        self._feature_dims: tuple[int, int] | None = None
+
+    # ---- warmup ----
+
+    def warm(self, template: CrystalGraph) -> int:
+        """Compile every shape in the set; returns the compile count.
+
+        ``template`` is any admissible structure (it provides feature
+        dimensionality); each rung is packed with one copy and executed
+        once. Dispatches run under ``telemetry.warmup()`` so compile
+        executions never pollute serving counters."""
+        state, _ = self.param_store.get()
+        self._feature_dims = (template.atom_fea.shape[1],
+                              template.edge_fea.shape[1])
+        n0 = self._jit_cache_size()
+        with self.telemetry.warmup():
+            for shape in self.shape_set:
+                batch = self.shape_set.pack([template], shape=shape)
+                np.asarray(self.predict_step(state, batch))
+        self.warmed = True
+        compiled = (self._jit_cache_size() or 0) - (n0 or 0)
+        self._log(
+            f"serve: warmed {len(self.shape_set)} shapes "
+            f"({compiled} fresh compiles)"
+        )
+        return compiled
+
+    def _jit_cache_size(self) -> int | None:
+        """The jit cache-miss counter (None when the fn isn't a jax.jit)."""
+        try:
+            return int(self.predict_step._cache_size())
+        except AttributeError:
+            return None
+
+    # ---- lifecycle ----
+
+    def start(self) -> "InferenceServer":
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._serve_loop, daemon=True, name="cgnn-serve"
+            )
+            self._worker.start()
+        if self._watcher is not None:
+            self._watcher.start()
+        return self
+
+    def attach_watcher(self, manager, poll_interval_s: float = 2.0,
+                       log_fn: Callable | None = None) -> CheckpointWatcher:
+        """Wire hot checkpoint reload (reload.py) to ``manager``'s dir.
+
+        The cache clears on every swap — cached rows are only valid for
+        the version that computed them."""
+        template, _ = self.param_store.get()
+        self._watcher = CheckpointWatcher(
+            manager, self.param_store, template,
+            poll_interval_s=poll_interval_s, telemetry=self.telemetry,
+            on_swap=lambda _v: self.cache.clear() if self.cache else None,
+            log_fn=log_fn or self._log,
+        )
+        if self._worker is not None and self._worker.is_alive():
+            self._watcher.start()
+        return self._watcher
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT -> graceful drain (resilience.preempt plumbing).
+
+        Returns the PreemptionHandler; the caller's main thread decides
+        what to do after the drain (serve.py shuts the HTTP listener and
+        exits 0)."""
+        from cgnn_tpu.resilience.preempt import PreemptionHandler
+
+        handler = PreemptionHandler(
+            log_fn=self._log,
+            action="draining the serving queue (in-flight requests will "
+                   "be answered; new ones rejected 503)",
+        )
+        handler.add_callback(self.begin_drain)
+        return handler.install()
+
+    def begin_drain(self) -> None:
+        """Stop admitting; already-queued requests still get answers.
+        Quick and thread-safe (called from signal handlers)."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self.batcher.close()
+        self._log("serve: draining (no new requests; flushing queue)")
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """begin_drain + wait for the worker to finish the queue.
+        True when the drain completed within the timeout."""
+        self.begin_drain()
+        if self._watcher is not None:
+            self._watcher.stop()
+        if self._worker is not None:
+            self._worker.join(timeout=timeout_s)
+            done = not self._worker.is_alive()
+        else:
+            # never started: flush synchronously so accepted work still
+            # gets answers
+            self._serve_loop()
+            done = True
+        self.telemetry.set_gauge("serve_drained_clean", float(done))
+        return done
+
+    # ---- request path ----
+
+    def _check_wellformed(self, graph: CrystalGraph) -> None:
+        """Admission-time structural validation: a malformed graph must
+        fail ALONE (400) — packed, it would either blow up pack_graphs
+        (failing every innocent co-batched request) or, flushed alone,
+        trace a fresh program shape (a recompile after warmup)."""
+        problems = []
+        if self._feature_dims is not None:
+            nd, ed = self._feature_dims
+            if np.ndim(graph.atom_fea) != 2 or graph.atom_fea.shape[1] != nd:
+                problems.append(
+                    f"atom_fea must be [N, {nd}], got "
+                    f"{np.shape(graph.atom_fea)}"
+                )
+            if np.ndim(graph.edge_fea) != 2 or graph.edge_fea.shape[1] != ed:
+                problems.append(
+                    f"edge_fea must be [E, {ed}], got "
+                    f"{np.shape(graph.edge_fea)}"
+                )
+        n, e = graph.num_nodes, graph.num_edges
+        if n < 1:
+            problems.append("structure has no atoms")
+        if len(graph.edge_fea) != e:
+            problems.append(
+                f"{e} edges but {len(graph.edge_fea)} edge feature rows"
+            )
+        for name in ("centers", "neighbors"):
+            idx = np.asarray(getattr(graph, name))
+            if len(idx) and (idx.min() < 0 or idx.max() >= n):
+                problems.append(
+                    f"{name} indices outside [0, {n}) "
+                    f"(min {idx.min()}, max {idx.max()})"
+                )
+        if problems:
+            raise ServeRejection(MALFORMED, "; ".join(problems))
+
+    def submit(self, graph: CrystalGraph,
+               timeout_ms: float | None = None) -> RequestFuture:
+        """Admit one structure; returns its future (raises ServeRejection
+        on malformed / queue-full / oversize / draining)."""
+        now = self._clock()
+        self._count("requests")
+        try:
+            self._check_wellformed(graph)
+        except ServeRejection as e:
+            self._count(f"reject_{e.reason}")
+            raise
+        fp = structure_fingerprint(graph) if self.cache is not None else None
+        if fp is not None:
+            hit = self.cache.get(fp)
+            if hit is not None:
+                row, version = hit
+                # entries are version-tagged and only served while their
+                # version is still live: the swap's cache.clear() is bulk
+                # eviction, but a batch IN FLIGHT across the swap writes
+                # its old-version rows AFTER the clear — this check is
+                # what actually guarantees no stale science is served
+                if version == self.param_store.version:
+                    self._count("cache_hits")
+                    fut = RequestFuture()
+                    fut.set_result(ServeResult(
+                        prediction=row, param_version=version,
+                        latency_ms=(self._clock() - now) * 1e3, cached=True,
+                    ))
+                    return fut
+        timeout = (timeout_ms / 1000.0 if timeout_ms is not None
+                   else self.default_timeout)
+        req = Request(
+            graph=graph,
+            enqueued=now,
+            deadline=None if timeout is None else now + timeout,
+            fingerprint=fp,
+        )
+        try:
+            self.batcher.offer(req)
+        except ServeRejection as e:
+            self._count(f"reject_{e.reason}")
+            raise
+        return req.future
+
+    def predict(self, graph: CrystalGraph,
+                timeout_ms: float | None = None) -> ServeResult:
+        """Blocking convenience: submit + wait."""
+        fut = self.submit(graph, timeout_ms=timeout_ms)
+        # wait slightly past the serving deadline: expiry is delivered by
+        # the worker, not by this caller racing it
+        timeout = (timeout_ms / 1000.0 if timeout_ms is not None
+                   else self.default_timeout)
+        return fut.result(None if timeout is None else timeout + 30.0)
+
+    # ---- the worker ----
+
+    def _serve_loop(self) -> None:
+        while True:
+            flush = self.batcher.next_flush()
+            if flush is None:
+                return
+            try:
+                self._process(flush)
+            except Exception as e:  # noqa: BLE001 — fail the flush, not the server
+                self._log(f"serve: batch failed: {e!r}")
+                for r in flush.requests:
+                    if not r.future.done():
+                        r.future.set_error(e)
+
+    def _process(self, flush: Flush) -> None:
+        import jax
+
+        for r in flush.expired:
+            self._count("reject_timeout")
+            r.future.set_error(ServeRejection(
+                TIMEOUT,
+                f"deadline exceeded after "
+                f"{(self._clock() - r.enqueued) * 1e3:.1f} ms in queue",
+            ))
+        if not flush.requests:
+            return
+        reqs = flush.requests
+        # the hot-swap boundary: one consistent (params, version) pair per
+        # batch — a reload landing after this line affects the NEXT batch
+        state, version = self.param_store.get()
+        batch = self.shape_set.pack([r.graph for r in reqs],
+                                    shape=flush.shape)
+        pre = self._jit_cache_size()
+        out = np.asarray(jax.device_get(self.predict_step(state, batch)))
+        post = self._jit_cache_size()
+        if self.warmed and pre is not None and post is not None and post > pre:
+            # a recompile after warmup is a policy bug (the batcher left
+            # the warm shape set) — LOUD, and counted for the loadgen
+            self._compiles_after_warm += post - pre
+            self.telemetry.counter_add("serve_recompiles_after_warm",
+                                       post - pre)
+            self._log(
+                f"serve: UNEXPECTED recompile after warmup "
+                f"(shape {flush.shape}); latency SLO was broken this batch"
+            )
+        now = self._clock()
+        occupancy = len(reqs) / flush.shape.graph_cap
+        for i, r in enumerate(reqs):
+            row = out[i].copy()
+            latency_ms = (now - r.enqueued) * 1e3
+            if self.cache is not None and r.fingerprint is not None:
+                self.cache.put(r.fingerprint, (row, version))
+            r.future.set_result(ServeResult(
+                prediction=row, param_version=version,
+                latency_ms=latency_ms, batch_occupancy=occupancy,
+            ))
+            self._record_latency(latency_ms)
+            # per REQUEST, not per batch: the run-summary quantiles must
+            # describe the same distribution stats() does (PERF.md §10)
+            self.telemetry.observe_value("serve_latency_ms", latency_ms)
+            self._count("responses")
+        self._count("batches")
+        with self._lock:
+            self._occupancies.append(occupancy)
+            del self._occupancies[:-4096]
+        self.telemetry.observe_value("serve_batch_occupancy", occupancy)
+        self.telemetry.set_gauge("serve_queue_depth", self.batcher.depth)
+
+    # ---- bookkeeping ----
+
+    def _count(self, key: str) -> None:
+        with self._lock:
+            self.counts[key] = self.counts.get(key, 0) + 1
+        self.telemetry.counter_add(f"serve_{key}", 1)
+
+    def _record_latency(self, latency_ms: float) -> None:
+        with self._lock:
+            self._latencies.append(latency_ms)
+            del self._latencies[:-8192]
+
+    def latency_quantiles(self) -> dict:
+        """{p50, p95, p99, mean, count} over recent responses."""
+        with self._lock:
+            vals = list(self._latencies)
+        if not vals:
+            return {}
+        arr = np.asarray(vals)
+        p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+        return {"p50": float(p50), "p95": float(p95), "p99": float(p99),
+                "mean": float(arr.mean()), "count": len(vals)}
+
+    def stats(self) -> dict:
+        with self._lock:
+            counts = dict(self.counts)
+            occ = list(self._occupancies)
+        out = {
+            "counts": counts,
+            "queue_depth": self.batcher.depth,
+            "param_version": self.param_store.version,
+            "draining": self._draining,
+            "latency_ms": self.latency_quantiles(),
+            "batch_occupancy_mean": float(np.mean(occ)) if occ else 0.0,
+            "shapes": [s.to_meta() for s in self.shape_set],
+            "recompiles_after_warm": self._compiles_after_warm,
+        }
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        if self._watcher is not None:
+            out["reload"] = {"swaps": self._watcher.swaps,
+                             "skips": self._watcher.skips}
+        return out
+
+
+def plan_from_state(meta: dict) -> dict:
+    """Model/packing knobs serve needs from a checkpoint's meta dict."""
+    from cgnn_tpu.config import DataConfig, ModelConfig
+
+    model_cfg = ModelConfig.from_meta(meta.get("model", {}))
+    data_cfg = DataConfig.from_meta(meta.get("data", {}))
+    return {"model_cfg": model_cfg, "data_cfg": data_cfg,
+            "task": meta.get("task", "regression")}
+
+
+def load_server(
+    ckpt_dir: str,
+    *,
+    batch_size: int = 64,
+    rungs: int = 3,
+    calibration: Sequence[CrystalGraph] | None = None,
+    calibration_n: int = 256,
+    tag: str = "latest",
+    telemetry=None,
+    max_queue: int = 256,
+    max_wait_ms: float = 5.0,
+    default_timeout_ms: float | None = 1000.0,
+    cache_size: int = 1024,
+    watch: bool = True,
+    poll_interval_s: float = 2.0,
+    log_fn: Callable = print,
+):
+    """Boot an InferenceServer from a training checkpoint directory.
+
+    Shared by serve.py (HTTP) and scripts/serve_loadgen.py (in-process):
+    restores the verified checkpoint, rebuilds the model, plans the shape
+    ladder from ``calibration`` (default: synthetic structures drawn with
+    the checkpoint's own featurization config), warms every shape, and —
+    with ``watch`` — attaches the hot-reload watcher to ``ckpt_dir``.
+
+    -> (server, dict of the bits callers reuse: manager, meta, configs,
+    template graph, the calibration sample).
+    """
+    import jax
+
+    from cgnn_tpu.config import build_model
+    from cgnn_tpu.data.dataset import load_synthetic
+    from cgnn_tpu.train import (
+        CheckpointManager,
+        Normalizer,
+        create_train_state,
+        make_optimizer,
+    )
+
+    mgr = CheckpointManager(ckpt_dir, log_fn=log_fn)
+    if not mgr.exists(tag):
+        raise FileNotFoundError(f"no {tag!r} checkpoint under {ckpt_dir}")
+    meta = mgr.read_meta(tag)
+    cfg = plan_from_state(meta)
+    if cfg["task"] == "force":
+        raise NotImplementedError(
+            "online serving covers property prediction; the force task's "
+            "per-atom output extraction is offline-only (predict.py)"
+        )
+    model_cfg, data_cfg = cfg["model_cfg"], cfg["data_cfg"]
+    model = build_model(model_cfg, data_cfg, cfg["task"])
+    if calibration is None:
+        calibration = load_synthetic(
+            calibration_n, data_cfg.featurize_config(), seed=0
+        )
+    dense_m = model_cfg.dense_m or None
+    edge_dtype = (jax.numpy.bfloat16 if model_cfg.dtype == "bfloat16"
+                  else np.float32)
+    shape_set = plan_shape_set(
+        calibration, batch_size, rungs=rungs, dense_m=dense_m,
+        edge_dtype=edge_dtype, num_targets=model_cfg.num_targets,
+    )
+    template = calibration[0]
+    example = shape_set.pack([template])
+    state = create_train_state(
+        model, example, make_optimizer(),
+        Normalizer.identity(model_cfg.num_targets), rng=jax.random.key(0),
+    )
+    state = mgr.restore_for_inference(state, tag)
+    # label with what the verifying chain ACTUALLY loaded — it can fall
+    # back past a corrupt newest save, and a wrong label here would both
+    # mis-tag every response and pin the watcher (newest == "current")
+    version = mgr.last_restored or tag
+    server = InferenceServer(
+        state, shape_set, version=version, telemetry=telemetry,
+        max_queue=max_queue, max_wait_ms=max_wait_ms,
+        default_timeout_ms=default_timeout_ms, cache_size=cache_size,
+        log_fn=log_fn,
+    )
+    server.warm(template)
+    if watch:
+        server.attach_watcher(mgr, poll_interval_s=poll_interval_s,
+                              log_fn=log_fn)
+    return server, {
+        "manager": mgr, "meta": meta, "model_cfg": model_cfg,
+        "data_cfg": data_cfg, "template": template,
+        "calibration": calibration,
+    }

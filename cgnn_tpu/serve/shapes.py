@@ -1,0 +1,215 @@
+"""Warm shape set: the fixed ladder of precompiled batch shapes.
+
+The whole CGCNN-on-XLA lineage rests on one packing insight: dispatch is
+cheap exactly when every batch reuses an already-compiled fixed shape
+(data/graph.py). Offline that is easy — ``capacities_for`` derives snug
+capacities per dataset. Online it is the hard part: traffic arrives one
+structure at a time, batch composition varies second to second, and a
+recompile (seconds, through a high-latency link) inside a request's
+latency budget is an SLO kill. So the serving path inverts the offline
+derivation: a SMALL FIXED LADDER of (graph_cap, node_cap, edge_cap)
+rungs is quantized ONCE from a calibration sample, every rung is
+compiled at startup (through the persistent XLA compile cache, so a
+restart warms from disk), and the micro-batcher only ever packs into
+rungs from this set — zero recompiles after warmup, by construction.
+
+The same ``ShapeSet`` serves offline: ``train.infer.run_fast_inference``
+accepts one in place of its per-bucket capacity derivation, so predict
+jobs reuse the serving shapes (and the serving compile cache) instead of
+compiling fresh per-dataset programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import numpy as np
+
+from cgnn_tpu.data.graph import (
+    CrystalGraph,
+    GraphBatch,
+    capacities_for,
+    graph_cap_for,
+    pack_graphs,
+)
+
+
+def _align8(n: int) -> int:
+    return max(8, -(-int(n) // 8) * 8)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class BatchShape:
+    """One compiled batch shape (capacities, not contents)."""
+
+    graph_cap: int
+    node_cap: int
+    edge_cap: int
+
+    def fits(self, n_graphs: int, n_nodes: int, n_edges: int) -> bool:
+        return (
+            n_graphs <= self.graph_cap
+            and n_nodes <= self.node_cap
+            and n_edges <= self.edge_cap
+        )
+
+    def to_meta(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ShapeSet:
+    """An ascending ladder of :class:`BatchShape` rungs plus the packing
+    parameters (dense layout, edge dtype, target width) every rung shares.
+
+    ``shape_for`` picks the SMALLEST rung that fits a request set — a
+    half-empty flush then pays a small program's latency, not the full
+    batch shape's. ``admits`` is the oversize gate: a single structure
+    that does not fit the largest rung can never be served and is
+    rejected at admission, with the observed sizes in the error.
+    """
+
+    def __init__(
+        self,
+        shapes: Sequence[BatchShape],
+        *,
+        dense_m: int | None = None,
+        edge_dtype=np.float32,
+        num_targets: int = 1,
+    ):
+        if not shapes:
+            raise ValueError("a ShapeSet needs at least one shape")
+        self.shapes = tuple(sorted(set(shapes)))
+        self.dense_m = dense_m
+        self.edge_dtype = edge_dtype
+        self.num_targets = num_targets
+        for s in self.shapes:
+            if dense_m is not None and s.edge_cap != s.node_cap * dense_m:
+                raise ValueError(
+                    f"dense layout requires edge_cap == node_cap * dense_m "
+                    f"for every rung; {s} violates it (dense_m={dense_m})"
+                )
+
+    def __len__(self) -> int:
+        return len(self.shapes)
+
+    def __iter__(self):
+        return iter(self.shapes)
+
+    @property
+    def largest(self) -> BatchShape:
+        return self.shapes[-1]
+
+    def graph_counts(self, graph: CrystalGraph) -> tuple[int, int]:
+        """(nodes, edge slots) one graph consumes under this set's layout.
+
+        Dense layout consumes ``nodes * dense_m`` edge slots regardless of
+        the true edge count (slot ownership is structural)."""
+        if self.dense_m is not None:
+            return graph.num_nodes, graph.num_nodes * self.dense_m
+        return graph.num_nodes, graph.num_edges
+
+    def admits(self, graph: CrystalGraph) -> bool:
+        n, e = self.graph_counts(graph)
+        return self.largest.fits(1, n, e)
+
+    def oversize_detail(self, graph: CrystalGraph) -> str:
+        n, e = self.graph_counts(graph)
+        big = self.largest
+        return (
+            f"structure has {n} nodes / {e} edge slots; the largest "
+            f"compiled shape holds {big.node_cap} nodes / {big.edge_cap} "
+            f"edge slots"
+        )
+
+    def shape_for(self, n_graphs: int, n_nodes: int,
+                  n_edges: int) -> BatchShape | None:
+        """Smallest rung fitting the given totals (None = nothing fits)."""
+        for s in self.shapes:
+            if s.fits(n_graphs, n_nodes, n_edges):
+                return s
+        return None
+
+    def pack(self, graphs: Sequence[CrystalGraph],
+             shape: BatchShape | None = None) -> GraphBatch:
+        """Pack ``graphs`` into ``shape`` (default: smallest fitting rung)."""
+        if shape is None:
+            n = sum(g.num_nodes for g in graphs)
+            e = sum(self.graph_counts(g)[1] for g in graphs)
+            shape = self.shape_for(len(graphs), n, e)
+            if shape is None:
+                raise ValueError(
+                    f"{len(graphs)} graphs ({n} nodes) fit no shape in "
+                    f"{self.shapes}"
+                )
+        return pack_graphs(
+            list(graphs),
+            shape.node_cap,
+            shape.edge_cap,
+            shape.graph_cap,
+            num_targets=self.num_targets,
+            dense_m=self.dense_m,
+            # in_cap/over_cap omitted: forward-only batches carry no
+            # transpose slots (the backward-pass-only layout)
+            edge_dtype=self.edge_dtype,
+        )
+
+    def to_meta(self) -> dict:
+        return {
+            "shapes": [s.to_meta() for s in self.shapes],
+            "dense_m": self.dense_m,
+            "edge_dtype": np.dtype(self.edge_dtype).name
+            if self.edge_dtype is not np.float32 else "float32",
+            "num_targets": self.num_targets,
+        }
+
+
+def plan_shape_set(
+    calibration: Sequence[CrystalGraph],
+    batch_size: int,
+    *,
+    rungs: int = 3,
+    dense_m: int | None = None,
+    edge_dtype=np.float32,
+    num_targets: int | None = None,
+) -> ShapeSet:
+    """Quantize a serving ladder from a calibration sample.
+
+    The top rung is the offline-proven snug full-batch shape
+    (``capacities_for(snug=True)`` at ``batch_size`` with
+    ``graph_cap_for`` slack); each lower rung halves the graph budget and
+    scales node/edge capacity proportionally (8-aligned), floored so that
+    ANY admitted structure fits EVERY rung — a deadline flush holding one
+    lone large structure must still have a rung to land in. ``rungs``
+    bounds the compile count: warmup compiles exactly ``len(set)``
+    programs, and nothing after warmup ever compiles.
+    """
+    if not len(calibration):
+        raise ValueError("shape planning needs a calibration sample")
+    if rungs < 1:
+        raise ValueError(f"rungs must be >= 1, got {rungs}")
+    node_cap, edge_cap = capacities_for(
+        calibration, batch_size, dense_m=dense_m, snug=True
+    )
+    # any admitted graph must fit the smallest rung (see docstring)
+    max_nodes = max(g.num_nodes for g in calibration)
+    max_edges = max(g.num_edges for g in calibration)
+    if num_targets is None:
+        num_targets = int(np.atleast_1d(calibration[0].target).shape[0])
+    shapes = []
+    for r in range(rungs):
+        scale = 2**r
+        b = max(1, math.ceil(batch_size / scale))
+        nc = _align8(max(math.ceil(node_cap / scale), max_nodes))
+        if dense_m is not None:
+            ec = nc * dense_m
+        else:
+            ec = _align8(max(math.ceil(edge_cap / scale), max_edges))
+        shapes.append(BatchShape(graph_cap_for(b), nc, ec))
+    return ShapeSet(
+        shapes,
+        dense_m=dense_m,
+        edge_dtype=edge_dtype,
+        num_targets=num_targets,
+    )

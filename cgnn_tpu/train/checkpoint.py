@@ -130,6 +130,12 @@ class CheckpointManager:
         self._worker: threading.Thread | None = None
         self._errors: list[BaseException] = []
         self.last_restore_report: list[str] = []
+        # name of the candidate the most recent restore actually loaded
+        # (None until a restore succeeds) — the chain can FALL BACK past
+        # the newest save, so consumers labeling what they serve/resume
+        # (serve.load_server's param_version) must read this rather than
+        # assume newest_committed() is what restored
+        self.last_restored: str | None = None
         self._swept_tmp = False
         self._next_seq = 1 + max(
             (int(m.group(1)) for m in map(_SAVE_RE.match,
@@ -147,6 +153,14 @@ class CheckpointManager:
             and read_manifest(os.path.join(self.directory, n)) is not None
         ]
         return sorted(names, reverse=True)
+
+    def newest_committed(self) -> str | None:
+        """Name of the newest committed versioned save (None when the
+        directory has none) — the polling primitive behind the serving
+        hot-reload watcher (serve/reload.py). Read-only: safe to call
+        from a process that never saves."""
+        saves = self._committed_saves()
+        return saves[0] if saves else None
 
     def _best_target(self) -> str | None:
         try:
@@ -192,6 +206,12 @@ class CheckpointManager:
             ordered = list(saves)
             if best and best not in ordered:
                 ordered.append(best)
+        elif _SAVE_RE.match(tag):
+            # explicit versioned save name (hot-reload restores a SPECIFIC
+            # newly committed save, never "whatever is newest by now"):
+            # exactly that candidate, no fallback — the caller decides what
+            # a verification failure means (the watcher skips and reports)
+            return [self._save_candidate(tag)] if tag in saves else []
         else:
             # arbitrary tag: only ever existed as a legacy tag directory
             # (the old layout saved to <dir>/<tag>); no versioned chain
@@ -390,6 +410,7 @@ class CheckpointManager:
                     f"checkpoint restore: fell back to {cand.name} "
                     f"({i} newer candidate(s) skipped — see above)"
                 )
+            self.last_restored = cand.name
             return cand, tree, meta
         raise CheckpointRestoreError(tag, self.last_restore_report)
 

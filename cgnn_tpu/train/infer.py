@@ -9,8 +9,17 @@ applies the training loop's lessons to the forward pass:
   train.py; >=0.97 padding efficiency at MP scale);
 - dispatch pipelining with a windowed value-fetch fence (bounds in-flight
   staged batches without a per-batch round trip);
-- ONE stacked device_get per bucket instead of one transfer per batch
-  (a device-side jnp.stack then a single link transfer).
+- ONE stacked device_get per compiled shape instead of one transfer per
+  batch (a device-side jnp.stack then a single link transfer).
+
+ISSUE 3 made the compiled shapes injectable: pass ``shape_set`` (a
+``serve.shapes.ShapeSet`` — the serving ladder) and batches pack into
+those FIXED precompiled rungs instead of deriving fresh per-bucket
+capacities — an offline predict job then reuses the online service's
+shapes (and, through the persistent XLA cache, its compiled programs),
+and the total compile count is pinned at ``len(shape_set)`` regardless
+of dataset. ``predict_step`` is likewise injectable, so serve and
+predict can share one jitted callable and its jit cache.
 """
 
 from __future__ import annotations
@@ -34,6 +43,33 @@ from cgnn_tpu.train.step import make_predict_step
 _WINDOW = 16
 
 
+def _shape_set_plan(graphs: Sequence, shape_set):
+    """Yield (index span, graph sublist, shape): greedy fill to the
+    LARGEST rung in input order; the ragged tail takes the smallest rung
+    that fits it. Input order is preserved by construction, so spans are
+    contiguous."""
+    big = shape_set.largest
+    start = 0
+    cur: list = []
+    n = e = 0
+    for i, g in enumerate(graphs):
+        if not shape_set.admits(g):
+            raise ValueError(
+                f"graph {getattr(g, 'cif_id', i)!r} exceeds the shape set: "
+                f"{shape_set.oversize_detail(g)}"
+            )
+        gn, ge = shape_set.graph_counts(g)
+        if cur and not big.fits(len(cur) + 1, n + gn, e + ge):
+            yield np.arange(start, i), cur, big
+            start, cur, n, e = i, [], 0, 0
+        cur.append(g)
+        n += gn
+        e += ge
+    if cur:
+        yield (np.arange(start, len(graphs)), cur,
+               shape_set.shape_for(len(cur), n, e))
+
+
 def run_fast_inference(
     state,
     graphs: Sequence,
@@ -44,13 +80,18 @@ def run_fast_inference(
     snug: bool = True,
     edge_dtype=np.float32,
     predict_step=None,
+    shape_set=None,
 ) -> tuple[np.ndarray, float]:
     """Predict over ``graphs`` -> ([n, T] predictions in input order,
     end-to-end structures/sec including host packing).
 
-    Buckets are processed one at a time with their own snug capacities;
-    within a bucket the original graph order is preserved, so the output
-    rows map back to the input by construction.
+    Without ``shape_set``: buckets are processed one at a time with their
+    own snug capacities; within a bucket the original graph order is
+    preserved, so the output rows map back to the input by construction.
+
+    With ``shape_set``: batches pack into the fixed rungs (module
+    docstring); ``buckets``/``dense_m``/``snug``/``edge_dtype`` are
+    ignored — the set carries the layout.
     """
     if not len(graphs):
         raise ValueError("no graphs to predict")
@@ -58,32 +99,51 @@ def run_fast_inference(
     n = len(graphs)
     preds: np.ndarray | None = None
     t0 = time.perf_counter()
-    bucket_of = assign_size_buckets(graphs, buckets)
-    for b in range(int(bucket_of.max()) + 1):
-        idxs = np.nonzero(bucket_of == b)[0]
-        if len(idxs) == 0:
-            continue
-        sub = [graphs[int(i)] for i in idxs]
-        nc, ec = capacities_for(sub, batch_size, dense_m=dense_m, snug=snug)
-        outs: list = []
-        spans: list = []
-        ptr = 0
-        # in_cap=0: no backward, so no transpose-slot packing
-        for batch in batch_iterator(sub, batch_size, nc, ec, dense_m=dense_m,
-                                    in_cap=0, snug=snug,
-                                    edge_dtype=edge_dtype):
-            n_real = int(np.asarray(batch.graph_mask).sum())
-            outs.append(predict_step(state, batch))
-            spans.append(idxs[ptr : ptr + n_real])
-            ptr += n_real
-            if len(outs) % _WINDOW == 0:
-                # true fence (block_until_ready returns early on tunneled
-                # runtimes): proves the window's steps finished, bounding
-                # staged-batch HBM without a per-batch round trip
-                float(outs[-_WINDOW][0, 0])
-        stacked = np.asarray(jax.device_get(jnp.stack(outs)))
+
+    # (shape key -> [(span, out)]) so the single stacked fetch groups by
+    # compiled shape; spans restore input order on the host afterwards
+    outs_by_shape: dict = {}
+    recent: list = []
+
+    def _dispatch(span, batch, key):
+        out = predict_step(state, batch)
+        outs_by_shape.setdefault(key, []).append((span, out))
+        recent.append(out)
+        if len(recent) == _WINDOW:
+            # true fence (block_until_ready returns early on tunneled
+            # runtimes) on the OLDEST in-window result: proves everything
+            # dispatched before it finished — bounding staged-batch HBM —
+            # while the newer _WINDOW-1 dispatches stay in flight
+            float(recent[0][0, 0])
+            del recent[:]
+
+    if shape_set is not None:
+        for span, sub, shape in _shape_set_plan(graphs, shape_set):
+            _dispatch(span, shape_set.pack(sub, shape=shape), shape)
+    else:
+        bucket_of = assign_size_buckets(graphs, buckets)
+        for b in range(int(bucket_of.max()) + 1):
+            idxs = np.nonzero(bucket_of == b)[0]
+            if len(idxs) == 0:
+                continue
+            sub = [graphs[int(i)] for i in idxs]
+            nc, ec = capacities_for(sub, batch_size, dense_m=dense_m,
+                                    snug=snug)
+            ptr = 0
+            # in_cap=0: no backward, so no transpose-slot packing
+            for batch in batch_iterator(sub, batch_size, nc, ec,
+                                        dense_m=dense_m, in_cap=0, snug=snug,
+                                        edge_dtype=edge_dtype):
+                n_real = int(np.asarray(batch.graph_mask).sum())
+                _dispatch(idxs[ptr : ptr + n_real], batch, (b, nc, ec))
+                ptr += n_real
+
+    for group in outs_by_shape.values():
+        stacked = np.asarray(
+            jax.device_get(jnp.stack([out for _, out in group]))
+        )
         if preds is None:
             preds = np.zeros((n, stacked.shape[-1]), np.float32)
-        for o, span in zip(stacked, spans):
+        for (span, _), o in zip(group, stacked):
             preds[span] = o[: len(span)]
     return preds, n / (time.perf_counter() - t0)

@@ -37,9 +37,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--packing", choices=["snug", "ladder"], default="snug",
                    help="snug = fill-to-capacity batches (train.py's "
                         "default; >=0.97 padding efficiency)")
-    p.add_argument("--buckets", type=int, default=1,
-                   help="size-class buckets (per-class capacities; use 3 "
-                        "for MP-scale mixed sizes)")
+    p.add_argument("--buckets", type=int, default=0,
+                   help="legacy per-size-class capacity derivation (use 3 "
+                        "for MP-scale mixed sizes); default packs into the "
+                        "serving shape ladder instead (--rungs)")
+    p.add_argument("--rungs", type=int, default=2,
+                   help="serving shape-ladder depth (serve.shapes): the "
+                        "compile count is pinned at this many programs, "
+                        "shared with an online server via the persistent "
+                        "compile cache")
     p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache",
                    metavar="DIR", help="persistent XLA compile cache "
                                        "('' disables)")
@@ -63,6 +69,20 @@ def main(argv=None) -> int:
             )
         except Exception as e:  # noqa: BLE001 — cache is best-effort
             print(f"compilation cache unavailable: {e}", file=sys.stderr)
+    from cgnn_tpu.train import CheckpointManager
+
+    mgr = CheckpointManager(args.ckpt_dir)
+    try:
+        # single exit path: every return below (incl. early argument/data
+        # errors) flows through the finally, so the manager's finalizer
+        # thread and orbax handles are always closed
+        return _run(args, mgr)
+    finally:
+        mgr.close()
+
+
+def _run(args, mgr) -> int:
+    import jax
     import numpy as np
 
     from cgnn_tpu.config import DataConfig, ModelConfig, build_model
@@ -72,11 +92,10 @@ def main(argv=None) -> int:
         load_trajectory,
     )
     from cgnn_tpu.data.graph import batch_iterator
-    from cgnn_tpu.train import CheckpointManager, Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
     from cgnn_tpu.train.infer import run_fast_inference
     from cgnn_tpu.train.loop import capacities_for
 
-    mgr = CheckpointManager(args.ckpt_dir)
     tag = "best" if args.best else "latest"
     if not mgr.exists(tag):
         print(f"no '{tag}' checkpoint under {args.ckpt_dir}", file=sys.stderr)
@@ -172,13 +191,32 @@ def main(argv=None) -> int:
                 force_ids.append(g.cif_id)
                 force_arrays.append(forces[(node_graph == k) & node_mask])
                 idx += 1
-    else:
+    elif args.buckets >= 1:
+        # legacy path (any EXPLICIT --buckets, including 1): per-size-
+        # class snug capacities derived from THIS dataset (fresh compiles
+        # per run); the unset default (0) takes the shape ladder below
         preds, rate = run_fast_inference(
             state, graphs, args.batch_size, buckets=args.buckets,
             dense_m=layout_m, snug=snug, edge_dtype=edge_dtype,
         )
         print(f"inference throughput: {rate:.0f} structures/sec "
               f"(dispatch-pipelined, single fetch per bucket)")
+    else:
+        # default: pack into the serving shape ladder (serve.shapes) —
+        # compile count pinned at --rungs, and shared with an online
+        # server through the persistent XLA compile cache
+        from cgnn_tpu.serve.shapes import plan_shape_set
+
+        shape_set = plan_shape_set(
+            graphs, args.batch_size, rungs=args.rungs, dense_m=layout_m,
+            edge_dtype=edge_dtype, num_targets=model_cfg.num_targets,
+        )
+        preds, rate = run_fast_inference(
+            state, graphs, args.batch_size, shape_set=shape_set,
+        )
+        print(f"inference throughput: {rate:.0f} structures/sec "
+              f"(dispatch-pipelined, {len(shape_set)}-rung shape ladder)")
+    if not force_task:
         for g, p in zip(graphs, preds):
             rows.append(
                 [g.cif_id]
@@ -195,7 +233,6 @@ def main(argv=None) -> int:
             **{f"forces_{i}": f for i, f in enumerate(force_arrays)},
         )
         print(f"wrote per-atom forces to {args.out}.forces.npz")
-    mgr.close()
     return 0
 
 

@@ -1,0 +1,409 @@
+#!/usr/bin/env python
+"""Concurrent open-loop load generator for the serving subsystem.
+
+Drives the IN-PROCESS ``InferenceServer`` (no sockets — the pure core,
+so CI and laptops measure batching/reload behavior, not TCP noise) or a
+running HTTP server (``--http URL``), and writes an SLO report JSON:
+latency p50/p95/p99, throughput, batch occupancy, reject counts, param
+versions observed, and the invariant checks the ISSUE pins:
+
+- ZERO dropped responses: every submitted request resolves (result or
+  an explicit rejection — never a hung future);
+- ZERO recompiles after warmup (the jit cache-miss counter is read
+  before and after the run);
+- a mid-run checkpoint hot-swap (``--hot-swap``) completes with both
+  param versions observed in responses and zero drops — in-flight
+  requests finish on the old params.
+
+Exit code is non-zero when any pinned invariant fails, so CI can run
+this directly (tier1.yml serve-smoke).
+
+Typical use::
+
+    python scripts/serve_loadgen.py --make-ckpt /tmp/serve-ckpt
+    python scripts/serve_loadgen.py /tmp/serve-ckpt --clients 64 \
+        --duration 10 --hot-swap --report slo.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("ckpt_dir", nargs="?", default=None,
+                   help="checkpoint directory (see --make-ckpt)")
+    p.add_argument("--make-ckpt", metavar="DIR", default="",
+                   help="create a tiny synthetic checkpoint at DIR and exit")
+    p.add_argument("--http", default="",
+                   help="fire at a running HTTP server instead of in-process")
+    p.add_argument("--clients", type=int, default=64)
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="seconds of open-loop load")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="per-client requests/sec (0 = closed-loop as fast "
+                        "as responses return)")
+    p.add_argument("--structures", type=int, default=512,
+                   help="distinct synthetic structures to draw requests from")
+    p.add_argument("--timeout-ms", type=float, default=30000.0,
+                   help="per-request deadline handed to the server")
+    p.add_argument("--hot-swap", action="store_true",
+                   help="commit a new checkpoint at half-duration and "
+                        "assert a zero-drop version transition")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--rungs", type=int, default=3)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--max-queue", type=int, default=4096)
+    p.add_argument("--report", default="slo_report.json")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def make_synth_ckpt(ckpt_dir: str, seed: int = 0) -> None:
+    """Commit a tiny trained-for-zero-epochs checkpoint (the serving
+    fixture: real model config + normalizer + versioned-save protocol)."""
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.config import DataConfig, ModelConfig, build_model
+    from cgnn_tpu.data.dataset import load_synthetic
+    from cgnn_tpu.data.graph import batch_iterator, capacities_for
+    from cgnn_tpu.train import (
+        CheckpointManager,
+        Normalizer,
+        create_train_state,
+        make_optimizer,
+    )
+
+    model_cfg = ModelConfig(atom_fea_len=16, n_conv=2, h_fea_len=32,
+                            dense_m=12)
+    data_cfg = DataConfig(radius=6.0, max_num_nbr=12)
+    graphs = load_synthetic(64, data_cfg.featurize_config(), seed=seed)
+    nc, ec = capacities_for(graphs, 16, dense_m=12, snug=True)
+    example = next(batch_iterator(graphs, 16, nc, ec, dense_m=12, in_cap=0,
+                                  snug=True))
+    model = build_model(model_cfg, data_cfg)
+    state = create_train_state(
+        model, example, make_optimizer(),
+        Normalizer.fit(np.stack([g.target for g in graphs])),
+        rng=jax.random.key(seed),
+    )
+    mgr = CheckpointManager(ckpt_dir)
+    mgr.save(state, {"model": model_cfg.to_meta(), "data": data_cfg.to_meta(),
+                     "task": "regression", "epoch": 0})
+    mgr.close()
+    print(f"committed synthetic checkpoint under {ckpt_dir} "
+          f"({mgr.newest_committed()})")
+
+
+def _perturbed_save(manager, template_state) -> None:
+    """Commit a new version with visibly different params (the hot-swap
+    fixture: predictions must change across the swap)."""
+    import jax
+    import numpy as np
+
+    def nudge(x):
+        a = np.asarray(x)
+        if np.issubdtype(a.dtype, np.floating):
+            return (a * 1.05 + 0.01).astype(a.dtype)
+        return a
+
+    new_state = template_state.replace(
+        params=jax.tree_util.tree_map(nudge, template_state.params)
+    )
+    manager.save(new_state, dict(manager.read_meta("latest"), epoch=-1))
+    manager.wait()
+
+
+class _ClientStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: list[float] = []
+        self.versions: dict[str, int] = {}
+        self.occupancies: list[float] = []
+        self.submitted = 0
+        self.answered = 0
+        self.cached = 0
+        self.rejected: dict[str, int] = {}
+        self.dropped = 0
+        self.errors: list[str] = []
+
+
+def _run_inproc(args) -> dict:
+    import numpy as np
+
+    from cgnn_tpu.observe import Telemetry
+    from cgnn_tpu.serve.batcher import ServeRejection
+    from cgnn_tpu.serve.server import load_server
+
+    telemetry = Telemetry.disabled()
+    server, parts = load_server(
+        args.ckpt_dir,
+        batch_size=args.batch_size,
+        rungs=args.rungs,
+        telemetry=telemetry,
+        max_queue=args.max_queue,
+        max_wait_ms=args.max_wait_ms,
+        default_timeout_ms=args.timeout_ms,
+        cache_size=0,  # the loadgen reuses structures; caching would
+                       # let most requests skip the batcher under test
+        watch=args.hot_swap,
+        poll_interval_s=0.2,
+    )
+    server.start()
+    compiles_at_warm = server._jit_cache_size()
+
+    from cgnn_tpu.data.dataset import load_synthetic
+
+    pool = load_synthetic(args.structures, parts["data_cfg"].
+                          featurize_config(), seed=args.seed + 1)
+    pool = [g for g in pool if server.shape_set.admits(g)]
+
+    stats = _ClientStats()
+    stop = threading.Event()
+
+    def client(ci: int):
+        rng = np.random.default_rng(args.seed + ci)
+        interval = 1.0 / args.rate if args.rate > 0 else 0.0
+        while not stop.is_set():
+            g = pool[int(rng.integers(len(pool)))]
+            t0 = time.monotonic()
+            try:
+                with stats.lock:
+                    stats.submitted += 1
+                fut = server.submit(g, timeout_ms=args.timeout_ms)
+                res = fut.result(timeout=args.timeout_ms / 1000.0 + 60.0)
+            except ServeRejection as e:
+                with stats.lock:
+                    stats.rejected[e.reason] = (
+                        stats.rejected.get(e.reason, 0) + 1
+                    )
+                continue
+            except TimeoutError:
+                with stats.lock:
+                    stats.dropped += 1  # a hung future IS a drop
+                continue
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                with stats.lock:
+                    stats.errors.append(repr(e))
+                continue
+            with stats.lock:
+                stats.answered += 1
+                stats.latencies.append(res.latency_ms)
+                stats.versions[res.param_version] = (
+                    stats.versions.get(res.param_version, 0) + 1
+                )
+                if res.cached:
+                    stats.cached += 1
+                else:
+                    stats.occupancies.append(res.batch_occupancy)
+            if interval:
+                stop.wait(max(0.0, interval - (time.monotonic() - t0)))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    swapped_to = None
+    if args.hot_swap:
+        time.sleep(args.duration / 2)
+        state, _ = server.param_store.get()
+        _perturbed_save(parts["manager"], state)
+        # the watcher polls at 0.2 s; give it a moment inside the window
+        deadline = time.monotonic() + max(5.0, args.duration / 4)
+        while time.monotonic() < deadline:
+            if server._watcher is not None and server._watcher.swaps:
+                swapped_to = server.param_store.version
+                break
+            time.sleep(0.05)
+
+    while time.monotonic() - t_start < args.duration:
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=args.timeout_ms / 1000.0 + 90.0)
+    wall = time.monotonic() - t_start
+    server.drain(timeout_s=60.0)
+    compiles_at_end = server._jit_cache_size()
+
+    lat = np.asarray(stats.latencies) if stats.latencies else np.zeros(1)
+    report = {
+        "mode": "inproc",
+        "clients": args.clients,
+        "duration_s": round(wall, 2),
+        "submitted": stats.submitted,
+        "answered": stats.answered,
+        "rejected": stats.rejected,
+        "dropped": stats.dropped,
+        "client_errors": stats.errors[:10],
+        "throughput_rps": round(stats.answered / wall, 1),
+        "latency_ms": {
+            "p50": float(np.percentile(lat, 50)),
+            "p95": float(np.percentile(lat, 95)),
+            "p99": float(np.percentile(lat, 99)),
+            "mean": float(lat.mean()),
+        },
+        "batch_occupancy_mean": (
+            float(np.mean(stats.occupancies)) if stats.occupancies else 0.0
+        ),
+        "param_versions": stats.versions,
+        "hot_swap": {
+            "requested": bool(args.hot_swap),
+            "swapped_to": swapped_to,
+            "watcher_swaps": (server._watcher.swaps
+                              if server._watcher else 0),
+            "watcher_skips": (server._watcher.skips
+                              if server._watcher else 0),
+        },
+        "compiles": {
+            "at_warm": compiles_at_warm,
+            "at_end": compiles_at_end,
+            "after_warm": (compiles_at_end or 0) - (compiles_at_warm or 0),
+        },
+        "server_stats": server.stats(),
+    }
+    return report
+
+
+def _run_http(args) -> dict:
+    """Minimal HTTP leg (urllib threads): smoke the wire path."""
+    import urllib.request
+
+    import numpy as np
+
+    from cgnn_tpu.config import DataConfig
+    from cgnn_tpu.data.dataset import load_synthetic
+
+    pool = load_synthetic(
+        min(args.structures, 64),
+        DataConfig(radius=6.0, max_num_nbr=12).featurize_config(),
+        seed=args.seed + 1,
+    )
+    stats = _ClientStats()
+    stop = threading.Event()
+
+    def client(ci: int):
+        rng = np.random.default_rng(args.seed + ci)
+        while not stop.is_set():
+            g = pool[int(rng.integers(len(pool)))]
+            body = json.dumps({"graph": {
+                "atom_fea": g.atom_fea.tolist(),
+                "edge_fea": g.edge_fea.tolist(),
+                "centers": g.centers.tolist(),
+                "neighbors": g.neighbors.tolist(),
+                "id": g.cif_id,
+            }, "timeout_ms": args.timeout_ms}).encode()
+            req = urllib.request.Request(
+                args.http.rstrip("/") + "/predict", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with stats.lock:
+                stats.submitted += 1
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=args.timeout_ms / 1000.0 + 30.0
+                ) as resp:
+                    payload = json.loads(resp.read())
+            except Exception as e:  # noqa: BLE001 — count and move on
+                with stats.lock:
+                    reason = getattr(e, "code", "transport")
+                    stats.rejected[str(reason)] = (
+                        stats.rejected.get(str(reason), 0) + 1
+                    )
+                continue
+            with stats.lock:
+                stats.answered += 1
+                stats.latencies.append(float(payload["latency_ms"]))
+                v = payload["param_version"]
+                stats.versions[v] = stats.versions.get(v, 0) + 1
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60.0)
+    wall = time.monotonic() - t_start
+    lat = np.asarray(stats.latencies) if stats.latencies else np.zeros(1)
+    return {
+        "mode": "http",
+        "clients": args.clients,
+        "duration_s": round(wall, 2),
+        "submitted": stats.submitted,
+        "answered": stats.answered,
+        "rejected": stats.rejected,
+        "dropped": 0,
+        "throughput_rps": round(stats.answered / wall, 1),
+        "latency_ms": {
+            "p50": float(np.percentile(lat, 50)),
+            "p99": float(np.percentile(lat, 99)),
+        },
+        "param_versions": stats.versions,
+    }
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.make_ckpt:
+        make_synth_ckpt(args.make_ckpt, seed=args.seed)
+        return 0
+    if not args.http and not args.ckpt_dir:
+        print("CKPT_DIR (or --http URL / --make-ckpt DIR) required",
+              file=sys.stderr)
+        return 2
+
+    report = _run_http(args) if args.http else _run_inproc(args)
+
+    failures = []
+    if report.get("dropped"):
+        failures.append(f"{report['dropped']} dropped responses (must be 0)")
+    if report.get("client_errors"):
+        failures.append(f"client errors: {report['client_errors']}")
+    if report.get("compiles", {}).get("after_warm"):
+        failures.append(
+            f"{report['compiles']['after_warm']} recompiles after warmup "
+            f"(must be 0)"
+        )
+    if args.hot_swap and not args.http:
+        versions = report["param_versions"]
+        if report["hot_swap"]["watcher_swaps"] < 1:
+            failures.append("hot swap never happened")
+        elif len([v for v, c in versions.items() if c > 0]) < 2:
+            failures.append(
+                f"expected responses from both param versions, saw "
+                f"{versions}"
+            )
+    report["failures"] = failures
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=1)
+    lat = report["latency_ms"]
+    print(
+        f"[{report['mode']}] {report['answered']}/{report['submitted']} "
+        f"answered @ {report['throughput_rps']} rps | p50 "
+        f"{lat['p50']:.1f} ms p99 {lat['p99']:.1f} ms | occupancy "
+        f"{report.get('batch_occupancy_mean', 0):.2f} | versions "
+        f"{report['param_versions']} | report -> {args.report}"
+    )
+    if failures:
+        print("SLO INVARIANT FAILURES: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

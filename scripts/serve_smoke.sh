@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Serving smoke (ISSUE 3 acceptance; .github/workflows/tier1.yml):
+#
+#  1. in-process load: 64 concurrent clients against the micro-batching
+#     server on a tiny synthetic checkpoint, with a checkpoint hot-swap
+#     committed mid-load -> the loadgen itself asserts ZERO dropped
+#     responses, ZERO recompiles after warmup, and responses observed
+#     from BOTH param versions (exit non-zero otherwise);
+#  2. HTTP front-end: start serve.py, wait for /healthz, fire concurrent
+#     HTTP requests, then SIGTERM -> the server must drain gracefully
+#     (queued requests answered) and exit 0.
+#
+# Runs anywhere jax[cpu] does (synthetic data, CPU device).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+PORT="${SERVE_SMOKE_PORT:-18437}"
+
+echo "== setup: tiny synthetic checkpoint =="
+python scripts/serve_loadgen.py --make-ckpt "$WORK/ckpt"
+
+echo "== leg 1: 64-client in-process load + mid-load hot swap =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --clients 64 --duration 8 --hot-swap \
+  --report "$WORK/slo_report.json"
+python - "$WORK/slo_report.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["dropped"] == 0, r
+assert r["compiles"]["after_warm"] == 0, r["compiles"]
+assert len(r["param_versions"]) >= 2, r["param_versions"]
+assert not r["failures"], r["failures"]
+print("leg 1 ok:", r["answered"], "answered @", r["throughput_rps"], "rps,",
+      "p99", round(r["latency_ms"]["p99"], 1), "ms, versions",
+      list(r["param_versions"]))
+EOF
+
+echo "== leg 2: HTTP front-end + graceful SIGTERM drain =="
+python serve.py "$WORK/ckpt" --port "$PORT" --calibrate 64 \
+  >"$WORK/serve.log" 2>&1 &
+SPID=$!
+for _ in $(seq 1 600); do
+  curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 && break
+  if ! kill -0 "$SPID" 2>/dev/null; then
+    echo "serve.py died during startup" >&2
+    cat "$WORK/serve.log" >&2
+    exit 1
+  fi
+  sleep 0.2
+done
+curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null
+
+python scripts/serve_loadgen.py --http "http://127.0.0.1:$PORT" \
+  --clients 8 --duration 4 --report "$WORK/slo_http.json"
+
+kill -TERM "$SPID"
+set +e; wait "$SPID"; RC=$?; set -e
+if [ "$RC" -ne 0 ]; then
+  echo "expected graceful drain exit 0, got $RC" >&2
+  tail -30 "$WORK/serve.log" >&2
+  exit 1
+fi
+grep -q "draining" "$WORK/serve.log"
+python - "$WORK/slo_http.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["answered"] > 0, "HTTP leg answered nothing"
+print("leg 2 ok:", r["answered"], "HTTP responses @",
+      r["throughput_rps"], "rps")
+EOF
+
+echo "serve smoke: ALL LEGS PASSED"

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Online inference server entrypoint (cgnn_tpu.serve; ISSUE 3).
+
+Loads a train.py checkpoint, plans + warms the fixed serving shape set,
+starts the hot-reload watcher on the checkpoint directory, and serves
+HTTP until SIGTERM/SIGINT — which triggers a graceful drain (queued
+requests answered, new ones rejected 503) and exit 0.
+
+Usage:
+    python serve.py CKPT_DIR [--port 8437] [--batch-size 64] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("ckpt_dir", help="checkpoint directory written by train.py")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8437)
+    p.add_argument("--device", choices=["auto", "cpu", "tpu"], default="auto")
+    p.add_argument("-b", "--batch-size", type=int, default=64,
+                   help="graph budget of the largest serving shape")
+    p.add_argument("--rungs", type=int, default=3,
+                   help="shape-ladder depth (compile count at warmup)")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="micro-batch flush deadline")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="admission bound (backpressure: reject above this)")
+    p.add_argument("--timeout-ms", type=float, default=1000.0,
+                   help="default per-request deadline (0 disables)")
+    p.add_argument("--cache-size", type=int, default=1024,
+                   help="LRU result cache entries (0 disables)")
+    p.add_argument("--poll-interval", type=float, default=2.0,
+                   help="hot-reload checkpoint poll seconds (0 disables)")
+    p.add_argument("--calibrate", type=int, default=256,
+                   help="synthetic calibration structures for shape planning")
+    p.add_argument("--calibration-cache", type=str, default="",
+                   help="featurized graph cache to calibrate shapes from "
+                        "(real traffic distribution beats synthetic)")
+    p.add_argument("--telemetry-dir", type=str, default="",
+                   help="write serving metrics.jsonl here ('' disables)")
+    p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache",
+                   metavar="DIR", help="persistent XLA compile cache "
+                                       "('' disables; warm restarts replay "
+                                       "compiles from disk)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.device == "cpu":
+        # env var alone is not honored under the axon TPU tunnel
+        jax.config.update("jax_platforms", "cpu")
+    if args.compile_cache:
+        try:
+            jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+        except Exception as e:  # noqa: BLE001 — cache is best-effort
+            print(f"compilation cache unavailable: {e}", file=sys.stderr)
+
+    from cgnn_tpu.observe import Telemetry
+    from cgnn_tpu.serve.http import make_http_server, make_structure_featurizer
+    from cgnn_tpu.serve.server import load_server
+
+    telemetry = (
+        Telemetry(level="epoch", log_dir=args.telemetry_dir)
+        if args.telemetry_dir else Telemetry.disabled()
+    )
+    calibration = None
+    if args.calibration_cache:
+        from cgnn_tpu.data.cache import load_graph_cache
+
+        calibration = load_graph_cache(args.calibration_cache)
+    try:
+        server, parts = load_server(
+            args.ckpt_dir,
+            batch_size=args.batch_size,
+            rungs=args.rungs,
+            calibration=calibration,
+            calibration_n=args.calibrate,
+            telemetry=telemetry,
+            max_queue=args.max_queue,
+            max_wait_ms=args.max_wait_ms,
+            default_timeout_ms=args.timeout_ms or None,
+            cache_size=args.cache_size,
+            watch=args.poll_interval > 0,
+            poll_interval_s=args.poll_interval or 2.0,
+        )
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    server.start()
+
+    httpd = make_http_server(
+        server, host=args.host, port=args.port,
+        featurize=make_structure_featurizer(parts["data_cfg"]),
+    )
+
+    # SIGTERM/SIGINT -> drain the batcher, stop the listener, exit 0
+    # (resilience.preempt signal plumbing; second signal kills)
+    handler = server.install_signal_handlers()
+    handler.add_callback(lambda: threading.Thread(
+        target=httpd.shutdown, daemon=True).start())
+
+    shapes = ", ".join(
+        f"({s.graph_cap}g/{s.node_cap}n/{s.edge_cap}e)"
+        for s in server.shape_set
+    )
+    print(f"serving on http://{args.host}:{args.port} "
+          f"(params {server.param_store.version}; shapes {shapes})")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        server.begin_drain()
+    httpd.server_close()
+    clean = server.drain(timeout_s=30.0)
+    handler.uninstall()
+    stats = server.stats()
+    lat = stats["latency_ms"]
+    if lat:
+        print(f"drained: {stats['counts']['responses']} responses, "
+              f"p50 {lat['p50']:.1f} ms / p99 {lat['p99']:.1f} ms")
+    telemetry.close()
+    if not clean:
+        print("drain timed out with requests still queued", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -369,3 +369,39 @@ class TestLoaderTelemetry:
         assert counters.get("loader_put_s", 0.0) >= 0.0
         assert "loader_wait_s" in counters
         telemetry.close()
+
+
+class TestDataParallelStepStream:
+    @pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                        reason="jax.shard_map unavailable (pre-existing "
+                               "seed gap in this jax build; runs in CI)")
+    def test_dp_per_step_loop_streams(self, tiny_dataset, tmp_path):
+        """The PR-1 known gap, closed (ISSUE 3): the DP PER-STEP loop
+        (scan_epochs=False) now emits per-step stream records — the tap
+        rides an outer jit around the shard_map step, carrying the
+        replicated post-psum metric sums (one record per step, not one
+        per device)."""
+        from cgnn_tpu.parallel import fit_data_parallel
+        from cgnn_tpu.parallel.mesh import make_mesh
+        from cgnn_tpu.train.loop import capacities_for
+
+        train, val, _ = tiny_dataset
+        telemetry = Telemetry("step", str(tmp_path), use_clu=False)
+        nc, ec = capacities_for(train, 4)
+        state = _fresh_state(train, nc, ec)
+        fit_data_parallel(
+            state, train, val, epochs=1, batch_size=4,
+            node_cap=nc, edge_cap=ec, mesh=make_mesh(2),
+            print_freq=0, log_fn=lambda *a, **k: None,
+            telemetry=telemetry, scan_epochs=False,
+        )
+        recs = telemetry.stream.records("train")
+        assert recs, "DP per-step loop emitted no stream records"
+        n_steps = max(r["step"] for r in recs)
+        # one record per optimizer step (not per device)
+        assert len(recs) == len({r["step"] for r in recs})
+        assert all("loss" in r for r in recs)
+        telemetry.close()
+        events = [r for r in read_jsonl(str(tmp_path / "metrics.jsonl"))
+                  if r.get("event") == "step" and r.get("phase") == "train"]
+        assert len(events) >= n_steps

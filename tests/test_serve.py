@@ -1,0 +1,498 @@
+"""Online serving subsystem tests (cgnn_tpu.serve; ISSUE 3).
+
+The load-bearing guarantees, pinned:
+
+- micro-batch flush fires on shape-full AND on the deadline, never on a
+  shape outside the warm set;
+- admission control: oversize and queue-full reject with typed errors,
+  per-request deadlines expire with TIMEOUT, a draining batcher rejects
+  new work but answers what it accepted (SIGTERM drain, zero drops);
+- hot reload is atomic: a swap landing mid-batch leaves the in-flight
+  batch on its old params (version recorded per response); an
+  integrity-failed checkpoint is skipped with a logged report and the
+  old params keep serving;
+- the served numbers equal the offline predict path's, and repeated
+  queries hit the LRU cache without drifting.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from cgnn_tpu.config import DataConfig, ModelConfig, build_model
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+from cgnn_tpu.observe import Telemetry
+from cgnn_tpu.resilience import faultinject
+from cgnn_tpu.resilience.preempt import PreemptionHandler
+from cgnn_tpu.serve import (
+    MALFORMED,
+    OVERSIZE,
+    QUEUE_FULL,
+    SHUTDOWN,
+    TIMEOUT,
+    BatchShape,
+    InferenceServer,
+    MicroBatcher,
+    Request,
+    ResultCache,
+    ServeRejection,
+    ShapeSet,
+    plan_shape_set,
+    structure_fingerprint,
+)
+from cgnn_tpu.serve.reload import CheckpointWatcher
+from cgnn_tpu.train import (
+    CheckpointManager,
+    Normalizer,
+    create_train_state,
+    make_optimizer,
+)
+from cgnn_tpu.train.step import make_predict_step
+
+CFG = FeaturizeConfig(radius=5.0, max_num_nbr=8)
+
+
+@pytest.fixture(scope="module")
+def graphs():
+    return load_synthetic(48, CFG, seed=11, max_atoms=8)
+
+
+@pytest.fixture(scope="module")
+def shape_set(graphs):
+    return plan_shape_set(graphs, 8, rungs=2)
+
+
+@pytest.fixture(scope="module")
+def model_state(graphs, shape_set):
+    model_cfg = ModelConfig(atom_fea_len=8, n_conv=1, h_fea_len=16)
+    model = build_model(model_cfg, DataConfig(radius=5.0, max_num_nbr=8))
+    state = create_train_state(
+        model, shape_set.pack([graphs[0]]), make_optimizer(),
+        Normalizer.fit(np.stack([g.target for g in graphs])),
+        rng=jax.random.key(7),
+    )
+    return model_cfg, state
+
+
+def _request(graph, now=0.0, deadline=None):
+    return Request(graph=graph, enqueued=now, deadline=deadline)
+
+
+# ---------------------------------------------------------------- shapes
+
+
+class TestShapePlanner:
+    def test_ladder_properties(self, graphs):
+        ss = plan_shape_set(graphs, 16, rungs=3)
+        assert len(ss) == 3
+        caps = [(s.graph_cap, s.node_cap, s.edge_cap) for s in ss]
+        assert caps == sorted(caps)
+        for s in ss:
+            assert s.node_cap % 8 == 0
+            # every admitted graph fits every rung (deadline flushes can
+            # land a lone large structure in the smallest rung)
+            assert all(
+                s.fits(1, *ss.graph_counts(g)) for g in graphs
+            )
+
+    def test_shape_for_picks_smallest(self, graphs):
+        ss = plan_shape_set(graphs, 16, rungs=3)
+        small = ss.shapes[0]
+        assert ss.shape_for(1, 8, 16) == small
+        assert ss.shape_for(10**9, 1, 1) is None
+
+    def test_dense_invariant(self, graphs):
+        ss = plan_shape_set(graphs, 16, rungs=2, dense_m=8)
+        for s in ss:
+            assert s.edge_cap == s.node_cap * 8
+
+    def test_pack_round_trip(self, graphs, shape_set):
+        batch = shape_set.pack(graphs[:3])
+        assert int(np.asarray(batch.graph_mask).sum()) == 3
+        shapes = {(s.node_cap,) for s in shape_set}
+        assert (batch.nodes.shape[0],) in shapes
+
+
+# --------------------------------------------------------------- batcher
+
+
+def _tiny_shape_set():
+    # graph_cap 4 so shape-full is easy to hit; node/edge caps generous
+    return ShapeSet([BatchShape(4, 64, 512), BatchShape(8, 128, 1024)])
+
+
+class TestMicroBatcher:
+    def test_flush_on_shape_full(self, graphs):
+        clk = [0.0]
+        b = MicroBatcher(_tiny_shape_set(), max_queue=64, max_wait_ms=1000.0,
+                         clock=lambda: clk[0])
+        for g in graphs[:8]:
+            b.offer(_request(g))
+        flush = b.poll(now=0.0)  # way before the deadline
+        assert flush is not None and flush.reason == "shape_full"
+        assert len(flush.requests) == 8  # fits the LARGEST rung (cap 8)
+        assert flush.shape.graph_cap == 8
+        assert b.depth == 0
+
+    def test_flush_on_deadline(self, graphs):
+        b = MicroBatcher(_tiny_shape_set(), max_queue=64, max_wait_ms=50.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(graphs[0], now=0.0))
+        assert b.poll(now=0.0) is None  # neither full nor waited
+        assert b.poll(now=0.049) is None
+        flush = b.poll(now=0.051)
+        assert flush is not None and flush.reason == "deadline"
+        assert len(flush.requests) == 1
+        assert flush.shape is not None  # smallest rung
+        assert flush.shape.graph_cap == 4
+
+    def test_oversize_rejected(self, graphs):
+        ss = ShapeSet([BatchShape(4, 8, 16)])  # nothing real fits
+        b = MicroBatcher(ss)
+        big = max(graphs, key=lambda g: g.num_nodes)
+        with pytest.raises(ServeRejection) as e:
+            b.offer(_request(big))
+        assert e.value.reason == OVERSIZE
+        assert "largest compiled shape" in str(e.value)
+        assert b.depth == 0
+
+    def test_backpressure_queue_full(self, graphs):
+        b = MicroBatcher(_tiny_shape_set(), max_queue=4, max_wait_ms=1000.0)
+        for g in graphs[:4]:
+            b.offer(_request(g, now=time.monotonic()))
+        with pytest.raises(ServeRejection) as e:
+            b.offer(_request(graphs[4], now=time.monotonic()))
+        assert e.value.reason == QUEUE_FULL
+
+    def test_timeout_expiry_delivered(self, graphs):
+        b = MicroBatcher(_tiny_shape_set(), max_queue=64, max_wait_ms=50.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(graphs[0], now=0.0, deadline=0.01))
+        b.offer(_request(graphs[1], now=0.0, deadline=99.0))
+        flush = b.poll(now=0.06)  # past the head's deadline AND max_wait
+        assert flush.reason == "deadline"
+        assert [r.graph for r in flush.requests] == [graphs[1]]
+        assert [r.graph for r in flush.expired] == [graphs[0]]
+
+    def test_expiry_alone_flushes_without_batch(self, graphs):
+        b = MicroBatcher(_tiny_shape_set(), max_queue=64, max_wait_ms=500.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(graphs[0], now=0.0, deadline=0.01))
+        flush = b.poll(now=0.02)  # expired, but max_wait not reached
+        assert flush is not None
+        assert not flush.requests and len(flush.expired) == 1
+
+    def test_drain_rejects_new_flushes_old(self, graphs):
+        b = MicroBatcher(_tiny_shape_set(), max_queue=64, max_wait_ms=1000.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(graphs[0], now=0.0))
+        b.close()
+        with pytest.raises(ServeRejection) as e:
+            b.offer(_request(graphs[1], now=0.0))
+        assert e.value.reason == SHUTDOWN
+        flush = b.poll(now=0.0)
+        assert flush.reason == "drain" and len(flush.requests) == 1
+        assert b.next_flush() is None  # closed + empty -> worker exits
+
+
+# ----------------------------------------------------------------- cache
+
+
+class TestResultCache:
+    def test_lru_eviction_and_hits(self):
+        c = ResultCache(capacity=2)
+        c.put("a", 1)
+        c.put("b", 2)
+        assert c.get("a") == 1  # refreshes 'a'
+        c.put("c", 3)  # evicts 'b' (least recent)
+        assert c.get("b") is None
+        assert c.get("a") == 1 and c.get("c") == 3
+        s = c.stats()
+        assert s["hits"] == 3 and s["misses"] == 1
+
+    def test_fingerprint_content_keyed(self, graphs):
+        a, b = graphs[0], graphs[1]
+        assert structure_fingerprint(a) == structure_fingerprint(a)
+        assert structure_fingerprint(a) != structure_fingerprint(b)
+
+
+# ---------------------------------------------------------------- server
+
+
+def _make_server(model_state, shape_set, **kw):
+    _, state = model_state
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("log_fn", lambda *a, **k: None)
+    return InferenceServer(state, shape_set, **kw)
+
+
+class TestInferenceServer:
+    def test_end_to_end_matches_offline(self, graphs, shape_set,
+                                        model_state):
+        _, state = model_state
+        server = _make_server(model_state, shape_set, cache_size=0)
+        server.warm(graphs[0])
+        server.start()
+        futs = [server.submit(g) for g in graphs[:20]]
+        got = np.stack([f.result(timeout=30.0).prediction for f in futs])
+        # offline reference: one singleton batch per graph (eval is
+        # batch-composition independent up to float assoc; loose tol)
+        pstep = jax.jit(make_predict_step())
+        want = np.stack([
+            np.asarray(pstep(state, shape_set.pack([g])))[0]
+            for g in graphs[:20]
+        ])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        assert server.drain(timeout_s=30.0)
+        stats = server.stats()
+        assert stats["counts"]["responses"] == 20
+        assert stats["recompiles_after_warm"] == 0
+
+    def test_cache_hit_serves_same_row(self, graphs, shape_set,
+                                       model_state):
+        server = _make_server(model_state, shape_set, cache_size=16)
+        server.warm(graphs[0])
+        server.start()
+        first = server.predict(graphs[3], timeout_ms=30000)
+        second = server.predict(graphs[3], timeout_ms=30000)
+        assert not first.cached and second.cached
+        np.testing.assert_array_equal(first.prediction, second.prediction)
+        assert second.param_version == first.param_version
+        assert server.drain(timeout_s=30.0)
+        assert server.counts["cache_hits"] == 1
+
+    def test_serving_telemetry_flows(self, graphs, shape_set, model_state,
+                                     tmp_path):
+        telemetry = Telemetry(level="epoch", log_dir=str(tmp_path),
+                              use_clu=False)
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              telemetry=telemetry)
+        server.warm(graphs[0])
+        server.start()
+        for g in graphs[:6]:
+            server.predict(g, timeout_ms=30000)
+        assert server.drain(timeout_s=30.0)
+        q = telemetry.series_quantiles("serve_latency_ms")
+        assert q and q["count"] >= 1 and q["p99"] >= q["p50"] > 0
+        counters = telemetry.counters()
+        assert counters["serve_responses"] == 6
+        assert counters["serve_requests"] == 6
+        # warmup dispatches must not count as served work
+        assert counters.get("serve_warm", 0) == 0
+        telemetry.close()
+        from cgnn_tpu.observe import read_jsonl
+
+        recs = read_jsonl(str(tmp_path / "metrics.jsonl"))
+        summary = [r for r in recs if r.get("event") == "run_summary"]
+        assert len(summary) == 1
+        assert "serve_latency_ms_p99" in summary[0]["gauges"]
+
+    def test_sigterm_drain_zero_drops(self, graphs, shape_set, model_state):
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              max_wait_ms=200.0, default_timeout_ms=None)
+        server.warm(graphs[0])
+        server.start()
+        # queue a burst, then latch the preemption signal mid-queue: the
+        # resilience callback path must kick the drain without polling
+        futs = [server.submit(g) for g in graphs[:12]]
+        handler = PreemptionHandler(log_fn=lambda *a: None)
+        handler.add_callback(server.begin_drain)
+        handler.request()  # the signal handler path, minus the signal
+        with pytest.raises(ServeRejection) as e:
+            server.submit(graphs[0])
+        assert e.value.reason == SHUTDOWN
+        assert server.drain(timeout_s=30.0)
+        # zero drops: every accepted request got a real answer
+        preds = [f.result(timeout=1.0) for f in futs]
+        assert all(p.prediction.shape == preds[0].prediction.shape
+                   for p in preds)
+        assert server.counts["responses"] == 12
+
+    def test_malformed_structure_rejected_at_admission(self, graphs,
+                                                       shape_set,
+                                                       model_state):
+        """A request with the wrong feature width or out-of-range
+        connectivity must fail ALONE (400) at admission — packed, it
+        would fail every co-batched request or trace a fresh shape."""
+        import dataclasses
+
+        server = _make_server(model_state, shape_set, cache_size=0)
+        server.warm(graphs[0])
+        g = graphs[0]
+        bad_width = dataclasses.replace(
+            g, atom_fea=np.zeros((g.num_nodes, g.atom_fea.shape[1] + 3),
+                                 np.float32))
+        with pytest.raises(ServeRejection) as e:
+            server.submit(bad_width)
+        assert e.value.reason == MALFORMED and "atom_fea" in str(e.value)
+        bad_index = dataclasses.replace(
+            g, centers=np.full_like(g.centers, g.num_nodes + 7))
+        with pytest.raises(ServeRejection) as e:
+            server.submit(bad_index)
+        assert e.value.reason == MALFORMED and "centers" in str(e.value)
+        assert server.counts["reject_malformed"] == 2
+        assert server.batcher.depth == 0  # nothing poisoned the queue
+
+    def test_worker_timeout_rejection(self, graphs, shape_set, model_state):
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              max_wait_ms=30.0)
+        server.warm(graphs[0])
+        # no worker running: the request's deadline passes while queued
+        fut = server.submit(graphs[0], timeout_ms=1.0)
+        time.sleep(0.05)
+        flush = server.batcher.poll()
+        assert flush is not None and flush.expired
+        server._process(flush)
+        with pytest.raises(ServeRejection) as e:
+            fut.result(timeout=1.0)
+        assert e.value.reason == TIMEOUT
+        assert server.counts["reject_timeout"] == 1
+
+
+# ------------------------------------------------------------ hot reload
+
+
+def _save_state(mgr, state, model_cfg, nudge=0.0):
+    params = state.params
+    if nudge:
+        params = jax.tree_util.tree_map(
+            lambda x: (np.asarray(x) + nudge).astype(np.asarray(x).dtype)
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+            params,
+        )
+    mgr.save(state.replace(params=params),
+             {"model": model_cfg.to_meta(),
+              "data": DataConfig(radius=5.0, max_num_nbr=8).to_meta(),
+              "task": "regression", "epoch": 0})
+    mgr.wait()
+
+
+class TestHotReload:
+    def test_swap_mid_batch_is_atomic(self, graphs, shape_set, model_state,
+                                      tmp_path):
+        model_cfg, state = model_state
+        mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                                log_fn=lambda m: None)
+        _save_state(mgr, state, model_cfg)
+        v1 = mgr.newest_committed()
+        _, boot = model_state
+        real = jax.jit(make_predict_step())
+        swap_during_call = {"armed": False, "watcher": None}
+
+        def spy_predict(s, batch):
+            if swap_during_call["armed"]:
+                swap_during_call["armed"] = False
+                # the reload lands while this batch is IN FLIGHT
+                assert swap_during_call["watcher"].poll_once()
+            return real(s, batch)
+
+        server = InferenceServer(
+            boot, shape_set, predict_step=spy_predict, version=v1,
+            cache_size=16, max_wait_ms=5.0, log_fn=lambda *a: None,
+        )
+        watcher = server.attach_watcher(mgr, poll_interval_s=3600)
+        swap_during_call["watcher"] = watcher
+
+        # commit v2 with different params, then serve one request with
+        # the swap firing mid-predict
+        _save_state(mgr, state, model_cfg, nudge=0.25)
+        v2 = mgr.newest_committed()
+        assert v2 != v1
+        server.start()
+        swap_during_call["armed"] = True
+        r_old = server.predict(graphs[0], timeout_ms=30000)
+        # in-flight batch finished on the OLD params
+        assert r_old.param_version == v1
+        # cache was cleared by the swap: the same structure re-serves
+        # fresh on the new params, and the numbers actually moved
+        r_new = server.predict(graphs[0], timeout_ms=30000)
+        assert not r_new.cached
+        assert r_new.param_version == v2
+        assert not np.allclose(r_old.prediction, r_new.prediction)
+        assert server.drain(timeout_s=30.0)
+        mgr.close()
+
+    def test_integrity_failed_checkpoint_skipped(self, graphs, shape_set,
+                                                 model_state, tmp_path):
+        model_cfg, state = model_state
+        logs: list[str] = []
+        mgr = CheckpointManager(str(tmp_path / "ckpt2"),
+                                log_fn=logs.append)
+        _save_state(mgr, state, model_cfg)
+        v1 = mgr.newest_committed()
+        from cgnn_tpu.serve.reload import ParamStore
+
+        store = ParamStore(state, v1)
+        watcher = CheckpointWatcher(mgr, store, state,
+                                    log_fn=logs.append)
+        # commit v2, then corrupt its payload (crc catches it)
+        _save_state(mgr, state, model_cfg, nudge=0.5)
+        v2 = mgr.newest_committed()
+        faultinject.corrupt_checkpoint(str(tmp_path / "ckpt2" / v2),
+                                       mode="garble")
+        assert not watcher.poll_once()
+        assert watcher.skips == 1 and store.version == v1
+        assert any("SKIPPING" in m and v2 in m for m in logs)
+        # the bad save is remembered, not retried in a loop
+        assert not watcher.poll_once()
+        assert watcher.skips == 1
+        # a full restore through the chain falls back PAST the corrupt
+        # v2 — and reports what it actually loaded (the serving version
+        # label must be the restored save, not newest_committed)
+        mgr.restore_for_inference(state, "latest")
+        assert mgr.last_restored == v1
+        # the next GOOD save supersedes it
+        _save_state(mgr, state, model_cfg, nudge=1.0)
+        assert watcher.poll_once()
+        assert store.version == mgr.newest_committed() != v2
+        mgr.close()
+
+    def test_watcher_noop_without_new_save(self, model_state, tmp_path):
+        model_cfg, state = model_state
+        mgr = CheckpointManager(str(tmp_path / "ckpt3"),
+                                log_fn=lambda m: None)
+        _save_state(mgr, state, model_cfg)
+        from cgnn_tpu.serve.reload import ParamStore
+
+        store = ParamStore(state, mgr.newest_committed())
+        watcher = CheckpointWatcher(mgr, store, state,
+                                    log_fn=lambda m: None)
+        assert not watcher.poll_once()
+        assert watcher.swaps == 0
+        mgr.close()
+
+
+# ----------------------------------------------------- concurrent load
+
+
+def test_concurrent_load_zero_drops(graphs, shape_set, model_state):
+    """64 concurrent in-process clients, every request answered (the
+    acceptance-criteria concurrency floor; ~2 s on CPU)."""
+    server = _make_server(model_state, shape_set, cache_size=0,
+                          max_queue=4096, default_timeout_ms=60000.0)
+    server.warm(graphs[0])
+    server.start()
+    answered = []
+    lock = threading.Lock()
+
+    def client(ci):
+        rng = np.random.default_rng(ci)
+        for _ in range(5):
+            g = graphs[int(rng.integers(len(graphs)))]
+            r = server.predict(g, timeout_ms=60000)
+            with lock:
+                answered.append(r)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert server.drain(timeout_s=60.0)
+    assert len(answered) == 64 * 5
+    assert server.stats()["recompiles_after_warm"] == 0
