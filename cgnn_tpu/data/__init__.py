@@ -23,6 +23,7 @@ from cgnn_tpu.data.cache import (
     featurize_directory_parallel,
 )
 from cgnn_tpu.data.loader import prefetch_to_device
+from cgnn_tpu.data.pipeline import BufferPool, PackError, parallel_pack
 
 __all__ = [
     "Structure",
@@ -45,4 +46,7 @@ __all__ = [
     "load_graph_cache",
     "featurize_directory_parallel",
     "prefetch_to_device",
+    "BufferPool",
+    "PackError",
+    "parallel_pack",
 ]

@@ -33,6 +33,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 from flax import struct
 
+from cgnn_tpu.data.featurize import gaussian_expand
 from cgnn_tpu.data.graph import GraphBatch, transpose_slots
 
 
@@ -124,6 +125,62 @@ class CompactSpec:
     dense_m: int
     edge_dtype: Any = np.float32
 
+    def __post_init__(self):
+        # identity token for per-graph probe verdicts: a verdict cached
+        # under spec A must never be read by spec B (different
+        # checkpoint/vocabulary in the same process). The token object
+        # is retained by every cache entry that references it, so its
+        # identity can never be recycled into a false match.
+        object.__setattr__(self, "_probe_token", object())
+
+    def graph_compactable(self, g, atol: float = 1e-5,
+                          sample_edges: int = 32) -> bool:
+        """Can THIS graph be staged compactly under this spec?
+
+        The dataset-level ``build`` probe validates a sample; serving
+        admits arbitrary per-request graphs, so each one is checked
+        individually (and the verdict cached on the graph, keyed to this
+        spec): raw distances present and consistent, atom rows inside
+        the vocabulary, and the stored edge features equal to the
+        Gaussian expansion of the distances — so a client-supplied graph
+        whose ``edge_fea`` disagrees with its ``distances`` is staged
+        full-fidelity instead of silently answered from different edges.
+        The feature check verifies an evenly spaced sample of
+        ``sample_edges`` edges: featurization mismatches (wrong
+        radius/step, different featurizer) are global and any sample
+        catches them, while a full O(E x G) expansion per request would
+        tax the submit path with a meaningful fraction of the very cost
+        compact staging removes. Never raises.
+        """
+        cached = getattr(g, "_compact_ok", None)
+        if cached is not None and cached[0] is self._probe_token:
+            return cached[1]
+        ok = False
+        try:
+            if (
+                g.distances is not None
+                and len(g.distances) == g.num_edges
+                and np.ndim(g.edge_fea) == 2
+                and g.edge_fea.shape[1] == len(self.gauss_filter)
+            ):
+                self.vocab.indices(g)  # raises CompactUnsupported if not
+                d = np.asarray(g.distances, np.float32)
+                step = max(1, len(d) // sample_edges)
+                idx = np.arange(0, len(d), step)[:sample_edges]
+                want = gaussian_expand(d[idx], self.gauss_filter,
+                                       self.gauss_var)
+                ok = np.allclose(
+                    np.asarray(g.edge_fea, np.float32)[idx], want,
+                    atol=atol,
+                )
+        except (CompactUnsupported, ValueError, TypeError):
+            ok = False
+        try:
+            g._compact_ok = (self._probe_token, ok)
+        except AttributeError:  # frozen/slotted graph: just skip the cache
+            pass
+        return ok
+
     @classmethod
     def build(cls, graphs: Sequence, gdf, dense_m: int,
               edge_dtype=np.float32, validate_k: int = 8) -> "CompactSpec":
@@ -206,6 +263,47 @@ def compact_shape_key(batch: CompactBatch) -> tuple:
     )
 
 
+def compact_buffer_key(node_cap: int, dense_m: int, graph_cap: int,
+                       tdim: int) -> tuple:
+    """Pool key for reusable compact staging buffers (data/pipeline.py
+    ``BufferPool``): one free-list per distinct buffer geometry."""
+    return ("compact", node_cap, dense_m, graph_cap, tdim)
+
+
+def alloc_compact_buffers(node_cap: int, dense_m: int, graph_cap: int,
+                          tdim: int) -> CompactBatch:
+    """Freshly allocate one forward-only (no transpose slots) compact
+    staging buffer set — the ``BufferPool`` factory for
+    ``pack_compact(out=...)``."""
+    return CompactBatch(
+        atom_idx=np.zeros(node_cap, np.int32),
+        distances=np.zeros((node_cap, dense_m), np.float32),
+        neighbors=np.zeros(node_cap * dense_m, np.int32),
+        edge_mask=np.zeros((node_cap, dense_m), np.uint8),
+        node_graph=np.zeros(node_cap, np.int32),
+        node_mask=np.zeros(node_cap, np.uint8),
+        graph_mask=np.zeros(graph_cap, np.float32),
+        targets=np.zeros((graph_cap, tdim), np.float32),
+        target_mask=np.zeros((graph_cap, tdim), np.float32),
+    )
+
+
+# base dense neighbor pattern (slot k -> its owning node k // M), cached
+# per shape: recomputing it per batch is an avoidable fresh allocation on
+# the packer's critical path
+_BASE_NEIGHBORS: dict[tuple[int, int], np.ndarray] = {}
+
+
+def _base_neighbors(node_cap: int, dense_m: int) -> np.ndarray:
+    base = _BASE_NEIGHBORS.get((node_cap, dense_m))
+    if base is None:
+        base = (np.arange(node_cap * dense_m, dtype=np.int32)
+                // dense_m).astype(np.int32)
+        base.setflags(write=False)
+        _BASE_NEIGHBORS[(node_cap, dense_m)] = base
+    return base
+
+
 def pack_compact(
     graphs: Sequence,
     node_cap: int,
@@ -217,11 +315,20 @@ def pack_compact(
     in_cap: int | None = None,
     over_cap: int | None = None,
     edge_dtype=None,  # accepted for pack_fn signature parity; spec wins
+    out: CompactBatch | None = None,
 ) -> CompactBatch:
     """pack_graphs' compact twin: same slot geometry, raw-form payload.
 
     Raises the same ``TransposeOverflowError`` on two-tier overflow so
     ``_pack_overflow_safe``'s split-don't-abort recovery applies unchanged.
+
+    ``out`` (forward-only batches) recycles a previously allocated buffer
+    set (``alloc_compact_buffers``) instead of allocating fresh arrays:
+    PERF.md §7 measured fresh zeros page-faulting at ~0.2 GB/s effective,
+    so reuse turns the pack's output writes into stores to already-mapped
+    pages. The returned batch ALIASES ``out``'s arrays — hand the buffer
+    back to its pool only after the device has consumed the dispatch that
+    read it. Bit-identical to a fresh pack (pinned by test).
     """
     dense_m = dense_m if dense_m is not None else spec.dense_m
     if dense_m is None:
@@ -233,6 +340,9 @@ def pack_compact(
         )
     if not graphs:
         raise ValueError("cannot pack an empty graph list")
+    if out is not None and (in_cap or over_cap is not None):
+        raise ValueError("buffer reuse (out=) is forward-only: transpose "
+                         "slots are not pooled")
     n_graphs = len(graphs)
     if n_graphs > graph_cap:
         raise ValueError(f"{n_graphs} graphs exceed graph_cap={graph_cap}")
@@ -248,14 +358,32 @@ def pack_compact(
         )
     tdim = num_targets or int(np.atleast_1d(graphs[0].target).shape[0])
 
-    atom_idx = np.zeros(node_cap, np.int32)
+    if out is not None:
+        want = (node_cap, dense_m, graph_cap, tdim)
+        got = (out.atom_idx.shape[0], out.distances.shape[1],
+               out.targets.shape[0], out.targets.shape[1])
+        if want != got:
+            raise ValueError(
+                f"out buffer geometry {got} does not match the requested "
+                f"pack {want} (pool keyed by compact_buffer_key?)"
+            )
+        atom_idx, node_graph, node_mask = (
+            out.atom_idx, out.node_graph, out.node_mask
+        )
+        # only the padding tail needs zeroing: [:total_nodes] is fully
+        # overwritten below (bit-parity with the fresh-zeros path)
+        atom_idx[total_nodes:] = 0
+        node_graph[total_nodes:] = 0
+        node_mask[total_nodes:] = 0
+    else:
+        atom_idx = np.zeros(node_cap, np.int32)
+        node_graph = np.zeros(node_cap, np.int32)
+        node_mask = np.zeros(node_cap, np.uint8)
     np.concatenate([spec.vocab.indices(g) for g in graphs],
                    out=atom_idx[:total_nodes])
-    node_graph = np.zeros(node_cap, np.int32)
     node_graph[:total_nodes] = np.repeat(
         np.arange(n_graphs, dtype=np.int32), nn_arr
     )
-    node_mask = np.zeros(node_cap, np.uint8)
     node_mask[:total_nodes] = 1
 
     e_node_off = np.repeat(node_offs[:-1], ne_arr)
@@ -284,17 +412,35 @@ def pack_compact(
     grid_valid = np.arange(dense_m) < counts[:, None]
     np.copyto(src, total_edges, where=~grid_valid)
     dist_pad = np.concatenate([dist, np.zeros(1, np.float32)])
-    distances = np.take(dist_pad, src, mode="clip")  # [node_cap, M]
-    edge_mask = grid_valid.astype(np.uint8)
-    neighbors = (np.arange(edge_cap, dtype=np.int32) // dense_m).astype(
-        np.int32
-    )
+    if out is not None:
+        distances, edge_mask, neighbors = (
+            out.distances, out.edge_mask, out.neighbors
+        )
+        # every slot of all three is overwritten: take covers the full
+        # [node_cap, M] grid (padding slots read the appended 0), the
+        # mask copies the full grid, neighbors resets to the base
+        # pattern before the real-edge scatter
+        np.take(dist_pad, src, mode="clip", out=distances)
+        np.copyto(edge_mask, grid_valid, casting="unsafe")
+        np.copyto(neighbors, _base_neighbors(node_cap, dense_m))
+    else:
+        distances = np.take(dist_pad, src, mode="clip")  # [node_cap, M]
+        edge_mask = grid_valid.astype(np.uint8)
+        neighbors = _base_neighbors(node_cap, dense_m).copy()
     neighbors[slots] = gnbr.astype(np.int32)
 
-    graph_mask = np.zeros(graph_cap, np.float32)
+    if out is not None:
+        graph_mask, targets, target_mask = (
+            out.graph_mask, out.targets, out.target_mask
+        )
+        graph_mask[n_graphs:] = 0.0
+        targets.fill(0.0)  # ragged target widths: no full overwrite below
+        target_mask.fill(0.0)
+    else:
+        graph_mask = np.zeros(graph_cap, np.float32)
+        targets = np.zeros((graph_cap, tdim), np.float32)
+        target_mask = np.zeros((graph_cap, tdim), np.float32)
     graph_mask[:n_graphs] = 1.0
-    targets = np.zeros((graph_cap, tdim), np.float32)
-    target_mask = np.zeros((graph_cap, tdim), np.float32)
     tgt = [np.atleast_1d(np.asarray(g.target, np.float32)) for g in graphs]
     if all(len(t) == len(tgt[0]) for t in tgt):
         tw = len(tgt[0])

@@ -29,7 +29,16 @@ class GaussianDistance:
 
     def expand(self, distances: np.ndarray) -> np.ndarray:
         """[...] distances -> [..., K] expanded features (float32)."""
-        d = np.asarray(distances, dtype=np.float32)
-        return np.exp(
-            -((d[..., None] - self.filter) ** 2) / self.var**2
-        ).astype(np.float32)
+        return gaussian_expand(distances, self.filter, self.var)
+
+
+def gaussian_expand(distances, filter: np.ndarray, var: float) -> np.ndarray:
+    """The one radial-basis formula (numpy form): shared by
+    ``GaussianDistance.expand`` and the compact-staging per-graph probe
+    (data/compact.py), so a change here cannot desynchronize them. The
+    jit-side twin lives in ``compact.make_expander`` (jnp)."""
+    d = np.asarray(distances, dtype=np.float32)
+    return np.exp(
+        -((d[..., None] - np.asarray(filter, np.float32)) ** 2)
+        / np.float32(var) ** 2
+    ).astype(np.float32)

@@ -941,6 +941,48 @@ def bucketed_batch_iterator(
             active.remove(pick)
 
 
+def plan_batches(
+    graphs: Sequence[CrystalGraph],
+    batch_size: int,
+    node_cap: int,
+    edge_cap: int,
+    snug: bool = False,
+):
+    """Yield ``(start, end)`` index spans over ``graphs`` matching
+    ``batch_iterator``'s greedy close condition EXACTLY (no shuffle),
+    without packing anything.
+
+    This is the planning half of the parallel ingest pipeline
+    (data/pipeline.py): the plan is computed once on the consumer,
+    cheap and deterministic, and the spans are handed to a pool of
+    packer workers — input order is preserved by construction, so the
+    reassembled batches map back to the input the same way the serial
+    ``batch_iterator`` loop's would. Oversize graphs raise the same
+    error ``batch_iterator`` raises (a plan that silently diverged from
+    the packer would break span bookkeeping downstream).
+    """
+    graph_cap = graph_cap_for(batch_size) if snug else batch_size
+    start, nn, ne = 0, 0, 0
+    for i, g in enumerate(graphs):
+        if g.num_nodes > node_cap or g.num_edges > edge_cap:
+            raise ValueError(
+                f"graph {g.cif_id!r} ({g.num_nodes} nodes, {g.num_edges} "
+                f"edges) exceeds batch capacity ({node_cap}, {edge_cap}); "
+                f"increase caps or filter the dataset"
+            )
+        if i > start and (
+            i - start == graph_cap
+            or nn + g.num_nodes > node_cap
+            or ne + g.num_edges > edge_cap
+        ):
+            yield start, i
+            start, nn, ne = i, 0, 0
+        nn += g.num_nodes
+        ne += g.num_edges
+    if start < len(graphs):
+        yield start, len(graphs)
+
+
 def count_batches(
     graphs: Sequence[CrystalGraph],
     batch_size: int,

@@ -89,3 +89,30 @@ def padding_gauges(stats) -> list[dict]:
         "shapes": len(stats.shapes),
     })
     return out
+
+
+def pipeline_gauges(counters: dict, gauges: dict) -> dict:
+    """Derived health figures for the parallel ingest pipeline
+    (data/pipeline.py), from a run's counters/gauges — the
+    ``loader_wait_s`` analog for the forward path.
+
+    - ``pipeline_wait_share``: consumer wait over (wait + pack) — near 0
+      means the packers kept the dispatch loop fed; near 1 means the
+      device idled on the host (add workers / enable compact staging);
+    - ``pipeline_pack_s_per_job``: mean worker seconds per packed batch.
+
+    The raw series (``pipeline_wait_s`` p50/p95/p99 via
+    ``Telemetry.observe_value``) and the ``pipeline_occupancy`` gauge the
+    pipeline sets directly complement these rollups.
+    """
+    wait = float(counters.get("pipeline_wait_s", 0.0))
+    pack = float(counters.get("pipeline_pack_s", 0.0))
+    jobs = float(counters.get("pipeline_jobs", 0.0))
+    out = {}
+    if wait + pack > 0:
+        out["pipeline_wait_share"] = wait / (wait + pack)
+    if jobs > 0:
+        out["pipeline_pack_s_per_job"] = pack / jobs
+    if "pipeline_occupancy" in gauges:
+        out["pipeline_occupancy"] = float(gauges["pipeline_occupancy"])
+    return out

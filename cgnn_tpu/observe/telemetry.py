@@ -22,7 +22,11 @@ import os
 import threading
 from typing import Callable, Iterator
 
-from cgnn_tpu.observe.gauges import hbm_gauges, padding_gauges
+from cgnn_tpu.observe.gauges import (
+    hbm_gauges,
+    padding_gauges,
+    pipeline_gauges,
+)
 from cgnn_tpu.observe.metrics_io import MetricsLogger
 from cgnn_tpu.observe.spans import SpanTracer
 from cgnn_tpu.observe.stream import StepStream
@@ -229,6 +233,7 @@ class Telemetry:
         per_step = counters.get("per_step_steps", 0.0)
         if scan + per_step > 0:
             gauges["scan_dispatch_share"] = scan / (scan + per_step)
+        gauges.update(pipeline_gauges(counters, gauges))
         if counters or gauges:
             self.logger.event("run_summary", {
                 "counters": counters, "gauges": gauges,

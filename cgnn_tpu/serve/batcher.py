@@ -98,6 +98,12 @@ class Request:
     # slot budget under the shape set's layout, computed once at admission
     nodes: int = 0
     edges: int = 0
+    # can this graph stage compactly (raw distances present + consistent,
+    # atom rows in the vocabulary)? Decided ONCE at admission — a flush
+    # whose requests are all compactable packs the raw CompactBatch form;
+    # any non-compactable member demotes its flush to full-fidelity
+    # packing (both programs are warmed, so neither path ever recompiles)
+    compactable: bool = False
 
 
 @dataclasses.dataclass

@@ -11,13 +11,19 @@ Request lifecycle::
 
     submit(graph)
       -> cache hit?  resolve immediately (no queue)
-      -> batcher.offer (admission: oversize / queue-full / draining)
-    worker: batcher.next_flush()
-      -> expired requests fail with TIMEOUT
-      -> pack into the flush's precompiled shape (shapes.py)
-      -> (state, version) = param_store.get()   # hot-swap boundary
-      -> predict_step(state, batch) -> device_get
-      -> resolve each future with (row, version, latency)
+      -> batcher.offer (admission: oversize / queue-full / draining;
+         compact-stageability decided here, per request)
+    worker (pack_workers > 0 — the default on accelerators):
+      feeder: batcher.next_flush() -> expired fail with TIMEOUT
+        -> packer pool (data/pipeline.py): pack into the flush's
+           precompiled shape — compact raw form when every member can,
+           warmed full-fidelity otherwise — into pooled buffers
+      dispatch: for each packed flush, in order:
+        -> (state, version) = param_store.get()   # hot-swap boundary
+        -> predict_step(state, batch) -> device_get
+        -> resolve each future with (row, version, latency)
+      (so the batcher coalesces flush N+2 while N+1 packs and N runs;
+       pack_workers=0 runs the same stages in-line on one thread)
 
 Hot reload safety rides on the ``param_store.get()`` placement: the pair
 is read once per batch, so a watcher swap lands cleanly between batches
@@ -89,6 +95,7 @@ class InferenceServer:
         max_wait_ms: float = 5.0,
         default_timeout_ms: float | None = 1000.0,
         cache_size: int = 1024,
+        pack_workers: int = 1,
         clock: Callable[[], float] = time.monotonic,
         log_fn: Callable = print,
     ):
@@ -99,7 +106,18 @@ class InferenceServer:
 
         self.shape_set = shape_set
         self.param_store = ParamStore(state, version)
-        self.predict_step = predict_step or jax.jit(make_predict_step())
+        # a compact shape set rebuilds GraphBatches INSIDE the compiled
+        # program (expander); the same jitted callable still accepts
+        # full-fidelity batches — the fallback for non-compactable
+        # requests (both forms are warmed, so neither ever recompiles)
+        self.predict_step = predict_step or jax.jit(
+            make_predict_step(shape_set.expander())
+        )
+        # pack pipeline threads between the batcher and the dispatch
+        # loop (data/pipeline.py): packing comes off the flush/dispatch
+        # thread so the batcher coalesces the NEXT flush while the
+        # current one packs and runs; 0 restores the in-line pack
+        self._pack_workers = max(0, int(pack_workers))
         self.telemetry = telemetry or Telemetry.disabled()
         self.batcher = MicroBatcher(
             shape_set, max_queue=max_queue, max_wait_ms=max_wait_ms,
@@ -140,21 +158,32 @@ class InferenceServer:
 
         ``template`` is any admissible structure (it provides feature
         dimensionality); each rung is packed with one copy and executed
-        once. Dispatches run under ``telemetry.warmup()`` so compile
-        executions never pollute serving counters."""
+        once. A compact set warms BOTH staging forms per rung — the
+        compact fast path and the full-fidelity fallback a flush holding
+        a non-compactable request takes — so the post-warmup compile
+        count is pinned no matter how traffic mixes. Dispatches run
+        under ``telemetry.warmup()`` so compile executions never pollute
+        serving counters."""
         state, _ = self.param_store.get()
         self._feature_dims = (template.atom_fea.shape[1],
                               template.edge_fea.shape[1])
         n0 = self._jit_cache_size()
+        programs = 0
         with self.telemetry.warmup():
             for shape in self.shape_set:
                 batch = self.shape_set.pack([template], shape=shape)
                 np.asarray(self.predict_step(state, batch))
+                programs += 1
+                if self.shape_set.compact is not None:
+                    full = self.shape_set.pack_full([template], shape=shape)
+                    np.asarray(self.predict_step(state, full))
+                    programs += 1
         self.warmed = True
         compiled = (self._jit_cache_size() or 0) - (n0 or 0)
         self._log(
-            f"serve: warmed {len(self.shape_set)} shapes "
-            f"({compiled} fresh compiles)"
+            f"serve: warmed {len(self.shape_set)} shapes / {programs} "
+            f"programs ({compiled} fresh compiles"
+            f"{', compact-staged' if self.shape_set.compact else ''})"
         )
         return compiled
 
@@ -310,6 +339,9 @@ class InferenceServer:
             enqueued=now,
             deadline=None if timeout is None else now + timeout,
             fingerprint=fp,
+            # decided once here: a flush packs compact only when EVERY
+            # member can (batcher.Request docstring)
+            compactable=self.shape_set.compactable(graph),
         )
         try:
             self.batcher.offer(req)
@@ -331,6 +363,8 @@ class InferenceServer:
     # ---- the worker ----
 
     def _serve_loop(self) -> None:
+        if self._pack_workers > 0:
+            return self._serve_loop_pipelined()
         while True:
             flush = self.batcher.next_flush()
             if flush is None:
@@ -343,9 +377,80 @@ class InferenceServer:
                     if not r.future.done():
                         r.future.set_error(e)
 
-    def _process(self, flush: Flush) -> None:
-        import jax
+    def _serve_loop_pipelined(self) -> None:
+        """The pack-overlapped worker: batcher -> packer pool -> dispatch.
 
+        ``parallel_pack`` (data/pipeline.py) runs the flush stream
+        through ``_pack_workers`` packer threads with order-restoring
+        reassembly, so while THIS thread dispatches flush N and blocks
+        on its fetch, flush N+1 is already packing and the batcher is
+        coalescing N+2 — packing leaves the dispatch critical path.
+        Order preservation keeps response FIFO fairness. Pack errors are
+        delivered per flush (the poisoned flush fails alone; admission
+        validation makes them unlikely). Pooled staging buffers recycle
+        after the flush's blocking fetch — the device is done with them.
+        """
+        from cgnn_tpu.data.pipeline import BufferPool, parallel_pack
+
+        pool = BufferPool()
+
+        def flushes():
+            while True:
+                flush = self.batcher.next_flush()
+                if flush is None:
+                    return
+                # expiries are delivered HERE, before the pack stage, so
+                # a timed-out client hears promptly instead of queueing
+                # behind the pipeline's in-flight flushes
+                self._fail_expired(flush)
+                if flush.requests:
+                    yield flush
+
+        def pack_one(flush: Flush):
+            t0 = time.perf_counter()
+            try:
+                batch, buf = self._pack_flush(flush, pool)
+                err = None
+            except Exception as e:  # noqa: BLE001 — fail the flush, not the stream
+                batch = buf = None
+                err = e
+            self.telemetry.observe_value("serve_pack_s",
+                                         time.perf_counter() - t0)
+            return flush, batch, buf, err
+
+        stream = iter(parallel_pack(
+            flushes(), pack_one, workers=self._pack_workers,
+            telemetry=self.telemetry, raise_on_error=False,
+            name="cgnn-serve-pack",
+        ))
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(stream)
+            except StopIteration:
+                return
+            except Exception as e:  # noqa: BLE001 — flush-stream error: keep serving
+                self._log(f"serve: pack pipeline error: {e!r}")
+                continue
+            # dispatch-side stall waiting on the packers (the ingest
+            # starvation signal; run_summary p50/p95/p99 via series)
+            self.telemetry.observe_value("pipeline_wait_s",
+                                         time.perf_counter() - t0)
+            flush, batch, buf, err = item
+            try:
+                if err is not None:
+                    raise err
+                self._dispatch_flush(flush, batch)
+            except Exception as e:  # noqa: BLE001 — fail the flush, not the server
+                self._log(f"serve: batch failed: {e!r}")
+                for r in flush.requests:
+                    if not r.future.done():
+                        r.future.set_error(e)
+            finally:
+                if buf is not None:
+                    pool.release(*buf)
+
+    def _fail_expired(self, flush: Flush) -> None:
         for r in flush.expired:
             self._count("reject_timeout")
             r.future.set_error(ServeRejection(
@@ -353,14 +458,45 @@ class InferenceServer:
                 f"deadline exceeded after "
                 f"{(self._clock() - r.enqueued) * 1e3:.1f} ms in queue",
             ))
+
+    def _pack_flush(self, flush: Flush, pool=None):
+        """-> (batch, pool buffer or None). Compact staging when the
+        shape set carries a spec AND every request in the flush is
+        compactable (admission-time flag); full-fidelity otherwise."""
+        graphs = [r.graph for r in flush.requests]
+        if self.shape_set.compact is not None:
+            if all(r.compactable for r in flush.requests):
+                buf = None
+                if pool is not None:
+                    key = self.shape_set.buffer_key(flush.shape)
+                    buf = (key, pool.acquire(
+                        key, self.shape_set.buffer_factory(flush.shape)))
+                batch = self.shape_set.pack(
+                    graphs, shape=flush.shape,
+                    out=None if buf is None else buf[1],
+                )
+                self._count("pack_compact")
+                return batch, buf
+            self._count("pack_full")
+            return self.shape_set.pack_full(graphs, shape=flush.shape), None
+        return self.shape_set.pack(graphs, shape=flush.shape), None
+
+    def _process(self, flush: Flush) -> None:
+        """The in-line (pack_workers=0) flush path: expire, pack,
+        dispatch — all on the calling thread."""
+        self._fail_expired(flush)
         if not flush.requests:
             return
+        batch, _ = self._pack_flush(flush)
+        self._dispatch_flush(flush, batch)
+
+    def _dispatch_flush(self, flush: Flush, batch) -> None:
+        import jax
+
         reqs = flush.requests
         # the hot-swap boundary: one consistent (params, version) pair per
         # batch — a reload landing after this line affects the NEXT batch
         state, version = self.param_store.get()
-        batch = self.shape_set.pack([r.graph for r in reqs],
-                                    shape=flush.shape)
         pre = self._jit_cache_size()
         out = np.asarray(jax.device_get(self.predict_step(state, batch)))
         post = self._jit_cache_size()
@@ -433,6 +569,13 @@ class InferenceServer:
             "batch_occupancy_mean": float(np.mean(occ)) if occ else 0.0,
             "shapes": [s.to_meta() for s in self.shape_set],
             "recompiles_after_warm": self._compiles_after_warm,
+            "ingest": {
+                "compact": self.shape_set.compact is not None,
+                "pack_workers": self._pack_workers,
+                "pack_s": self.telemetry.series_quantiles("serve_pack_s"),
+                "pipeline_wait_s": self.telemetry.series_quantiles(
+                    "pipeline_wait_s"),
+            },
         }
         if self.cache is not None:
             out["cache"] = self.cache.stats()
@@ -465,6 +608,8 @@ def load_server(
     max_wait_ms: float = 5.0,
     default_timeout_ms: float | None = 1000.0,
     cache_size: int = 1024,
+    compact: str = "auto",
+    pack_workers: int | None = None,
     watch: bool = True,
     poll_interval_s: float = 2.0,
     log_fn: Callable = print,
@@ -476,6 +621,21 @@ def load_server(
     ladder from ``calibration`` (default: synthetic structures drawn with
     the checkpoint's own featurization config), warms every shape, and —
     with ``watch`` — attaches the hot-reload watcher to ``ckpt_dir``.
+
+    ``compact='auto'`` (default) serves compact-staged when the backend
+    is an ACCELERATOR and the calibration sample probes stageable
+    (data/compact.py); on a CPU backend the device IS the host, so
+    shrinking H2D bytes buys nothing while the on-device re-expansion
+    costs real compute — measured on this container's loadgen: compact
+    serving on CPU is throughput-neutral with a worse p99, on the
+    tunneled TPU it is the ISSUE-4 win. ``'on'`` forces it (the A/B
+    leg), ``'off'`` forces full-fidelity packing.
+
+    ``pack_workers`` sizes the pack pipeline between the batcher and
+    the dispatch loop (0 = pack in-line on the worker thread); default
+    ``None`` follows the same device rule — 1 on accelerators (pack
+    overlaps remote dispatch), 0 on CPU (an overlap thread only steals
+    cores from the compute it would overlap with).
 
     -> (server, dict of the bits callers reuse: manager, meta, configs,
     template graph, the calibration sample).
@@ -510,12 +670,31 @@ def load_server(
     dense_m = model_cfg.dense_m or None
     edge_dtype = (jax.numpy.bfloat16 if model_cfg.dtype == "bfloat16"
                   else np.float32)
+    on_accelerator = jax.default_backend() != "cpu"
+    if pack_workers is None:
+        pack_workers = 1 if on_accelerator else 0
+    want_compact = (compact == "on"
+                    or (compact == "auto" and on_accelerator))
+    compact_spec = None
+    if want_compact and dense_m is not None:
+        from cgnn_tpu.data.compact import CompactSpec, CompactUnsupported
+
+        try:
+            compact_spec = CompactSpec.build(
+                list(calibration), data_cfg.featurize_config().gdf(),
+                dense_m=dense_m, edge_dtype=edge_dtype,
+            )
+        except CompactUnsupported as e:
+            log_fn(f"serve: compact staging unavailable ({e}); "
+                   f"full-fidelity packing")
     shape_set = plan_shape_set(
         calibration, batch_size, rungs=rungs, dense_m=dense_m,
         edge_dtype=edge_dtype, num_targets=model_cfg.num_targets,
+        compact=compact_spec,
     )
     template = calibration[0]
-    example = shape_set.pack([template])
+    # model init reads the expanded form regardless of staging mode
+    example = shape_set.pack_full([template])
     state = create_train_state(
         model, example, make_optimizer(),
         Normalizer.identity(model_cfg.num_targets), rng=jax.random.key(0),
@@ -529,7 +708,7 @@ def load_server(
         state, shape_set, version=version, telemetry=telemetry,
         max_queue=max_queue, max_wait_ms=max_wait_ms,
         default_timeout_ms=default_timeout_ms, cache_size=cache_size,
-        log_fn=log_fn,
+        pack_workers=pack_workers, log_fn=log_fn,
     )
     server.warm(template)
     if watch:

@@ -77,6 +77,7 @@ class ShapeSet:
         dense_m: int | None = None,
         edge_dtype=np.float32,
         num_targets: int = 1,
+        compact=None,
     ):
         if not shapes:
             raise ValueError("a ShapeSet needs at least one shape")
@@ -84,6 +85,15 @@ class ShapeSet:
         self.dense_m = dense_m
         self.edge_dtype = edge_dtype
         self.num_targets = num_targets
+        # CompactSpec | None: with a spec, pack() stages the raw compact
+        # form (data/compact.py — ~12x fewer host bytes written and H2D
+        # bytes moved) and the predict step must carry the matching
+        # expander (train.step.make_predict_step(expander=...)) so the
+        # exact GraphBatch is rebuilt INSIDE the compiled program
+        self.compact = compact
+        if compact is not None and dense_m is None:
+            raise ValueError("compact staging requires the dense layout "
+                             "(dense_m)")
         for s in self.shapes:
             if dense_m is not None and s.edge_cap != s.node_cap * dense_m:
                 raise ValueError(
@@ -100,6 +110,22 @@ class ShapeSet:
     @property
     def largest(self) -> BatchShape:
         return self.shapes[-1]
+
+    def expander(self):
+        """Jit-composable CompactBatch -> GraphBatch reconstruction for
+        this set's spec (None without compact staging) — hand it to
+        ``train.step.make_predict_step(expander=...)``."""
+        if self.compact is None:
+            return None
+        from cgnn_tpu.data.compact import make_expander
+
+        return make_expander(self.compact)
+
+    def compactable(self, graph: CrystalGraph) -> bool:
+        """Can this graph stage compactly under the set's spec? (Always
+        False without one; never raises — the serving admission probe.)"""
+        return (self.compact is not None
+                and self.compact.graph_compactable(graph))
 
     def graph_counts(self, graph: CrystalGraph) -> tuple[int, int]:
         """(nodes, edge slots) one graph consumes under this set's layout.
@@ -131,18 +157,49 @@ class ShapeSet:
                 return s
         return None
 
-    def pack(self, graphs: Sequence[CrystalGraph],
-             shape: BatchShape | None = None) -> GraphBatch:
-        """Pack ``graphs`` into ``shape`` (default: smallest fitting rung)."""
+    def _resolve(self, graphs: Sequence[CrystalGraph],
+                 shape: BatchShape | None) -> BatchShape:
+        if shape is not None:
+            return shape
+        n = sum(g.num_nodes for g in graphs)
+        e = sum(self.graph_counts(g)[1] for g in graphs)
+        shape = self.shape_for(len(graphs), n, e)
         if shape is None:
-            n = sum(g.num_nodes for g in graphs)
-            e = sum(self.graph_counts(g)[1] for g in graphs)
-            shape = self.shape_for(len(graphs), n, e)
-            if shape is None:
-                raise ValueError(
-                    f"{len(graphs)} graphs ({n} nodes) fit no shape in "
-                    f"{self.shapes}"
-                )
+            raise ValueError(
+                f"{len(graphs)} graphs ({n} nodes) fit no shape in "
+                f"{self.shapes}"
+            )
+        return shape
+
+    def pack(self, graphs: Sequence[CrystalGraph],
+             shape: BatchShape | None = None, out=None):
+        """Pack ``graphs`` into ``shape`` (default: smallest fitting rung).
+
+        With a compact spec this stages the raw ``CompactBatch`` form
+        (``out`` recycles a pooled staging buffer); without one, the
+        full-fidelity ``GraphBatch``."""
+        shape = self._resolve(graphs, shape)
+        if self.compact is not None:
+            from cgnn_tpu.data.compact import pack_compact
+
+            return pack_compact(
+                list(graphs),
+                shape.node_cap,
+                shape.edge_cap,
+                shape.graph_cap,
+                self.compact,
+                num_targets=self.num_targets,
+                dense_m=self.dense_m,
+                out=out,
+            )
+        return self.pack_full(graphs, shape)
+
+    def pack_full(self, graphs: Sequence[CrystalGraph],
+                  shape: BatchShape | None = None) -> GraphBatch:
+        """Full-fidelity pack regardless of the compact spec — the
+        serving fallback for requests that cannot stage compactly (no
+        raw distances / atom rows outside the vocabulary)."""
+        shape = self._resolve(graphs, shape)
         return pack_graphs(
             list(graphs),
             shape.node_cap,
@@ -155,6 +212,25 @@ class ShapeSet:
             edge_dtype=self.edge_dtype,
         )
 
+    def buffer_key(self, shape: BatchShape) -> tuple:
+        """Staging-buffer pool key for one rung (compact sets only)."""
+        if self.compact is None:
+            raise ValueError("buffer pooling applies to compact staging")
+        from cgnn_tpu.data.compact import compact_buffer_key
+
+        return compact_buffer_key(shape.node_cap, self.dense_m,
+                                  shape.graph_cap, self.num_targets)
+
+    def buffer_factory(self, shape: BatchShape):
+        """() -> fresh staging buffers for one rung (BufferPool factory)."""
+        if self.compact is None:
+            raise ValueError("buffer pooling applies to compact staging")
+        from cgnn_tpu.data.compact import alloc_compact_buffers
+
+        return lambda: alloc_compact_buffers(
+            shape.node_cap, self.dense_m, shape.graph_cap, self.num_targets
+        )
+
     def to_meta(self) -> dict:
         return {
             "shapes": [s.to_meta() for s in self.shapes],
@@ -162,6 +238,7 @@ class ShapeSet:
             "edge_dtype": np.dtype(self.edge_dtype).name
             if self.edge_dtype is not np.float32 else "float32",
             "num_targets": self.num_targets,
+            "compact": self.compact is not None,
         }
 
 
@@ -173,6 +250,7 @@ def plan_shape_set(
     dense_m: int | None = None,
     edge_dtype=np.float32,
     num_targets: int | None = None,
+    compact=None,
 ) -> ShapeSet:
     """Quantize a serving ladder from a calibration sample.
 
@@ -212,4 +290,5 @@ def plan_shape_set(
         dense_m=dense_m,
         edge_dtype=edge_dtype,
         num_targets=num_targets,
+        compact=compact,
     )

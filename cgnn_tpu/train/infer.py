@@ -20,6 +20,23 @@ shapes (and, through the persistent XLA cache, its compiled programs),
 and the total compile count is pinned at ``len(shape_set)`` regardless
 of dataset. ``predict_step`` is likewise injectable, so serve and
 predict can share one jitted callable and its jit cache.
+
+ISSUE 4 closed the remaining host gap (BENCH_r05: device 112,305
+structs/s vs 1,461 end-to-end — 98.7% of a cold predict run was host
+packing on the critical path) three ways, all in this function:
+
+- **compact staging** (``compact=`` / a compact shape set): batches
+  stage the raw ``CompactBatch`` form (~12x fewer host bytes written
+  and H2D bytes moved) and the exact GraphBatch is rebuilt inside the
+  jitted ``predict_step`` via ``make_expander`` — the train path's §7
+  win, applied to the forward path, same parity pins;
+- **parallel packing** (``pack_workers=``): a bounded pool of packer
+  threads (data/pipeline.py) with order-restoring reassembly feeds the
+  dispatch window, so the device never waits on a single packer;
+- **buffer pooling**: compact packers write into reusable preallocated
+  per-shape buffers instead of allocating per batch (the §7 page-fault
+  bound); a buffer is recycled only after the window fence proves the
+  dispatch that read it completed (FIFO per-device execution order).
 """
 
 from __future__ import annotations
@@ -31,11 +48,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cgnn_tpu.data import invariants
 from cgnn_tpu.data.graph import (
     assign_size_buckets,
-    batch_iterator,
     capacities_for,
+    graph_cap_for,
+    pack_graphs,
+    plan_batches,
 )
+from cgnn_tpu.data.pipeline import BufferPool, parallel_pack
 from cgnn_tpu.train.step import make_predict_step
 
 # in-flight dispatch window before a bounding value fetch (same role as
@@ -81,6 +102,9 @@ def run_fast_inference(
     edge_dtype=np.float32,
     predict_step=None,
     shape_set=None,
+    compact=None,
+    pack_workers: int = 0,
+    telemetry=None,
 ) -> tuple[np.ndarray, float]:
     """Predict over ``graphs`` -> ([n, T] predictions in input order,
     end-to-end structures/sec including host packing).
@@ -91,11 +115,30 @@ def run_fast_inference(
 
     With ``shape_set``: batches pack into the fixed rungs (module
     docstring); ``buckets``/``dense_m``/``snug``/``edge_dtype`` are
-    ignored — the set carries the layout.
+    ignored — the set carries the layout (including its compact spec).
+
+    ``compact`` (a ``data.compact.CompactSpec``) stages the raw compact
+    form; a compact ``shape_set`` implies it. The default
+    ``predict_step`` then carries the matching expander — an INJECTED
+    step must accept ``CompactBatch`` (``make_predict_step(expander)``).
+
+    ``pack_workers > 0`` packs batches on that many pipeline threads
+    (data/pipeline.py) overlapping the dispatch loop; ``0`` packs
+    serially on the calling thread (identical outputs, pinned by test).
     """
     if not len(graphs):
         raise ValueError("no graphs to predict")
-    predict_step = predict_step or jax.jit(make_predict_step())
+    if shape_set is not None and shape_set.compact is not None:
+        if compact is not None and compact is not shape_set.compact:
+            raise ValueError("shape_set already carries a compact spec")
+        compact = shape_set.compact
+    if predict_step is None:
+        expander = None
+        if compact is not None:
+            from cgnn_tpu.data.compact import make_expander
+
+            expander = make_expander(compact)
+        predict_step = jax.jit(make_predict_step(expander))
     n = len(graphs)
     preds: np.ndarray | None = None
     t0 = time.perf_counter()
@@ -104,11 +147,28 @@ def run_fast_inference(
     # compiled shape; spans restore input order on the host afterwards
     outs_by_shape: dict = {}
     recent: list = []
+    # compact staging buffers in dispatch order; an entry is released to
+    # the pool once the window fence proves its dispatch completed
+    pool = BufferPool() if compact is not None else None
+    pending: list = []
 
-    def _dispatch(span, batch, key):
+    def _release_fenced():
+        # the fence blocked on the FIRST dispatch of the closing window:
+        # everything dispatched before it completed (FIFO per device), so
+        # all but the window's remaining _WINDOW - 1 dispatches are safe
+        safe = len(pending) - (_WINDOW - 1)
+        if safe > 0:
+            for item in pending[:safe]:
+                if item is not None:
+                    pool.release(*item)
+            del pending[:safe]
+
+    def _dispatch(span, batch, key, buf=None):
         out = predict_step(state, batch)
         outs_by_shape.setdefault(key, []).append((span, out))
         recent.append(out)
+        if pool is not None:
+            pending.append(buf)
         if len(recent) == _WINDOW:
             # true fence (block_until_ready returns early on tunneled
             # runtimes) on the OLDEST in-window result: proves everything
@@ -116,27 +176,71 @@ def run_fast_inference(
             # while the newer _WINDOW-1 dispatches stay in flight
             float(recent[0][0, 0])
             del recent[:]
+            if pool is not None:
+                _release_fenced()
 
     if shape_set is not None:
-        for span, sub, shape in _shape_set_plan(graphs, shape_set):
-            _dispatch(span, shape_set.pack(sub, shape=shape), shape)
+        def pack_job(job):
+            span, sub, shape = job
+            buf = None
+            if pool is not None:
+                key = shape_set.buffer_key(shape)
+                buf = (key, pool.acquire(key, shape_set.buffer_factory(shape)))
+            batch = shape_set.pack(sub, shape=shape,
+                                   out=None if buf is None else buf[1])
+            return span, invariants.maybe_check(batch, shape_set.dense_m), \
+                shape, buf
+
+        jobs = _shape_set_plan(graphs, shape_set)
     else:
         bucket_of = assign_size_buckets(graphs, buckets)
-        for b in range(int(bucket_of.max()) + 1):
-            idxs = np.nonzero(bucket_of == b)[0]
-            if len(idxs) == 0:
-                continue
-            sub = [graphs[int(i)] for i in idxs]
-            nc, ec = capacities_for(sub, batch_size, dense_m=dense_m,
-                                    snug=snug)
-            ptr = 0
-            # in_cap=0: no backward, so no transpose-slot packing
-            for batch in batch_iterator(sub, batch_size, nc, ec,
-                                        dense_m=dense_m, in_cap=0, snug=snug,
-                                        edge_dtype=edge_dtype):
-                n_real = int(np.asarray(batch.graph_mask).sum())
-                _dispatch(idxs[ptr : ptr + n_real], batch, (b, nc, ec))
-                ptr += n_real
+        graph_cap = graph_cap_for(batch_size) if snug else batch_size
+        tdim = int(np.atleast_1d(graphs[0].target).shape[0])
+
+        def bucket_jobs():
+            for b in range(int(bucket_of.max()) + 1):
+                idxs = np.nonzero(bucket_of == b)[0]
+                if len(idxs) == 0:
+                    continue
+                sub = [graphs[int(i)] for i in idxs]
+                nc, ec = capacities_for(sub, batch_size, dense_m=dense_m,
+                                        snug=snug)
+                for s, e in plan_batches(sub, batch_size, nc, ec, snug=snug):
+                    yield idxs[s:e], sub[s:e], (b, nc, ec), nc, ec
+
+        def pack_job(job):
+            span, sub, key, nc, ec = job
+            buf = None
+            if compact is not None:
+                from cgnn_tpu.data.compact import (
+                    alloc_compact_buffers,
+                    compact_buffer_key,
+                    pack_compact,
+                )
+
+                bkey = compact_buffer_key(nc, dense_m, graph_cap, tdim)
+                buf = (bkey, pool.acquire(
+                    bkey,
+                    lambda: alloc_compact_buffers(nc, dense_m, graph_cap,
+                                                  tdim),
+                ))
+                batch = pack_compact(sub, nc, ec, graph_cap, compact,
+                                     num_targets=tdim, dense_m=dense_m,
+                                     out=buf[1])
+            else:
+                batch = pack_graphs(sub, nc, ec, graph_cap, dense_m=dense_m,
+                                    edge_dtype=edge_dtype)
+            return span, invariants.maybe_check(batch, dense_m), key, buf
+
+        jobs = bucket_jobs()
+
+    if pack_workers > 0:
+        packed = parallel_pack(jobs, pack_job, workers=pack_workers,
+                               telemetry=telemetry)
+    else:
+        packed = map(pack_job, jobs)
+    for span, batch, key, buf in packed:
+        _dispatch(span, batch, key, buf)
 
     for group in outs_by_shape.values():
         stacked = np.asarray(

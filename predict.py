@@ -46,6 +46,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "compile count is pinned at this many programs, "
                         "shared with an online server via the persistent "
                         "compile cache")
+    p.add_argument("--pack-workers", type=int, default=None,
+                   help="host pack pipeline threads (data/pipeline.py) "
+                        "overlapping packing with device dispatch; 0 packs "
+                        "serially on the main thread (default: 4 on an "
+                        "accelerator backend, 0 on CPU — overlap threads "
+                        "only steal cores from a CPU 'device')")
+    p.add_argument("--compact", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="stage raw CompactBatch forms (~12x fewer host and "
+                        "H2D bytes; data/compact.py) and expand on device; "
+                        "'auto' engages on accelerator backends when the "
+                        "dataset probes stageable, falling back to "
+                        "full-fidelity staging otherwise")
     p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache",
                    metavar="DIR", help="persistent XLA compile cache "
                                        "('' disables)")
@@ -81,6 +94,33 @@ def main(argv=None) -> int:
         mgr.close()
 
 
+def _probe_compact(args, graphs, data_cfg, layout_m, edge_dtype):
+    """CompactSpec for this dataset, or None (full-fidelity staging):
+    --compact off, a CPU backend under 'auto' (the device IS the host —
+    nothing to save, re-expansion to pay), COO layout, or a dataset the
+    probe rejects (continuous atom features / stale cache) all fall back
+    loudly-but-gracefully."""
+    import sys
+
+    import jax
+
+    if args.compact == "off" or layout_m is None:
+        return None
+    if args.compact == "auto" and jax.default_backend() == "cpu":
+        return None
+    from cgnn_tpu.data.compact import CompactSpec, CompactUnsupported
+
+    try:
+        return CompactSpec.build(
+            graphs, data_cfg.featurize_config().gdf(), dense_m=layout_m,
+            edge_dtype=edge_dtype,
+        )
+    except CompactUnsupported as e:
+        print(f"compact staging unavailable ({e}); using full-fidelity "
+              f"packing", file=sys.stderr)
+        return None
+
+
 def _run(args, mgr) -> int:
     import jax
     import numpy as np
@@ -96,6 +136,8 @@ def _run(args, mgr) -> int:
     from cgnn_tpu.train.infer import run_fast_inference
     from cgnn_tpu.train.loop import capacities_for
 
+    if args.pack_workers is None:
+        args.pack_workers = 4 if jax.default_backend() != "cpu" else 0
     tag = "best" if args.best else "latest"
     if not mgr.exists(tag):
         print(f"no '{tag}' checkpoint under {args.ckpt_dir}", file=sys.stderr)
@@ -198,24 +240,34 @@ def _run(args, mgr) -> int:
         preds, rate = run_fast_inference(
             state, graphs, args.batch_size, buckets=args.buckets,
             dense_m=layout_m, snug=snug, edge_dtype=edge_dtype,
+            compact=_probe_compact(args, graphs, data_cfg, layout_m,
+                                   edge_dtype),
+            pack_workers=args.pack_workers,
         )
         print(f"inference throughput: {rate:.0f} structures/sec "
               f"(dispatch-pipelined, single fetch per bucket)")
     else:
         # default: pack into the serving shape ladder (serve.shapes) —
         # compile count pinned at --rungs, and shared with an online
-        # server through the persistent XLA compile cache
+        # server through the persistent XLA compile cache. Compact-staged
+        # by default: batches cross the link in raw form (~12x smaller)
+        # and the ladder's packers run on --pack-workers threads.
         from cgnn_tpu.serve.shapes import plan_shape_set
 
         shape_set = plan_shape_set(
             graphs, args.batch_size, rungs=args.rungs, dense_m=layout_m,
             edge_dtype=edge_dtype, num_targets=model_cfg.num_targets,
+            compact=_probe_compact(args, graphs, data_cfg, layout_m,
+                                   edge_dtype),
         )
         preds, rate = run_fast_inference(
             state, graphs, args.batch_size, shape_set=shape_set,
+            pack_workers=args.pack_workers,
         )
         print(f"inference throughput: {rate:.0f} structures/sec "
-              f"(dispatch-pipelined, {len(shape_set)}-rung shape ladder)")
+              f"(dispatch-pipelined, {len(shape_set)}-rung shape ladder, "
+              f"{'compact' if shape_set.compact else 'full'}-staged, "
+              f"{args.pack_workers} pack workers)")
     if not force_task:
         for g, p in zip(graphs, preds):
             rows.append(

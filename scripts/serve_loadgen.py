@@ -60,6 +60,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "assert a zero-drop version transition")
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--rungs", type=int, default=3)
+    p.add_argument("--compact", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="compact-staged serving (ISSUE 4): auto = "
+                        "accelerator backends only; on/off force the "
+                        "A/B legs")
+    p.add_argument("--pack-workers", type=int, default=None,
+                   help="server pack pipeline threads (0 = in-line pack, "
+                        "the pre-ISSUE-4 worker; default follows the "
+                        "backend like --compact auto)")
     p.add_argument("--max-wait-ms", type=float, default=5.0)
     p.add_argument("--max-queue", type=int, default=4096)
     p.add_argument("--report", default="slo_report.json")
@@ -152,6 +161,8 @@ def _run_inproc(args) -> dict:
         telemetry=telemetry,
         max_queue=args.max_queue,
         max_wait_ms=args.max_wait_ms,
+        compact=args.compact,
+        pack_workers=args.pack_workers,
         default_timeout_ms=args.timeout_ms,
         cache_size=0,  # the loadgen reuses structures; caching would
                        # let most requests skip the batcher under test

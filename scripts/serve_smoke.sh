@@ -38,6 +38,28 @@ print("leg 1 ok:", r["answered"], "answered @", r["throughput_rps"], "rps,",
       list(r["param_versions"]))
 EOF
 
+echo "== leg 1b: compact-staged + pipelined packer (forced; ISSUE 4) =="
+# CPU CI would never pick these under 'auto' (accelerator-only default),
+# so force them: the SLO invariants — zero drops, zero recompiles after
+# the doubled warmup (compact + full program per rung) — must hold under
+# the new ingest machinery no matter the backend
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --clients 64 --duration 6 --compact on --pack-workers 2 \
+  --report "$WORK/slo_compact.json"
+python - "$WORK/slo_compact.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["dropped"] == 0, r
+assert r["compiles"]["after_warm"] == 0, r["compiles"]
+assert not r["failures"], r["failures"]
+ingest = r["server_stats"]["ingest"]
+assert ingest["compact"] and ingest["pack_workers"] == 2, ingest
+assert r["server_stats"]["counts"].get("pack_compact", 0) > 0, (
+    r["server_stats"]["counts"])
+print("leg 1b ok:", r["answered"], "answered @", r["throughput_rps"],
+      "rps under compact+pipelined ingest")
+EOF
+
 echo "== leg 2: HTTP front-end + graceful SIGTERM drain =="
 python serve.py "$WORK/ckpt" --port "$PORT" --calibrate 64 \
   >"$WORK/serve.log" 2>&1 &

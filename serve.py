@@ -36,6 +36,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="default per-request deadline (0 disables)")
     p.add_argument("--cache-size", type=int, default=1024,
                    help="LRU result cache entries (0 disables)")
+    p.add_argument("--compact", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="compact-staged serving (data/compact.py): auto "
+                        "engages on accelerator backends, on/off force")
+    p.add_argument("--pack-workers", type=int, default=None,
+                   help="pack pipeline threads between batcher and "
+                        "dispatch (0 = in-line; default follows the "
+                        "backend like --compact auto)")
     p.add_argument("--poll-interval", type=float, default=2.0,
                    help="hot-reload checkpoint poll seconds (0 disables)")
     p.add_argument("--calibrate", type=int, default=256,
@@ -95,6 +103,8 @@ def main(argv=None) -> int:
             max_wait_ms=args.max_wait_ms,
             default_timeout_ms=args.timeout_ms or None,
             cache_size=args.cache_size,
+            compact=args.compact,
+            pack_workers=args.pack_workers,
             watch=args.poll_interval > 0,
             poll_interval_s=args.poll_interval or 2.0,
         )

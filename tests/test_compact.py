@@ -140,6 +140,72 @@ def test_iterator_with_compact_pack_fn(graphs, spec):
     assert all(k[0] == "compact" for k in keys)
 
 
+def test_pack_compact_buffer_reuse_bit_identical(graphs, spec):
+    """pack_compact(out=) must be indistinguishable from a fresh pack —
+    including stale state from a PREVIOUS batch in the recycled buffer
+    (the padding-tail zeroing is what this pins)."""
+    from cgnn_tpu.data.compact import alloc_compact_buffers
+
+    nc, ec = capacities_for(graphs, len(graphs), dense_m=12, snug=True)
+    tdim = 1
+    buf = alloc_compact_buffers(nc, 12, len(graphs), tdim)
+    # dirty the buffer with a big batch, then pack a SMALLER one into it
+    pack_compact(graphs, nc, ec, len(graphs), spec, num_targets=tdim,
+                 out=buf)
+    small = graphs[:5]
+    fresh = pack_compact(small, nc, ec, len(graphs), spec,
+                         num_targets=tdim)
+    reused = pack_compact(small, nc, ec, len(graphs), spec,
+                          num_targets=tdim, out=buf)
+    import jax
+
+    for leaf_fresh, leaf_reused in zip(
+        jax.tree_util.tree_leaves(fresh), jax.tree_util.tree_leaves(reused)
+    ):
+        np.testing.assert_array_equal(leaf_fresh, leaf_reused)
+    assert reused.atom_idx is buf.atom_idx  # actually reused, not copied
+
+
+def test_pack_compact_out_rejects_mismatch_and_transpose(graphs, spec):
+    from cgnn_tpu.data.compact import alloc_compact_buffers
+
+    nc, ec = capacities_for(graphs, len(graphs), dense_m=12, snug=True)
+    wrong = alloc_compact_buffers(nc + 8, 12, len(graphs), 1)
+    with pytest.raises(ValueError, match="geometry"):
+        pack_compact(graphs, nc, ec, len(graphs), spec, num_targets=1,
+                     out=wrong)
+    ok = alloc_compact_buffers(nc, 12, len(graphs), 1)
+    with pytest.raises(ValueError, match="forward-only"):
+        pack_compact(graphs, nc, ec, len(graphs), spec, num_targets=1,
+                     over_cap=overflow_cap(graphs, len(graphs), 12), out=ok)
+
+
+def test_graph_compactable_probe(graphs, spec):
+    import dataclasses
+
+    g = graphs[0]
+    assert spec.graph_compactable(g)
+    # no raw distances (the wire-format request case) -> full fidelity
+    bare = dataclasses.replace(g, distances=None)
+    assert not spec.graph_compactable(bare)
+    # edge features inconsistent with distances -> full fidelity (the
+    # exactness contract: compact staging must never change the answer)
+    lying = dataclasses.replace(g, edge_fea=g.edge_fea + 0.25)
+    assert not spec.graph_compactable(lying)
+    # atom rows outside the vocabulary -> full fidelity
+    alien = dataclasses.replace(
+        g, atom_fea=np.full_like(g.atom_fea, 0.123456)
+    )
+    assert not spec.graph_compactable(alien)
+    # the verdict is cached on the graph, keyed to THIS spec's identity
+    # (a different spec in the same process must re-probe, not reuse)
+    assert g._compact_ok == (spec._probe_token, True)
+    assert alien._compact_ok == (spec._probe_token, False)
+    spec2 = CompactSpec.build(graphs, CFG.gdf(), dense_m=12)
+    assert spec2.graph_compactable(g)  # re-probed under spec2, not stale
+    assert g._compact_ok[0] is spec2._probe_token
+
+
 def test_fit_compact_matches_full(graphs):
     """Single-bucket scan training: compact staging must produce the same
     trajectory as full staging up to edge-feature roundoff."""

@@ -137,6 +137,104 @@ def test_fast_inference_shape_set_pins_compiles():
             run_fast_inference(state, [huge], 2, shape_set=tiny_set)
 
 
+def test_fast_inference_parallel_pipeline_bit_exact_vs_serial():
+    """The parallel pack pipeline must be a pure scheduling optimization:
+    identical inputs through pack_workers=0 and pack_workers=3 give
+    BIT-identical outputs — ragged tail (157 graphs), multi-rung ladder,
+    multi-bucket legacy path, input-order restoration, with and without
+    compact staging."""
+    from cgnn_tpu.data.compact import CompactSpec, make_expander
+    from cgnn_tpu.serve.shapes import plan_shape_set
+
+    graphs = load_synthetic_mp(157, CFG, seed=9)
+    state = _tiny_state(graphs)
+    spec = CompactSpec.build(graphs, CFG.gdf(), dense_m=12)
+
+    # serving-ladder path, compact-staged (predict.py's default)
+    ladder = plan_shape_set(graphs, 32, rungs=2, dense_m=12, compact=spec)
+    pstep = jax.jit(make_predict_step(make_expander(spec)))
+    serial, _ = run_fast_inference(state, graphs, 32, shape_set=ladder,
+                                   predict_step=pstep, pack_workers=0)
+    parallel, _ = run_fast_inference(state, graphs, 32, shape_set=ladder,
+                                     predict_step=pstep, pack_workers=3)
+    np.testing.assert_array_equal(serial, parallel)
+
+    # ladder path, full-fidelity staging
+    ladder_full = plan_shape_set(graphs, 32, rungs=2, dense_m=12)
+    fserial, _ = run_fast_inference(state, graphs, 32,
+                                    shape_set=ladder_full,
+                                    predict_step=pstep, pack_workers=0)
+    fparallel, _ = run_fast_inference(state, graphs, 32,
+                                      shape_set=ladder_full,
+                                      predict_step=pstep, pack_workers=3)
+    np.testing.assert_array_equal(fserial, fparallel)
+
+    # legacy bucketed path (multi-bucket order restoration under the pool)
+    for buckets in (1, 3):
+        bserial, _ = run_fast_inference(state, graphs, 32, buckets=buckets,
+                                        dense_m=12, snug=True,
+                                        predict_step=pstep, pack_workers=0)
+        bparallel, _ = run_fast_inference(state, graphs, 32,
+                                          buckets=buckets, dense_m=12,
+                                          snug=True, predict_step=pstep,
+                                          pack_workers=3)
+        np.testing.assert_array_equal(bserial, bparallel)
+
+
+def test_fast_inference_compact_staging_matches_full():
+    """Compact staging is an I/O-layout change, not a numerics change:
+    predictions over compact-staged batches must match full-fidelity
+    staging to edge-feature roundoff (the <=1 ulp jnp.exp/np.exp
+    difference; same bound test_compact pins for training)."""
+    from cgnn_tpu.data.compact import CompactSpec, make_expander
+    from cgnn_tpu.serve.shapes import plan_shape_set
+
+    graphs = load_synthetic_mp(96, CFG, seed=12)
+    state = _tiny_state(graphs)
+    spec = CompactSpec.build(graphs, CFG.gdf(), dense_m=12)
+    pstep = jax.jit(make_predict_step(make_expander(spec)))
+
+    ladder = plan_shape_set(graphs, 32, rungs=2, dense_m=12, compact=spec)
+    ladder_full = plan_shape_set(graphs, 32, rungs=2, dense_m=12)
+    got, _ = run_fast_inference(state, graphs, 32, shape_set=ladder,
+                                predict_step=pstep, pack_workers=2)
+    want, _ = run_fast_inference(state, graphs, 32, shape_set=ladder_full,
+                                 predict_step=pstep, pack_workers=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    # the bucketed path accepts a spec directly (no shape set)
+    got_b, _ = run_fast_inference(state, graphs, 32, buckets=2, dense_m=12,
+                                  snug=True, predict_step=pstep,
+                                  compact=spec, pack_workers=2)
+    want_b, _ = run_fast_inference(state, graphs, 32, buckets=2, dense_m=12,
+                                   snug=True, predict_step=pstep,
+                                   pack_workers=0)
+    np.testing.assert_allclose(got_b, want_b, rtol=1e-5, atol=1e-5)
+
+
+def test_fast_inference_compact_ladder_pins_compiles():
+    """Compact staging keeps the ladder's compile pin: warming each
+    rung's compact program once leaves the jit cache at len(shape_set),
+    and a full pipelined run adds NOTHING — the parallel packers and the
+    buffer pool never perturb traced shapes."""
+    from cgnn_tpu.data.compact import CompactSpec, make_expander
+    from cgnn_tpu.serve.shapes import plan_shape_set
+
+    graphs = load_synthetic_mp(120, CFG, seed=13)
+    state = _tiny_state(graphs)
+    spec = CompactSpec.build(graphs, CFG.gdf(), dense_m=12)
+    ladder = plan_shape_set(graphs, 32, rungs=2, dense_m=12, compact=spec)
+    pstep = jax.jit(make_predict_step(make_expander(spec)))
+
+    for shape in ladder:
+        np.asarray(pstep(state, ladder.pack([graphs[0]], shape=shape)))
+    assert pstep._cache_size() == len(ladder)
+
+    run_fast_inference(state, graphs, 32, shape_set=ladder,
+                       predict_step=pstep, pack_workers=3)
+    assert pstep._cache_size() == len(ladder)  # zero fresh traces
+
+
 def test_fast_inference_single_bucket_small():
     graphs = load_synthetic_mp(20, CFG, seed=6)
     model = CrystalGraphConvNet(atom_fea_len=8, n_conv=1, h_fea_len=16,

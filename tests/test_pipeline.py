@@ -1,5 +1,9 @@
-"""Input-pipeline tests: graph cache round-trip, prefetch loader, native
-neighbor backend vs numpy (SURVEY.md §7 phase 4)."""
+"""Input-pipeline tests: graph cache round-trip, prefetch loader, the
+parallel pack pipeline (data/pipeline.py), native neighbor backend vs
+numpy (SURVEY.md §7 phase 4)."""
+
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -9,6 +13,7 @@ from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
 from cgnn_tpu.data.graph import batch_iterator
 from cgnn_tpu.data.loader import prefetch_to_device
 from cgnn_tpu.data.neighbors import neighbor_list
+from cgnn_tpu.data.pipeline import BufferPool, PackError, parallel_pack
 from cgnn_tpu.data.synthetic import random_structure
 from cgnn_tpu.native import native_available, neighbor_search_native
 
@@ -68,6 +73,115 @@ class TestPrefetch:
 
         with pytest.raises(RuntimeError, match="producer failed"):
             list(prefetch_to_device(gen()))
+
+
+def _pack_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("cgnn-pack") and t.is_alive()]
+
+
+class TestParallelPack:
+    def test_order_restored_under_skew(self):
+        """Workers finishing out of order must not reorder results: slow
+        every third job and check the stream still matches input order."""
+        def job(i):
+            if i % 3 == 0:
+                time.sleep(0.01)
+            return i * i
+
+        got = list(parallel_pack(range(40), job, workers=4))
+        assert got == [i * i for i in range(40)]
+
+    def test_matches_serial_map(self):
+        jobs = [np.arange(i + 1) for i in range(25)]
+        want = [a.sum() for a in jobs]
+        got = list(parallel_pack(iter(jobs), lambda a: a.sum(), workers=3))
+        assert got == want
+
+    def test_consumer_abandonment_stops_workers(self):
+        """The prefetch stop-event contract, generalized to the pool: a
+        consumer that leaves mid-stream (exception/early return) must
+        release the feeder and every packer thread promptly — nothing
+        may block forever holding packed batches alive."""
+        it = parallel_pack(range(10_000), lambda i: np.zeros(1024) + i,
+                           workers=3, depth=4)
+        for _, _ in zip(range(3), it):
+            pass
+        it.close()  # what an exception in the consumer loop triggers
+        deadline = time.monotonic() + 6.0
+        while _pack_threads() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not _pack_threads(), (
+            "pack pipeline threads still alive after the consumer left"
+        )
+
+    def test_pack_error_delivered_in_order_and_raises(self):
+        def job(i):
+            if i == 5:
+                raise RuntimeError("bad job 5")
+            return i
+
+        out = []
+        with pytest.raises(RuntimeError, match="bad job 5"):
+            for r in parallel_pack(range(10), job, workers=2):
+                out.append(r)
+        assert out == [0, 1, 2, 3, 4]  # everything before the poison slot
+
+    def test_pack_error_yielded_when_not_raising(self):
+        def job(i):
+            if i % 4 == 2:
+                raise ValueError(f"poison {i}")
+            return i
+
+        got = list(parallel_pack(range(8), job, workers=3,
+                                 raise_on_error=False))
+        assert [r for r in got if not isinstance(r, PackError)] == [
+            0, 1, 3, 4, 5, 7]
+        errs = [r for r in got if isinstance(r, PackError)]
+        assert [str(e.error) for e in errs] == ["poison 2", "poison 6"]
+        assert got.index(errs[0]) == 2  # in-order delivery
+
+    def test_jobs_iterable_error_propagates(self):
+        """The loader's producer-error contract: an exception raised by
+        the JOBS iterable surfaces at the consumer."""
+        def jobs():
+            yield 1
+            yield 2
+            raise RuntimeError("producer failed")
+
+        with pytest.raises(RuntimeError, match="producer failed"):
+            list(parallel_pack(jobs(), lambda i: i, workers=2))
+
+    def test_depth_bounds_in_flight(self):
+        """At most ``depth`` jobs may be past the feeder at once: a
+        stalled consumer must not let the packers run ahead unboundedly
+        (packed batches are the memory the bound protects)."""
+        started = []
+        lock = threading.Lock()
+
+        def job(i):
+            with lock:
+                started.append(i)
+            return i
+
+        it = parallel_pack(range(100), job, workers=2, depth=3)
+        next(it)
+        time.sleep(0.3)  # consumer stalls; feeder+workers run free
+        with lock:
+            n_started = len(started)
+        # 1 consumed + at most `depth` in flight behind it
+        assert n_started <= 1 + 3 + 1  # +1: release happens before yield
+        it.close()
+
+    def test_buffer_pool_reuses(self):
+        pool = BufferPool()
+        a = pool.acquire("k", lambda: np.zeros(4))
+        pool.release("k", a)
+        b = pool.acquire("k", lambda: np.ones(4))  # factory NOT called
+        assert b is a
+        c = pool.acquire("k", lambda: np.ones(4))  # empty again -> fresh
+        assert c is not a
+        assert pool.allocated == 2 and pool.reused == 1
 
 
 class TestNativeNeighbors:

@@ -496,3 +496,137 @@ def test_concurrent_load_zero_drops(graphs, shape_set, model_state):
     assert server.drain(timeout_s=60.0)
     assert len(answered) == 64 * 5
     assert server.stats()["recompiles_after_warm"] == 0
+
+
+# ----------------------------------------------------- compact serving
+
+
+class TestCompactServing:
+    """ISSUE 4: serving stages the raw CompactBatch form when it can —
+    ~12x fewer host/H2D bytes per flush — expands on device, and falls
+    back to warmed full-fidelity packing (never a recompile) for
+    requests that cannot stage compactly."""
+
+    @pytest.fixture(scope="class")
+    def dense_parts(self):
+        from cgnn_tpu.data.compact import CompactSpec
+
+        cfg = FeaturizeConfig(radius=5.0, max_num_nbr=8)
+        graphs = load_synthetic(48, cfg, seed=21, max_atoms=8)
+        spec = CompactSpec.build(graphs, cfg.gdf(), dense_m=8)
+        ss = plan_shape_set(graphs, 8, rungs=2, dense_m=8, compact=spec)
+        model_cfg = ModelConfig(atom_fea_len=8, n_conv=1, h_fea_len=16,
+                                dense_m=8)
+        model = build_model(model_cfg, DataConfig(radius=5.0, max_num_nbr=8))
+        state = create_train_state(
+            model, ss.pack_full([graphs[0]]), make_optimizer(),
+            Normalizer.fit(np.stack([g.target for g in graphs])),
+            rng=jax.random.key(7),
+        )
+        return graphs, ss, state
+
+    def _server(self, ss, state, **kw):
+        kw.setdefault("cache_size", 0)
+        kw.setdefault("log_fn", lambda *a, **k: None)
+        return InferenceServer(state, ss, **kw)
+
+    def test_compact_serving_matches_full_fidelity(self, dense_parts):
+        graphs, ss, state = dense_parts
+        compact_srv = self._server(ss, state)
+        compact_srv.warm(graphs[0])
+        compact_srv.start()
+        futs = [compact_srv.submit(g, timeout_ms=30000)
+                for g in graphs[:16]]
+        got = np.stack([f.result(30.0).prediction for f in futs])
+        assert compact_srv.drain(timeout_s=30.0)
+        # every flush actually took the compact path
+        assert compact_srv.counts.get("pack_compact", 0) >= 1
+        assert compact_srv.counts.get("pack_full", 0) == 0
+
+        full_ss = ShapeSet(list(ss.shapes), dense_m=8,
+                           num_targets=ss.num_targets)
+        full_srv = self._server(full_ss, state)
+        full_srv.warm(graphs[0])
+        full_srv.start()
+        futs = [full_srv.submit(g, timeout_ms=30000) for g in graphs[:16]]
+        want = np.stack([f.result(30.0).prediction for f in futs])
+        assert full_srv.drain(timeout_s=30.0)
+        # same answers up to the <=1 ulp on-device edge re-expansion
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_non_compactable_falls_back_without_recompile(self,
+                                                          dense_parts):
+        import dataclasses
+
+        graphs, ss, state = dense_parts
+        server = self._server(ss, state, max_wait_ms=20.0)
+        server.warm(graphs[0])
+        server.start()
+        # wire-format request: featurized arrays, no raw distances
+        bare = dataclasses.replace(graphs[1], distances=None)
+        futs = [server.submit(g, timeout_ms=30000)
+                for g in (graphs[0], bare, graphs[2])]
+        rows = [f.result(30.0) for f in futs]
+        assert all(np.isfinite(r.prediction).all() for r in rows)
+        # a compactable-only burst afterwards still goes compact
+        futs = [server.submit(g, timeout_ms=30000) for g in graphs[3:9]]
+        for f in futs:
+            f.result(30.0)
+        assert server.drain(timeout_s=30.0)
+        assert server.counts.get("pack_full", 0) >= 1
+        assert server.counts.get("pack_compact", 0) >= 1
+        # the fallback program was warmed: NOTHING recompiled under load
+        assert server.stats()["recompiles_after_warm"] == 0
+        # the bare graph's answer equals its full-featured twin's
+        bare_row = rows[1].prediction
+        direct = server_predict_reference(state, ss, graphs[1])
+        np.testing.assert_allclose(bare_row, direct, rtol=1e-5, atol=1e-5)
+
+    def test_pack_pipeline_telemetry_series(self, dense_parts, tmp_path):
+        graphs, ss, state = dense_parts
+        telemetry = Telemetry(level="epoch", log_dir=str(tmp_path),
+                              use_clu=False)
+        server = self._server(ss, state, telemetry=telemetry,
+                              pack_workers=2)
+        server.warm(graphs[0])
+        server.start()
+        for g in graphs[:8]:
+            server.predict(g, timeout_ms=30000)
+        assert server.drain(timeout_s=30.0)
+        # the satellite's observability contract: pack time and
+        # dispatch-side pipeline wait are value SERIES, so run_summary
+        # carries p50/p95/p99 through the existing quantile machinery
+        assert telemetry.series_quantiles("serve_pack_s")["count"] >= 1
+        assert telemetry.series_quantiles("pipeline_wait_s")["count"] >= 1
+        ingest = server.stats()["ingest"]
+        assert ingest["compact"] and ingest["pack_workers"] == 2
+        telemetry.close()
+        from cgnn_tpu.observe import read_jsonl
+
+        recs = read_jsonl(str(tmp_path / "metrics.jsonl"))
+        summary = [r for r in recs if r.get("event") == "run_summary"]
+        assert len(summary) == 1
+        gauges = summary[0]["gauges"]
+        assert "serve_pack_s_p99" in gauges
+        assert "pipeline_wait_s_p99" in gauges
+
+    def test_inline_pack_workers_zero_still_serves_compact(self,
+                                                           dense_parts):
+        graphs, ss, state = dense_parts
+        server = self._server(ss, state, pack_workers=0)
+        server.warm(graphs[0])
+        server.start()
+        futs = [server.submit(g, timeout_ms=30000) for g in graphs[:6]]
+        for f in futs:
+            assert np.isfinite(f.result(30.0).prediction).all()
+        assert server.drain(timeout_s=30.0)
+        assert server.counts.get("pack_compact", 0) >= 1
+
+
+def server_predict_reference(state, ss, graph):
+    """Offline reference for one graph through the set's compact path."""
+    from cgnn_tpu.train.step import make_predict_step as _mps
+
+    step = jax.jit(_mps(ss.expander()))
+    out = np.asarray(step(state, ss.pack([graph])))
+    return out[0]
