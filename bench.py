@@ -335,6 +335,18 @@ def main() -> None:
                     pack_workers=4 if on_accel else 0)
     run_fast_inference(istate, mp_graphs, 512, **infer_kw)  # compile pass
     _, infer_e2e = run_fast_inference(istate, mp_graphs, 512, **infer_kw)
+    # device-parallel leg (ISSUE 5): the SAME ladder/step/pack config
+    # round-robined across resolve_devices('auto') — measured in the same
+    # session as the single-device number (§8's in-session-ratio rule:
+    # cross-session levels drift with the link; the ratio is the result).
+    # On a CPU backend 'auto' is one device by design, so the two legs
+    # coincide and the ratio honestly reads ~1.
+    from cgnn_tpu.serve.devices import resolve_devices
+
+    inf_devices = resolve_devices("auto")
+    mdev_kw = dict(infer_kw, devices=inf_devices)
+    run_fast_inference(istate, mp_graphs, 512, **mdev_kw)  # per-dev compile
+    _, infer_e2e_mdev = run_fast_inference(istate, mp_graphs, 512, **mdev_kw)
     # the pre-ISSUE-4 serial full-fidelity path, for the same-session
     # before/after (cross-session BENCH levels drift with the link, §8)
     serial_kw = dict(buckets=3, dense_m=12, snug=True,
@@ -391,6 +403,14 @@ def main() -> None:
                 # the end-to-end rate incl. host packing
                 "inference_structs_per_sec": round(infer_dev, 1),
                 "inference_e2e_structs_per_sec": round(infer_e2e, 1),
+                # device-parallel forward path (ISSUE 5): same config
+                # dispatched across all 'auto' devices, same session as
+                # the single-device e2e above (§8 in-session-ratio rule)
+                "inference_devices": len(inf_devices),
+                "inference_e2e_multidev_structs_per_sec": round(
+                    infer_e2e_mdev, 1),
+                "inference_multidev_vs_single": round(
+                    infer_e2e_mdev / max(infer_e2e, 1.0), 3),
                 # the pre-ISSUE-4 serial full-fidelity ingest, same
                 # session (the honest before/after; PERF.md §11)
                 "inference_e2e_serial_structs_per_sec": round(

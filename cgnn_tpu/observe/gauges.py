@@ -91,6 +91,36 @@ def padding_gauges(stats) -> list[dict]:
     return out
 
 
+def device_gauges(counters: dict, gauges: dict) -> dict:
+    """Derived health figures for the device-parallel dispatch layer
+    (serve/devices.py, ISSUE 5), from a run's counters/gauges — the
+    ``pipeline_gauges`` analog for the device dimension.
+
+    ``DeviceSet.flush_gauges`` writes the raw per-device names
+    (``device{i}_dispatches`` / ``device{i}_occupancy`` /
+    ``device{i}_window_depth`` plus ``device_count``); this rollup adds:
+
+    - ``devices_active``: devices that dispatched at least one flush —
+      the 8-host-device dryrun's distribution invariant keys on this;
+    - ``device_dispatch_min_share`` / ``device_dispatch_max_share``:
+      each device's share of total dispatches — min near 1/N means the
+      least-loaded router balanced, max near 1 means one chip served
+      everything (the pre-ISSUE-5 shape).
+    """
+    n = int(gauges.get("device_count", 0))
+    if n <= 0:
+        return {}
+    dispatches = [float(gauges.get(f"device{i}_dispatches", 0.0))
+                  for i in range(n)]
+    total = sum(dispatches)
+    out = {"devices_active": float(sum(1 for d in dispatches if d > 0))}
+    if total > 0:
+        shares = [d / total for d in dispatches]
+        out["device_dispatch_min_share"] = min(shares)
+        out["device_dispatch_max_share"] = max(shares)
+    return out
+
+
 def pipeline_gauges(counters: dict, gauges: dict) -> dict:
     """Derived health figures for the parallel ingest pipeline
     (data/pipeline.py), from a run's counters/gauges — the

@@ -23,6 +23,7 @@ import threading
 from typing import Callable, Iterator
 
 from cgnn_tpu.observe.gauges import (
+    device_gauges,
     hbm_gauges,
     padding_gauges,
     pipeline_gauges,
@@ -234,6 +235,7 @@ class Telemetry:
         if scan + per_step > 0:
             gauges["scan_dispatch_share"] = scan / (scan + per_step)
         gauges.update(pipeline_gauges(counters, gauges))
+        gauges.update(device_gauges(counters, gauges))
         if counters or gauges:
             self.logger.event("run_summary", {
                 "counters": counters, "gauges": gauges,

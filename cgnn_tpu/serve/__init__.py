@@ -23,6 +23,7 @@ from cgnn_tpu.serve.batcher import (
     ServeRejection,
 )
 from cgnn_tpu.serve.cache import ResultCache, structure_fingerprint
+from cgnn_tpu.serve.devices import DeviceSet, replicate_state, resolve_devices
 from cgnn_tpu.serve.reload import CheckpointWatcher, ParamStore
 from cgnn_tpu.serve.server import InferenceServer, ServeResult, load_server
 from cgnn_tpu.serve.shapes import BatchShape, ShapeSet, plan_shape_set
@@ -30,6 +31,7 @@ from cgnn_tpu.serve.shapes import BatchShape, ShapeSet, plan_shape_set
 __all__ = [
     "BatchShape",
     "CheckpointWatcher",
+    "DeviceSet",
     "Flush",
     "InferenceServer",
     "MALFORMED",
@@ -47,5 +49,7 @@ __all__ = [
     "TIMEOUT",
     "load_server",
     "plan_shape_set",
+    "replicate_state",
+    "resolve_devices",
     "structure_fingerprint",
 ]

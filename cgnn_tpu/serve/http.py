@@ -156,6 +156,7 @@ def make_handler(server: InferenceServer,
                 "latency_ms": result.latency_ms,
                 "cached": result.cached,
                 "batch_occupancy": result.batch_occupancy,
+                "device_id": result.device_id,
             })
 
     return ServeHandler

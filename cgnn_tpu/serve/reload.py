@@ -30,17 +30,37 @@ from cgnn_tpu.train.checkpoint import CheckpointManager
 
 
 class ParamStore:
-    """Atomic (state, version) holder the serving worker reads per batch."""
+    """Atomic (state, version) holder the serving workers read per batch.
 
-    def __init__(self, state, version: str = "init"):
+    With ``devices`` (ISSUE 5, serve/devices.py) the store holds ONE
+    REPLICA PER DEVICE: ``get(i)`` returns device i's committed copy
+    paired with the single shared version. ``swap`` builds every replica
+    FIRST (the slow part — N device_puts — runs outside the lock, on the
+    watcher thread) and then publishes the whole tuple and the version
+    in one locked assignment, so no reader can ever observe a torn set:
+    every ``get`` sees either all-old or all-new replicas, under exactly
+    one version. In-flight flushes that already read their (state,
+    version) pair keep their dispatch-time replica alive by reference
+    and finish on it — the ISSUE-3 per-batch atomicity, now per-device.
+    """
+
+    def __init__(self, state, version: str = "init", devices=None):
         self._lock = threading.Lock()
-        self._state = state
+        self._devices = tuple(devices) if devices else None
+        self._states = self._replicate(state)
         self._version = version
 
-    def get(self):
-        """-> (state, version), a consistent pair."""
+    def _replicate(self, state) -> tuple:
+        if self._devices is None:
+            return (state,)
+        from cgnn_tpu.serve.devices import replicate_state
+
+        return replicate_state(state, self._devices)
+
+    def get(self, device_index: int = 0):
+        """-> (state replica for ``device_index``, version) — consistent."""
         with self._lock:
-            return self._state, self._version
+            return self._states[device_index], self._version
 
     @property
     def version(self) -> str:
@@ -48,8 +68,11 @@ class ParamStore:
             return self._version
 
     def swap(self, state, version: str) -> None:
+        # replicate OUTSIDE the lock: N device transfers must not stall
+        # every dispatch worker's get() for their duration
+        states = self._replicate(state)
         with self._lock:
-            self._state = state
+            self._states = states
             self._version = version
 
 

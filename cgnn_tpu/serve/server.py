@@ -19,11 +19,15 @@ Request lifecycle::
            precompiled shape — compact raw form when every member can,
            warmed full-fidelity otherwise — into pooled buffers
       dispatch: for each packed flush, in order:
-        -> (state, version) = param_store.get()   # hot-swap boundary
+        -> (state, version) = param_store.get(device)  # hot-swap boundary
         -> predict_step(state, batch) -> device_get
-        -> resolve each future with (row, version, latency)
+        -> resolve each future with (row, version, latency, device_id)
       (so the batcher coalesces flush N+2 while N+1 packs and N runs;
        pack_workers=0 runs the same stages in-line on one thread)
+    with devices > 1 (serve/devices.py, ISSUE 5): a router assigns each
+      packed flush to the least-loaded device and one dispatch thread
+      PER device runs the dispatch stage against that device's param
+      replica — N chips serve concurrently from one batcher
 
 Hot reload safety rides on the ``param_store.get()`` placement: the pair
 is read once per batch, so a watcher swap lands cleanly between batches
@@ -59,6 +63,7 @@ from cgnn_tpu.serve.batcher import (
     ServeRejection,
 )
 from cgnn_tpu.serve.cache import ResultCache, structure_fingerprint
+from cgnn_tpu.serve.devices import DeviceSet, resolve_devices
 from cgnn_tpu.serve.reload import CheckpointWatcher, ParamStore
 from cgnn_tpu.serve.shapes import ShapeSet, plan_shape_set
 
@@ -72,6 +77,10 @@ class ServeResult:
     latency_ms: float
     cached: bool = False
     batch_occupancy: float = 0.0  # real graphs / graph slots of its batch
+    # which device of the set answered (ISSUE 5); -1 for cache hits — no
+    # device computed them, and attributing them to device 0 would skew
+    # client-side per-device accounting on a multi-device server
+    device_id: int = 0
 
 
 class InferenceServer:
@@ -80,7 +89,10 @@ class InferenceServer:
     ``state`` is a restored-for-inference TrainState; ``shape_set`` the
     precompiled ladder (shapes.plan_shape_set). ``predict_step`` defaults
     to ``jax.jit(make_predict_step())`` — inject a pre-jitted one to share
-    its compile cache with an offline predict path.
+    its compile cache with an offline predict path. ``devices`` (a list
+    of jax devices, or None for the backend-aware auto resolution) sets
+    the dispatch fan-out: params replicate per device, flushes route
+    least-loaded, every response records its ``device_id``.
     """
 
     def __init__(
@@ -96,6 +108,7 @@ class InferenceServer:
         default_timeout_ms: float | None = 1000.0,
         cache_size: int = 1024,
         pack_workers: int = 1,
+        devices=None,
         clock: Callable[[], float] = time.monotonic,
         log_fn: Callable = print,
     ):
@@ -105,7 +118,13 @@ class InferenceServer:
         from cgnn_tpu.train.step import make_predict_step
 
         self.shape_set = shape_set
-        self.param_store = ParamStore(state, version)
+        # the device-parallel dispatch layer (serve/devices.py): one
+        # param replica per device, flushes routed least-loaded across
+        # the set; None = the backend-aware 'auto' resolution (all
+        # accelerator devices; single device on CPU backends)
+        self.device_set = DeviceSet(devices)
+        self.param_store = ParamStore(state, version,
+                                      devices=self.device_set.devices)
         # a compact shape set rebuilds GraphBatches INSIDE the compiled
         # program (expander); the same jitted callable still accepts
         # full-fidelity batches — the fallback for non-compactable
@@ -154,35 +173,43 @@ class InferenceServer:
     # ---- warmup ----
 
     def warm(self, template: CrystalGraph) -> int:
-        """Compile every shape in the set; returns the compile count.
+        """Compile every shape in the set ON EVERY DEVICE; returns the
+        program count (traced forms, independent of the device count).
 
         ``template`` is any admissible structure (it provides feature
         dimensionality); each rung is packed with one copy and executed
-        once. A compact set warms BOTH staging forms per rung — the
-        compact fast path and the full-fidelity fallback a flush holding
-        a non-compactable request takes — so the post-warmup compile
-        count is pinned no matter how traffic mixes. Dispatches run
-        under ``telemetry.warmup()`` so compile executions never pollute
+        once per device. A compact set warms BOTH staging forms per rung
+        — the compact fast path and the full-fidelity fallback a flush
+        holding a non-compactable request takes — so the post-warmup
+        compile count is pinned no matter how traffic mixes OR which
+        device a flush lands on: ``len(shape_set) * forms`` traced
+        programs, each built into one executable per device here and
+        NEVER again (devices.py module docstring). Dispatches run under
+        ``telemetry.warmup()`` so compile executions never pollute
         serving counters."""
-        state, _ = self.param_store.get()
         self._feature_dims = (template.atom_fea.shape[1],
                               template.edge_fea.shape[1])
         n0 = self._jit_cache_size()
         programs = 0
         with self.telemetry.warmup():
             for shape in self.shape_set:
+                # pack once per form on the host; each device's replica
+                # pulls the same staged batch through its own executable
                 batch = self.shape_set.pack([template], shape=shape)
-                np.asarray(self.predict_step(state, batch))
-                programs += 1
-                if self.shape_set.compact is not None:
-                    full = self.shape_set.pack_full([template], shape=shape)
-                    np.asarray(self.predict_step(state, full))
-                    programs += 1
+                full = (self.shape_set.pack_full([template], shape=shape)
+                        if self.shape_set.compact is not None else None)
+                for i in range(len(self.device_set)):
+                    state, _ = self.param_store.get(i)
+                    np.asarray(self.predict_step(state, batch))
+                    if full is not None:
+                        np.asarray(self.predict_step(state, full))
+                programs += 1 if full is None else 2
         self.warmed = True
         compiled = (self._jit_cache_size() or 0) - (n0 or 0)
         self._log(
             f"serve: warmed {len(self.shape_set)} shapes / {programs} "
-            f"programs ({compiled} fresh compiles"
+            f"programs on {len(self.device_set)} device(s) "
+            f"({compiled} fresh compiles"
             f"{', compact-staged' if self.shape_set.compact else ''})"
         )
         return compiled
@@ -264,6 +291,9 @@ class InferenceServer:
             self._serve_loop()
             done = True
         self.telemetry.set_gauge("serve_drained_clean", float(done))
+        # per-device occupancy/dispatch gauges -> run_summary (the
+        # observe.gauges.device_gauges rollup reads these names)
+        self.device_set.flush_gauges(self.telemetry)
         return done
 
     # ---- request path ----
@@ -330,6 +360,7 @@ class InferenceServer:
                     fut.set_result(ServeResult(
                         prediction=row, param_version=version,
                         latency_ms=(self._clock() - now) * 1e3, cached=True,
+                        device_id=-1,
                     ))
                     return fut
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
@@ -363,49 +394,29 @@ class InferenceServer:
     # ---- the worker ----
 
     def _serve_loop(self) -> None:
+        if len(self.device_set) > 1:
+            return self._serve_loop_multidev()
         if self._pack_workers > 0:
             return self._serve_loop_pipelined()
         while True:
             flush = self.batcher.next_flush()
             if flush is None:
                 return
-            try:
-                self._process(flush)
-            except Exception as e:  # noqa: BLE001 — fail the flush, not the server
-                self._log(f"serve: batch failed: {e!r}")
-                for r in flush.requests:
-                    if not r.future.done():
-                        r.future.set_error(e)
+            self._process(flush)
 
-    def _serve_loop_pipelined(self) -> None:
-        """The pack-overlapped worker: batcher -> packer pool -> dispatch.
+    def _flushes(self):
+        """The live flush stream: expiries are delivered HERE, before
+        the pack stage, so a timed-out client hears promptly instead of
+        queueing behind the pipeline's in-flight flushes."""
+        while True:
+            flush = self.batcher.next_flush()
+            if flush is None:
+                return
+            self._fail_expired(flush)
+            if flush.requests:
+                yield flush
 
-        ``parallel_pack`` (data/pipeline.py) runs the flush stream
-        through ``_pack_workers`` packer threads with order-restoring
-        reassembly, so while THIS thread dispatches flush N and blocks
-        on its fetch, flush N+1 is already packing and the batcher is
-        coalescing N+2 — packing leaves the dispatch critical path.
-        Order preservation keeps response FIFO fairness. Pack errors are
-        delivered per flush (the poisoned flush fails alone; admission
-        validation makes them unlikely). Pooled staging buffers recycle
-        after the flush's blocking fetch — the device is done with them.
-        """
-        from cgnn_tpu.data.pipeline import BufferPool, parallel_pack
-
-        pool = BufferPool()
-
-        def flushes():
-            while True:
-                flush = self.batcher.next_flush()
-                if flush is None:
-                    return
-                # expiries are delivered HERE, before the pack stage, so
-                # a timed-out client hears promptly instead of queueing
-                # behind the pipeline's in-flight flushes
-                self._fail_expired(flush)
-                if flush.requests:
-                    yield flush
-
+    def _make_pack_one(self, pool):
         def pack_one(flush: Flush):
             t0 = time.perf_counter()
             try:
@@ -418,11 +429,40 @@ class InferenceServer:
                                          time.perf_counter() - t0)
             return flush, batch, buf, err
 
-        stream = iter(parallel_pack(
-            flushes(), pack_one, workers=self._pack_workers,
-            telemetry=self.telemetry, raise_on_error=False,
-            name="cgnn-serve-pack",
-        ))
+        return pack_one
+
+    def _packed_stream(self, pool):
+        """(flush, batch, buf, err) stream: through the parallel pack
+        pipeline when ``pack_workers > 0``, in-line otherwise."""
+        from cgnn_tpu.data.pipeline import parallel_pack
+
+        pack_one = self._make_pack_one(pool)
+        if self._pack_workers > 0:
+            return iter(parallel_pack(
+                self._flushes(), pack_one, workers=self._pack_workers,
+                telemetry=self.telemetry, raise_on_error=False,
+                name="cgnn-serve-pack",
+            ))
+        return map(pack_one, self._flushes())
+
+    def _serve_loop_pipelined(self) -> None:
+        """The single-device pack-overlapped worker: batcher -> packer
+        pool -> dispatch.
+
+        ``parallel_pack`` (data/pipeline.py) runs the flush stream
+        through ``_pack_workers`` packer threads with order-restoring
+        reassembly, so while THIS thread dispatches flush N and blocks
+        on its fetch, flush N+1 is already packing and the batcher is
+        coalescing N+2 — packing leaves the dispatch critical path.
+        Order preservation keeps response FIFO fairness. Pack errors are
+        delivered per flush (the poisoned flush fails alone; admission
+        validation makes them unlikely). Pooled staging buffers recycle
+        after the flush's blocking fetch — the device is done with them.
+        """
+        from cgnn_tpu.data.pipeline import BufferPool
+
+        pool = BufferPool()
+        stream = self._packed_stream(pool)
         while True:
             t0 = time.perf_counter()
             try:
@@ -436,19 +476,70 @@ class InferenceServer:
             # starvation signal; run_summary p50/p95/p99 via series)
             self.telemetry.observe_value("pipeline_wait_s",
                                          time.perf_counter() - t0)
-            flush, batch, buf, err = item
-            try:
-                if err is not None:
-                    raise err
-                self._dispatch_flush(flush, batch)
-            except Exception as e:  # noqa: BLE001 — fail the flush, not the server
-                self._log(f"serve: batch failed: {e!r}")
-                for r in flush.requests:
-                    if not r.future.done():
-                        r.future.set_error(e)
-            finally:
-                if buf is not None:
-                    pool.release(*buf)
+            self._run_flush(*item, pool=pool)
+
+    def _serve_loop_multidev(self) -> None:
+        """The device-parallel worker: batcher -> packer pool -> router
+        -> one dispatch thread PER device (ISSUE 5).
+
+        The router assigns each packed flush to the least-loaded device
+        (DeviceSet.pick: fewest in-flight, round-robin tie-break) and
+        hands it to that device's dispatch thread over a bounded queue —
+        the per-device in-flight window. Each device thread reads its
+        (params, version) replica pair once per flush, dispatches, and
+        BLOCKS on the fetch before touching the next flush, so per
+        device execution is FIFO and a pooled staging buffer is released
+        only after the fetch proves its dispatch completed — the ISSUE-4
+        BufferPool contract, per device. Responses stay FIFO per device;
+        cross-device completion order is whatever the hardware does (the
+        price of using more than one chip).
+        """
+        import queue as queue_mod
+
+        from cgnn_tpu.data.pipeline import BufferPool
+
+        pool = BufferPool()
+        n = len(self.device_set)
+        qs = [queue_mod.Queue(maxsize=self.device_set.window)
+              for _ in range(n)]
+
+        def device_worker(i: int) -> None:
+            while True:
+                item = qs[i].get()
+                if item is None:
+                    return
+                self._run_flush(*item, pool=pool, device=i, routed=True)
+
+        workers = [
+            threading.Thread(target=device_worker, args=(i,), daemon=True,
+                             name=f"cgnn-serve-dev{i}")
+            for i in range(n)
+        ]
+        for t in workers:
+            t.start()
+        stream = self._packed_stream(pool)
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(stream)
+                except StopIteration:
+                    return
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    self._log(f"serve: pack pipeline error: {e!r}")
+                    continue
+                self.telemetry.observe_value("pipeline_wait_s",
+                                             time.perf_counter() - t0)
+                i = self.device_set.pick()
+                # in-flight accounting BEFORE the put so pick() sees the
+                # routed-but-unstarted load of every device
+                self.device_set.note_enqueue(i)
+                qs[i].put(item)
+        finally:
+            for q in qs:
+                q.put(None)
+            for t in workers:
+                t.join()
 
     def _fail_expired(self, flush: Flush) -> None:
         for r in flush.expired:
@@ -487,16 +578,53 @@ class InferenceServer:
         self._fail_expired(flush)
         if not flush.requests:
             return
-        batch, _ = self._pack_flush(flush)
-        self._dispatch_flush(flush, batch)
+        try:
+            batch, buf = self._pack_flush(flush)
+            err = None
+        except Exception as e:  # noqa: BLE001 — fail the flush, not the server
+            batch = buf = None
+            err = e
+        self._run_flush(flush, batch, buf, err, pool=None)
 
-    def _dispatch_flush(self, flush: Flush, batch) -> None:
+    def _run_flush(self, flush: Flush, batch, buf, err, *, pool,
+                   device: int = 0, routed: bool = False) -> None:
+        """Dispatch one packed flush on ``device`` with the shared
+        error/accounting/buffer-release discipline: a failed flush fails
+        alone (its futures get the error, the server keeps serving), the
+        device's in-flight count and busy time are maintained exactly
+        once per flush, and a pooled staging buffer is released only
+        AFTER the blocking fetch inside ``_dispatch_flush`` proved the
+        device consumed it. ``routed`` marks flushes whose enqueue was
+        already counted by the multidev router."""
+        if not routed:
+            self.device_set.note_enqueue(device)
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            if err is not None:
+                raise err
+            self._dispatch_flush(flush, batch, device=device)
+            ok = True
+        except Exception as e:  # noqa: BLE001 — fail the flush, not the server
+            self._log(f"serve: batch failed (device {device}): {e!r}")
+            for r in flush.requests:
+                if not r.future.done():
+                    r.future.set_error(e)
+        finally:
+            self.device_set.note_complete(device,
+                                          time.perf_counter() - t0, ok=ok)
+            if buf is not None and pool is not None:
+                pool.release(*buf)
+
+    def _dispatch_flush(self, flush: Flush, batch, device: int = 0) -> None:
         import jax
 
         reqs = flush.requests
-        # the hot-swap boundary: one consistent (params, version) pair per
-        # batch — a reload landing after this line affects the NEXT batch
-        state, version = self.param_store.get()
+        # the hot-swap boundary: one consistent (params, version) REPLICA
+        # pair per batch, read from the dispatch device's slot — a reload
+        # landing after this line affects the NEXT batch; this one keeps
+        # its dispatch-time replica alive by reference and finishes on it
+        state, version = self.param_store.get(device)
         pre = self._jit_cache_size()
         out = np.asarray(jax.device_get(self.predict_step(state, batch)))
         post = self._jit_cache_size()
@@ -512,6 +640,7 @@ class InferenceServer:
             )
         now = self._clock()
         occupancy = len(reqs) / flush.shape.graph_cap
+        self._count(f"batches_device{device}")
         for i, r in enumerate(reqs):
             row = out[i].copy()
             latency_ms = (now - r.enqueued) * 1e3
@@ -520,6 +649,7 @@ class InferenceServer:
             r.future.set_result(ServeResult(
                 prediction=row, param_version=version,
                 latency_ms=latency_ms, batch_occupancy=occupancy,
+                device_id=device,
             ))
             self._record_latency(latency_ms)
             # per REQUEST, not per batch: the run-summary quantiles must
@@ -564,6 +694,7 @@ class InferenceServer:
             "counts": counts,
             "queue_depth": self.batcher.depth,
             "param_version": self.param_store.version,
+            "devices": self.device_set.stats(),
             "draining": self._draining,
             "latency_ms": self.latency_quantiles(),
             "batch_occupancy_mean": float(np.mean(occ)) if occ else 0.0,
@@ -610,6 +741,7 @@ def load_server(
     cache_size: int = 1024,
     compact: str = "auto",
     pack_workers: int | None = None,
+    devices: str | int = "auto",
     watch: bool = True,
     poll_interval_s: float = 2.0,
     log_fn: Callable = print,
@@ -636,6 +768,14 @@ def load_server(
     ``None`` follows the same device rule — 1 on accelerators (pack
     overlaps remote dispatch), 0 on CPU (an overlap thread only steals
     cores from the compute it would overlap with).
+
+    ``devices`` (ISSUE 5) selects the dispatch set: ``'auto'`` = every
+    local device on accelerator backends, one device on CPU (host
+    "devices" share the same cores — serve/devices.py); an int forces
+    that many anywhere, which is how the 8-host-device dryrun proves
+    distribution in-container. With more than one device, params are
+    replicated per device, flushes route least-loaded, and hot reload
+    swaps all replicas atomically under one version.
 
     -> (server, dict of the bits callers reuse: manager, meta, configs,
     template graph, the calibration sample).
@@ -671,8 +811,13 @@ def load_server(
     edge_dtype = (jax.numpy.bfloat16 if model_cfg.dtype == "bfloat16"
                   else np.float32)
     on_accelerator = jax.default_backend() != "cpu"
+    device_list = resolve_devices(devices)
     if pack_workers is None:
-        pack_workers = 1 if on_accelerator else 0
+        # accelerators overlap packing with remote dispatch; on CPU an
+        # overlap thread steals the cores it would overlap with — but a
+        # FORCED multi-device set (the dryrun case) gets one packer so
+        # the router + per-device dispatch threads are actually fed
+        pack_workers = 1 if on_accelerator or len(device_list) > 1 else 0
     want_compact = (compact == "on"
                     or (compact == "auto" and on_accelerator))
     compact_spec = None
@@ -708,7 +853,7 @@ def load_server(
         state, shape_set, version=version, telemetry=telemetry,
         max_queue=max_queue, max_wait_ms=max_wait_ms,
         default_timeout_ms=default_timeout_ms, cache_size=cache_size,
-        pack_workers=pack_workers, log_fn=log_fn,
+        pack_workers=pack_workers, devices=device_list, log_fn=log_fn,
     )
     server.warm(template)
     if watch:

@@ -104,6 +104,7 @@ def run_fast_inference(
     shape_set=None,
     compact=None,
     pack_workers: int = 0,
+    devices: Sequence | None = None,
     telemetry=None,
 ) -> tuple[np.ndarray, float]:
     """Predict over ``graphs`` -> ([n, T] predictions in input order,
@@ -125,6 +126,16 @@ def run_fast_inference(
     ``pack_workers > 0`` packs batches on that many pipeline threads
     (data/pipeline.py) overlapping the dispatch loop; ``0`` packs
     serially on the calling thread (identical outputs, pinned by test).
+
+    ``devices`` (ISSUE 5; e.g. ``serve.devices.resolve_devices('auto')``)
+    round-robins the windowed dispatch across that many device replicas
+    of ``state``: batch k runs on device k % N, each device keeps its own
+    in-flight window with its own value-fetch fence (FIFO per device, so
+    the buffer-pool release contract carries over per device), and the
+    final collection does ONE stacked fetch per (compiled shape, device).
+    Outputs are BIT-identical to the single-device path over identical
+    batches (same packing plan, same program — pinned by test); ``None``
+    keeps the single-device dispatch loop.
     """
     if not len(graphs):
         raise ValueError("no graphs to predict")
@@ -143,41 +154,60 @@ def run_fast_inference(
     preds: np.ndarray | None = None
     t0 = time.perf_counter()
 
-    # (shape key -> [(span, out)]) so the single stacked fetch groups by
-    # compiled shape; spans restore input order on the host afterwards
-    outs_by_shape: dict = {}
-    recent: list = []
-    # compact staging buffers in dispatch order; an entry is released to
-    # the pool once the window fence proves its dispatch completed
-    pool = BufferPool() if compact is not None else None
-    pending: list = []
+    # device replicas: batch k dispatches against states[k % n_dev] — the
+    # replica is committed to its device, the staged batch is uncommitted
+    # host memory, so computation follows the params to the right chip
+    # with no explicit placement per dispatch (serve/devices.py)
+    if devices is not None and len(devices):
+        from cgnn_tpu.serve.devices import replicate_state
 
-    def _release_fenced():
-        # the fence blocked on the FIRST dispatch of the closing window:
-        # everything dispatched before it completed (FIFO per device), so
-        # all but the window's remaining _WINDOW - 1 dispatches are safe
-        safe = len(pending) - (_WINDOW - 1)
+        states = replicate_state(state, devices)
+    else:
+        states = (state,)
+    n_dev = len(states)
+    dispatched = [0]
+
+    # ((shape key, device) -> [(span, out)]) so the single stacked fetch
+    # groups by compiled shape AND by the device holding the outputs;
+    # spans restore input order on the host afterwards
+    outs_by_shape: dict = {}
+    recent: list[list] = [[] for _ in range(n_dev)]
+    # compact staging buffers in per-device dispatch order; an entry is
+    # released to the pool once ITS device's window fence proves its
+    # dispatch completed (execution is FIFO per device, not across them)
+    pool = BufferPool() if compact is not None else None
+    pending: list[list] = [[] for _ in range(n_dev)]
+
+    def _release_fenced(di):
+        # the fence blocked on the FIRST dispatch of device di's closing
+        # window: everything dispatched before it on THAT device
+        # completed (FIFO per device), so all but the window's remaining
+        # _WINDOW - 1 dispatches are safe
+        safe = len(pending[di]) - (_WINDOW - 1)
         if safe > 0:
-            for item in pending[:safe]:
+            for item in pending[di][:safe]:
                 if item is not None:
                     pool.release(*item)
-            del pending[:safe]
+            del pending[di][:safe]
 
     def _dispatch(span, batch, key, buf=None):
-        out = predict_step(state, batch)
-        outs_by_shape.setdefault(key, []).append((span, out))
-        recent.append(out)
+        di = dispatched[0] % n_dev  # round-robin across the device set
+        dispatched[0] += 1
+        out = predict_step(states[di], batch)
+        outs_by_shape.setdefault((key, di), []).append((span, out))
+        recent[di].append(out)
         if pool is not None:
-            pending.append(buf)
-        if len(recent) == _WINDOW:
+            pending[di].append(buf)
+        if len(recent[di]) == _WINDOW:
             # true fence (block_until_ready returns early on tunneled
             # runtimes) on the OLDEST in-window result: proves everything
-            # dispatched before it finished — bounding staged-batch HBM —
-            # while the newer _WINDOW-1 dispatches stay in flight
-            float(recent[0][0, 0])
-            del recent[:]
+            # dispatched before it ON THIS DEVICE finished — bounding
+            # staged-batch HBM per chip — while the newer _WINDOW-1
+            # dispatches stay in flight
+            float(recent[di][0][0, 0])
+            del recent[di][:]
             if pool is not None:
-                _release_fenced()
+                _release_fenced(di)
 
     if shape_set is not None:
         def pack_job(job):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from typing import Callable, Iterable, Sequence
 
@@ -330,6 +331,43 @@ class PackOncePlan:
         return (self._train[i] for i in order), iter(self._val)
 
 
+class PendingPairMetrics:
+    """A deferred epoch-pair sums fetch running on a background thread
+    (ISSUE 5 satellite: SCAN_COST r5 measured ``pair_fetch_s`` at
+    224.9 ms of a 256 ms bench-scale epoch — almost all of it the fetch
+    WAITING for the epoch's in-flight compute, during which the host sat
+    idle instead of dispatching the next epoch).
+
+    ``result()`` joins the thread and returns ``(train_means,
+    val_means)`` — the exact values the synchronous path computes, from
+    the exact same ``fetch_device_sums`` call (bit-identical, pinned by
+    test); an exception from the fetch re-raises at the join."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+        self._out = None
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="cgnn-pair-fetch"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            self._out = self._fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised at result()
+            self._err = e
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self):
+        self._thread.join()
+        if self._err is not None:
+            raise self._err
+        return self._out
+
+
 class ScanEpochDriver:
     """Whole-epoch dispatch for device-resident datasets: one ``lax.scan``
     per bucket shape per epoch instead of one dispatch per step.
@@ -607,10 +645,14 @@ class ScanEpochDriver:
             self.run_epoch_pair(scratch, first=True)
         return state
 
-    def _drive(self, state: TrainState, groups, scans, body, train, first):
+    def _drive(self, state: TrainState, groups, scans, body, train, first,
+               prebuild: bool = True):
         """Dispatch one epoch; returns (state, device_sums, steps) WITHOUT
         fetching — callers combine/fetch sums (run_epoch_pair: one link
-        sync for train+eval; train_epoch/eval_epoch: per-phase fetch)."""
+        sync for train+eval; train_epoch/eval_epoch: per-phase fetch).
+        ``prebuild=False`` defers the next-epoch schedule prebuild to the
+        caller (run_epoch_pair's async-fetch mode overlaps it with the
+        background sums fetch instead)."""
         t_drive0 = time.perf_counter()
         sched_key = (id(groups), train, first)
         if train:
@@ -689,7 +731,7 @@ class ScanEpochDriver:
         # along the in-flight work instead of stalling the next epoch's
         # first scan. (If the run ends here the prebuild is unused — a few
         # rng draws consumed in the same order a further epoch would have.)
-        if train and not self.aborted:
+        if train and not self.aborted and prebuild:
             self._sched_cache[(id(groups), True, False)] = \
                 self._build_sched(groups, True, False)
         t_prebuild = time.perf_counter()
@@ -726,7 +768,8 @@ class ScanEpochDriver:
         )
         return means_from_sums(fetch_device_sums(dev_sums), steps)
 
-    def run_epoch_pair(self, state: TrainState, first: bool):
+    def run_epoch_pair(self, state: TrainState, first: bool,
+                       async_fetch: bool = False):
         """Train epoch + eval epoch with ONE link sync for both.
 
         Each fetch on a high-latency link stalls the device for a full
@@ -735,12 +778,25 @@ class ScanEpochDriver:
         can be enqueued before the train sums are ever fetched —
         halving the per-epoch sync count. -> (state, train_means,
         val_means).
+
+        ``async_fetch=True`` (ISSUE 5 satellite) returns ``(state,
+        PendingPairMetrics)`` instead: the sums fetch — SCAN_COST r5's
+        ``pair_fetch_s``, 224.9 ms of a 256 ms bench epoch, almost all
+        of it waiting for the epoch's in-flight compute — runs on a
+        background thread while the caller keeps dispatching (the next
+        epoch's first scans in ``fit``), and the next-epoch schedule
+        prebuild moves AFTER the fetch thread starts so it overlaps the
+        wait too. The rng draw ORDER is unchanged (train draws, then
+        prebuild draws; eval consumes none in between), so schedules,
+        trajectories, and the fetched metrics are bit-identical to the
+        synchronous path — pinned by test.
         """
         self.aborted = False
         self.eval_truncated = False
         state, tr_sums, tr_steps = self._drive(
             state, self._train_groups, self._train_scans,
             self._train_body, train=True, first=first,
+            prebuild=not async_fetch,
         )
         train_aborted = self.aborted
         ev_sums, ev_steps = None, 0
@@ -762,14 +818,30 @@ class ScanEpochDriver:
             self.aborted = train_aborted
         combined = {f"t:{k}": v for k, v in (tr_sums or {}).items()}
         combined |= {f"e:{k}": v for k, v in (ev_sums or {}).items()}
-        t0 = time.perf_counter()
-        fetched = fetch_device_sums(combined or None)
-        self.timings["pair_fetch_s"] = self.timings.get(
-            "pair_fetch_s", 0.0) + (time.perf_counter() - t0)
-        tr = {k[2:]: v for k, v in fetched.items() if k.startswith("t:")}
-        ev = {k[2:]: v for k, v in fetched.items() if k.startswith("e:")}
-        return (state, means_from_sums(tr, tr_steps),
-                means_from_sums(ev, ev_steps))
+
+        def fetch_pair():
+            t0 = time.perf_counter()
+            fetched = fetch_device_sums(combined or None)
+            self.timings["pair_fetch_s"] = self.timings.get(
+                "pair_fetch_s", 0.0) + (time.perf_counter() - t0)
+            tr = {k[2:]: v for k, v in fetched.items()
+                  if k.startswith("t:")}
+            ev = {k[2:]: v for k, v in fetched.items()
+                  if k.startswith("e:")}
+            return (means_from_sums(tr, tr_steps),
+                    means_from_sums(ev, ev_steps))
+
+        if not async_fetch:
+            train_m, val_m = fetch_pair()
+            return state, train_m, val_m
+        pending = PendingPairMetrics(fetch_pair)
+        # the deferred prebuild (see _drive): schedule + stage the next
+        # train epoch while the fetch thread blocks on this epoch's
+        # in-flight compute. Same rng draws, same order as the sync path.
+        if not train_aborted:
+            self._sched_cache[(id(self._train_groups), True, False)] = \
+                self._build_sched(self._train_groups, True, False)
+        return state, pending
 
 
 def fit(
@@ -1029,14 +1101,86 @@ def fit(
     )
     telemetry.observe_padding(pad_stats)
     preempted = False
+
+    def finish_epoch(epoch, train_m, val_m, eval_truncated, t0):
+        """Epoch bookkeeping that needs the fetched metrics (best
+        tracking, history, logging, the metrics hook) — shared by the
+        synchronous path and the deferred async-fetch path, which runs
+        it one epoch late, after the NEXT epoch's dispatches are already
+        in flight. Returns is_best."""
+        nonlocal best
+        if epoch == start_epoch:
+            log_fn(pad_stats.summary())
+        metric = val_m.get(best_key, np.nan)
+        is_best = metric > best if classification else metric < best
+        if eval_truncated:
+            # preemption cut eval short: the metric covers a fraction of
+            # the validation set — never let it repoint 'best'
+            is_best = False
+        if is_best:
+            best = metric
+        history.append({"epoch": epoch, "train": train_m, "val": val_m})
+        log_fn(
+            f"Epoch {epoch}: train loss {train_m.get('loss', np.nan):.4f}"
+            f"  val {best_key} {metric:.4f}{' *' if is_best else ''}"
+            f"  ({time.perf_counter() - t0:.1f}s)"
+        )
+        if on_epoch_metrics is not None:
+            on_epoch_metrics(epoch, train_m, val_m)
+        return is_best
+
+    # ISSUE 5 satellite: the epoch-pair sums fetch (SCAN_COST r5:
+    # pair_fetch_s 224.9 ms of a 256 ms bench epoch) moves to a
+    # background thread whenever the divergence monitor doesn't need the
+    # sums before proceeding (--guard rollback). Full one-epoch-deep
+    # overlap — epoch N's fetch runs while epoch N+1's scans dispatch —
+    # additionally requires no epoch-end checkpoint consumer: the save
+    # needs (state, metrics) together at the boundary, and the state is
+    # donated into the next epoch's first scan the moment it dispatches.
+    # With a consumer, the fetch thread still overlaps the next-epoch
+    # schedule prebuild and is joined in-iteration (metrics bit-identical
+    # either way, pinned by test).
+    async_pair = driver is not None and monitor is None
+    defer_pair = async_pair and on_epoch_end is None and preempt is None
+    pending_prev: tuple | None = None  # (epoch, pending, eval_trunc, t0)
+
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         if driver is not None:
             with telemetry.span("epoch", epoch=epoch, driver="scan"):
-                state, train_m, val_m = driver.run_epoch_pair(
-                    state, first=epoch == start_epoch
-                )
-            if driver.aborted:
+                if async_pair:
+                    state, pending = driver.run_epoch_pair(
+                        state, first=epoch == start_epoch, async_fetch=True
+                    )
+                else:
+                    state, train_m, val_m = driver.run_epoch_pair(
+                        state, first=epoch == start_epoch
+                    )
+            aborted, eval_trunc = driver.aborted, driver.eval_truncated
+            if defer_pair:
+                if pending_prev is not None:
+                    # epoch N-1's fetch ran while epoch N's dispatches
+                    # were enqueued; resolve + bookkeep it now, with the
+                    # device already streaming into epoch N
+                    p_epoch, p_pending, p_trunc, p_t0 = pending_prev
+                    tm, vm = p_pending.result()
+                    finish_epoch(p_epoch, tm, vm, p_trunc, p_t0)
+                    pending_prev = None
+                if aborted:
+                    # defensive only — defer_pair requires preempt=None,
+                    # and the driver sets aborted solely from a preempt
+                    # poll. Mirror the sync path: the partial epoch's
+                    # metrics are DROPPED (never queued for bookkeeping)
+                    save_preempted_mid_epoch(state, epoch, on_epoch_end,
+                                             log_fn)
+                    preempted = True
+                    break
+                pending_prev = (epoch, pending, eval_trunc, t0)
+                faultinject.maybe_sigterm(epoch)
+                continue
+            if async_pair:
+                train_m, val_m = pending.result()
+            if aborted:
                 save_preempted_mid_epoch(state, epoch, on_epoch_end, log_fn)
                 preempted = True
                 break
@@ -1073,30 +1217,22 @@ def fit(
                     log_fn=log_fn,
                     telemetry=telemetry,
                 )
-        if epoch == start_epoch:
-            log_fn(pad_stats.summary())
-        metric = val_m.get(best_key, np.nan)
-        is_best = metric > best if classification else metric < best
-        if driver is not None and driver.eval_truncated:
-            # preemption cut eval short: the metric covers a fraction of
-            # the validation set — never let it repoint 'best'
-            is_best = False
-        if is_best:
-            best = metric
-        history.append({"epoch": epoch, "train": train_m, "val": val_m})
-        log_fn(
-            f"Epoch {epoch}: train loss {train_m.get('loss', np.nan):.4f}"
-            f"  val {best_key} {metric:.4f}{' *' if is_best else ''}"
-            f"  ({time.perf_counter() - t0:.1f}s)"
+        is_best = finish_epoch(
+            epoch, train_m, val_m,
+            driver is not None and driver.eval_truncated, t0,
         )
-        if on_epoch_metrics is not None:
-            on_epoch_metrics(epoch, train_m, val_m)
         state, _, preempted = resilience_epoch_end(
             state, epoch, train_m, val_m, is_best, monitor=monitor,
             on_epoch_end=on_epoch_end, preempt=preempt, log_fn=log_fn,
         )
         if preempted:
             break
+    if pending_prev is not None:
+        # the deferred path's final epoch: nothing overlaps its fetch —
+        # resolve and bookkeep it before reporting the run
+        p_epoch, p_pending, p_trunc, p_t0 = pending_prev
+        tm, vm = p_pending.result()
+        finish_epoch(p_epoch, tm, vm, p_trunc, p_t0)
     out = {"best": best, "history": history}
     if preempted:
         out["preempted"] = True

@@ -59,6 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "'auto' engages on accelerator backends when the "
                         "dataset probes stageable, falling back to "
                         "full-fidelity staging otherwise")
+    p.add_argument("--devices", default="auto", metavar="{auto,N}",
+                   help="device-parallel dispatch (serve/devices.py): "
+                        "round-robin the windowed dispatch over this many "
+                        "local devices. 'auto' = all devices on "
+                        "accelerator backends, one on CPU (host 'devices' "
+                        "share the same cores); an integer forces")
     p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache",
                    metavar="DIR", help="persistent XLA compile cache "
                                        "('' disables)")
@@ -136,8 +142,11 @@ def _run(args, mgr) -> int:
     from cgnn_tpu.train.infer import run_fast_inference
     from cgnn_tpu.train.loop import capacities_for
 
+    from cgnn_tpu.serve.devices import resolve_devices
+
     if args.pack_workers is None:
         args.pack_workers = 4 if jax.default_backend() != "cpu" else 0
+    devices = resolve_devices(args.devices)
     tag = "best" if args.best else "latest"
     if not mgr.exists(tag):
         print(f"no '{tag}' checkpoint under {args.ckpt_dir}", file=sys.stderr)
@@ -242,10 +251,11 @@ def _run(args, mgr) -> int:
             dense_m=layout_m, snug=snug, edge_dtype=edge_dtype,
             compact=_probe_compact(args, graphs, data_cfg, layout_m,
                                    edge_dtype),
-            pack_workers=args.pack_workers,
+            pack_workers=args.pack_workers, devices=devices,
         )
         print(f"inference throughput: {rate:.0f} structures/sec "
-              f"(dispatch-pipelined, single fetch per bucket)")
+              f"(dispatch-pipelined, single fetch per bucket, "
+              f"{len(devices)} device(s))")
     else:
         # default: pack into the serving shape ladder (serve.shapes) —
         # compile count pinned at --rungs, and shared with an online
@@ -262,12 +272,13 @@ def _run(args, mgr) -> int:
         )
         preds, rate = run_fast_inference(
             state, graphs, args.batch_size, shape_set=shape_set,
-            pack_workers=args.pack_workers,
+            pack_workers=args.pack_workers, devices=devices,
         )
         print(f"inference throughput: {rate:.0f} structures/sec "
               f"(dispatch-pipelined, {len(shape_set)}-rung shape ladder, "
               f"{'compact' if shape_set.compact else 'full'}-staged, "
-              f"{args.pack_workers} pack workers)")
+              f"{args.pack_workers} pack workers, "
+              f"{len(devices)} device(s))")
     if not force_task:
         for g, p in zip(graphs, preds):
             rows.append(

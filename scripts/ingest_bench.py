@@ -49,6 +49,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--repeats", type=int, default=3,
                    help="timed rounds per metric (best is reported)")
+    p.add_argument("--devices", default="auto",
+                   help="device-parallel e2e leg (ISSUE 5): 'auto' or a "
+                        "forced count (the CI 8-host-device dryrun)")
     return p
 
 
@@ -158,9 +161,26 @@ def main(argv=None) -> int:
     # edge features); a mismatch is a correctness bug, not a perf number
     np.testing.assert_allclose(preds, serial_preds, rtol=1e-4, atol=1e-4)
 
+    # dispatch-side guard (ISSUE 5): the device-parallel e2e leg — same
+    # ladder/step, round-robined over the device set. Bit-exact vs the
+    # single-device run over identical batches, or the guard fails.
+    from cgnn_tpu.serve.devices import resolve_devices
+
+    devices = resolve_devices(args.devices)
+    mkw = dict(kw, devices=devices)
+    mdev_preds, _ = run_fast_inference(state, graphs, args.batch_size,
+                                       **mkw)
+    mdev_e2e = max(
+        run_fast_inference(state, graphs, args.batch_size, **mkw)[1]
+        for _ in range(args.repeats)
+    )
+    np.testing.assert_array_equal(preds, mdev_preds)
+
     print(json.dumps({
         "pack_structs_per_sec": round(args.n / pack_s, 1),
         "e2e_structs_per_sec": round(e2e, 1),
+        "e2e_multidev_structs_per_sec": round(mdev_e2e, 1),
+        "inference_devices": len(devices),
         "bytes_staged": int(bytes_staged),
         "serial_pack_structs_per_sec": round(args.n / serial_pack_s, 1),
         "serial_e2e_structs_per_sec": round(serial_e2e, 1),

@@ -69,6 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="server pack pipeline threads (0 = in-line pack, "
                         "the pre-ISSUE-4 worker; default follows the "
                         "backend like --compact auto)")
+    p.add_argument("--devices", default="auto", metavar="{auto,N}",
+                   help="device-parallel dispatch set (ISSUE 5): 'auto' "
+                        "= all local devices on accelerators, one on "
+                        "CPU; an integer forces that many anywhere. "
+                        "With a forced N > 1 the loadgen HARD-ASSERTS "
+                        "that every device answered responses")
     p.add_argument("--max-wait-ms", type=float, default=5.0)
     p.add_argument("--max-queue", type=int, default=4096)
     p.add_argument("--report", default="slo_report.json")
@@ -144,6 +150,10 @@ class _ClientStats:
         self.rejected: dict[str, int] = {}
         self.dropped = 0
         self.errors: list[str] = []
+        self.device_responses: dict[int, int] = {}
+        # device_id -> param versions it answered with (the per-device
+        # hot-swap consistency record)
+        self.device_versions: dict[int, set] = {}
 
 
 def _run_inproc(args) -> dict:
@@ -163,6 +173,7 @@ def _run_inproc(args) -> dict:
         max_wait_ms=args.max_wait_ms,
         compact=args.compact,
         pack_workers=args.pack_workers,
+        devices=args.devices,
         default_timeout_ms=args.timeout_ms,
         cache_size=0,  # the loadgen reuses structures; caching would
                        # let most requests skip the batcher under test
@@ -211,6 +222,13 @@ def _run_inproc(args) -> dict:
                 stats.latencies.append(res.latency_ms)
                 stats.versions[res.param_version] = (
                     stats.versions.get(res.param_version, 0) + 1
+                )
+                di = getattr(res, "device_id", 0)
+                stats.device_responses[di] = (
+                    stats.device_responses.get(di, 0) + 1
+                )
+                stats.device_versions.setdefault(di, set()).add(
+                    res.param_version
                 )
                 if res.cached:
                     stats.cached += 1
@@ -268,6 +286,18 @@ def _run_inproc(args) -> dict:
             float(np.mean(stats.occupancies)) if stats.occupancies else 0.0
         ),
         "param_versions": stats.versions,
+        "devices": {
+            "requested": str(args.devices),
+            "count": len(server.device_set),
+            "responses_by_device": {
+                str(k): v
+                for k, v in sorted(stats.device_responses.items())
+            },
+            "versions_by_device": {
+                str(k): sorted(v)
+                for k, v in sorted(stats.device_versions.items())
+            },
+        },
         "hot_swap": {
             "requested": bool(args.hot_swap),
             "swapped_to": swapped_to,
@@ -398,16 +428,35 @@ def main(argv=None) -> int:
                 f"expected responses from both param versions, saw "
                 f"{versions}"
             )
+    if not args.http and args.devices != "auto" and int(args.devices) > 1:
+        # forced multi-device dryrun (ISSUE 5): distribution is a HARD
+        # invariant — a device that answered nothing under sustained
+        # load means the router (or the replica set) is broken
+        dev = report["devices"]
+        want = int(args.devices)
+        if dev["count"] != want:
+            failures.append(
+                f"requested {want} devices, server resolved {dev['count']}"
+            )
+        silent = [i for i in range(want)
+                  if not dev["responses_by_device"].get(str(i))]
+        if silent:
+            failures.append(
+                f"devices {silent} answered no responses under load "
+                f"(distribution broken: {dev['responses_by_device']})"
+            )
     report["failures"] = failures
     with open(args.report, "w") as f:
         json.dump(report, f, indent=1)
     lat = report["latency_ms"]
+    dev = report.get("devices", {})
     print(
         f"[{report['mode']}] {report['answered']}/{report['submitted']} "
         f"answered @ {report['throughput_rps']} rps | p50 "
         f"{lat['p50']:.1f} ms p99 {lat['p99']:.1f} ms | occupancy "
         f"{report.get('batch_occupancy_mean', 0):.2f} | versions "
-        f"{report['param_versions']} | report -> {args.report}"
+        f"{report['param_versions']} | devices "
+        f"{dev.get('responses_by_device', {})} | report -> {args.report}"
     )
     if failures:
         print("SLO INVARIANT FAILURES: " + "; ".join(failures),

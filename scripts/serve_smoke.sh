@@ -6,6 +6,9 @@
 #     committed mid-load -> the loadgen itself asserts ZERO dropped
 #     responses, ZERO recompiles after warmup, and responses observed
 #     from BOTH param versions (exit non-zero otherwise);
+#     1b forces the compact+pipelined ingest (ISSUE 4); 1c forces the
+#     device-parallel dispatch layer across 8 virtual host devices
+#     (ISSUE 5: distribution + per-replica swap consistency);
 #  2. HTTP front-end: start serve.py, wait for /healthz, fire concurrent
 #     HTTP requests, then SIGTERM -> the server must drain gracefully
 #     (queued requests answered) and exit 0.
@@ -58,6 +61,34 @@ assert r["server_stats"]["counts"].get("pack_compact", 0) > 0, (
     r["server_stats"]["counts"])
 print("leg 1b ok:", r["answered"], "answered @", r["throughput_rps"],
       "rps under compact+pipelined ingest")
+EOF
+
+echo "== leg 1c: device-parallel dispatch, 8 host devices (ISSUE 5) =="
+# the MULTICHIP dryrun pattern: 8 virtual CPU devices + a FORCED
+# --devices 8 ('auto' is deliberately single-device on CPU backends).
+# Hard invariants: zero drops, zero recompiles after the N-device warmup
+# (compile count = shapes x forms x 8, all at warmup), EVERY device
+# answers responses, and a mid-load hot swap serves both param versions
+# with each response's version consistent with its replica.
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --clients 64 --duration 6 --hot-swap --devices 8 \
+  --report "$WORK/slo_multidev.json"
+python - "$WORK/slo_multidev.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["dropped"] == 0, r
+assert r["compiles"]["after_warm"] == 0, r["compiles"]
+assert not r["failures"], r["failures"]
+dev = r["devices"]
+assert dev["count"] == 8, dev
+silent = [i for i in range(8)
+          if not dev["responses_by_device"].get(str(i))]
+assert not silent, f"devices {silent} answered nothing: {dev}"
+assert len(r["param_versions"]) >= 2, r["param_versions"]
+print("leg 1c ok:", r["answered"], "answered across", dev["count"],
+      "devices", dev["responses_by_device"], "- swap versions",
+      list(r["param_versions"]))
 EOF
 
 echo "== leg 2: HTTP front-end + graceful SIGTERM drain =="

@@ -44,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pack pipeline threads between batcher and "
                         "dispatch (0 = in-line; default follows the "
                         "backend like --compact auto)")
+    p.add_argument("--devices", default="auto", metavar="{auto,N}",
+                   help="device-parallel dispatch set (serve/devices.py): "
+                        "'auto' = all local devices on accelerator "
+                        "backends, one on CPU; an integer forces that "
+                        "many anywhere (the 8-host-device dryrun)")
     p.add_argument("--poll-interval", type=float, default=2.0,
                    help="hot-reload checkpoint poll seconds (0 disables)")
     p.add_argument("--calibrate", type=int, default=256,
@@ -105,6 +110,7 @@ def main(argv=None) -> int:
             cache_size=args.cache_size,
             compact=args.compact,
             pack_workers=args.pack_workers,
+            devices=args.devices,
             watch=args.poll_interval > 0,
             poll_interval_s=args.poll_interval or 2.0,
         )
@@ -129,7 +135,8 @@ def main(argv=None) -> int:
         for s in server.shape_set
     )
     print(f"serving on http://{args.host}:{args.port} "
-          f"(params {server.param_store.version}; shapes {shapes})")
+          f"(params {server.param_store.version}; shapes {shapes}; "
+          f"{len(server.device_set)} device(s))")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -235,6 +235,131 @@ def test_fast_inference_compact_ladder_pins_compiles():
     assert pstep._cache_size() == len(ladder)  # zero fresh traces
 
 
+def test_fast_inference_multidev_bit_exact_vs_single():
+    """ISSUE 5: round-robining the windowed dispatch across the 8
+    virtual devices is a pure placement change — identical batches
+    through the identical program give BIT-identical outputs vs the
+    single-device loop, across the ladder+compact path and the legacy
+    multi-bucket full-fidelity path (ragged 157-graph tail, input-order
+    restoration), with and without the parallel pack pipeline. (The
+    full-fidelity LADDER form is covered per-device by the serve warmup
+    tests and the buffer-fence stress below.)"""
+    from cgnn_tpu.data.compact import CompactSpec, make_expander
+    from cgnn_tpu.serve.shapes import plan_shape_set
+
+    devices = jax.devices()
+    assert len(devices) == 8  # conftest forces the 8-device CPU mesh
+    graphs = load_synthetic_mp(157, CFG, seed=9)
+    state = _tiny_state(graphs)
+    spec = CompactSpec.build(graphs, CFG.gdf(), dense_m=12)
+    ladder = plan_shape_set(graphs, 32, rungs=2, dense_m=12, compact=spec)
+    pstep = jax.jit(make_predict_step(make_expander(spec)))
+
+    single, _ = run_fast_inference(state, graphs, 32, shape_set=ladder,
+                                   predict_step=pstep, pack_workers=0)
+    multi, _ = run_fast_inference(state, graphs, 32, shape_set=ladder,
+                                  predict_step=pstep, pack_workers=3,
+                                  devices=devices)
+    np.testing.assert_array_equal(single, multi)
+
+    # legacy multi-bucket path: full-fidelity packing, input-order
+    # restoration across buckets under the round-robin
+    bsingle, _ = run_fast_inference(state, graphs, 32, buckets=3,
+                                    dense_m=12, snug=True,
+                                    predict_step=pstep)
+    bmulti, _ = run_fast_inference(state, graphs, 32, buckets=3,
+                                   dense_m=12, snug=True,
+                                   predict_step=pstep, devices=devices)
+    np.testing.assert_array_equal(bsingle, bmulti)
+
+
+def test_fast_inference_multidev_trace_count_independent_of_devices():
+    """The ISSUE-5 compile pin: the number of TRACED programs is
+    len(shape_set) x staging forms, independent of the device count (the
+    jit trace cache keys on abstract values, not devices); XLA builds
+    one executable per (program, device) at the first multidev pass and
+    a second pass adds NOTHING."""
+    from cgnn_tpu.data.compact import CompactSpec, make_expander
+    from cgnn_tpu.serve.shapes import plan_shape_set
+
+    devices = jax.devices()
+    graphs = load_synthetic_mp(157, CFG, seed=9)
+    state = _tiny_state(graphs)
+    spec = CompactSpec.build(graphs, CFG.gdf(), dense_m=12)
+    ladder = plan_shape_set(graphs, 32, rungs=2, dense_m=12, compact=spec)
+    base = make_predict_step(make_expander(spec))
+
+    def counting_jit():
+        traces = [0]
+
+        def counting_body(state, batch):
+            traces[0] += 1  # runs once per TRACE, never per execution
+            return base(state, batch)
+
+        return jax.jit(counting_body), traces
+
+    p1, t1 = counting_jit()
+    want, _ = run_fast_inference(state, graphs, 32, shape_set=ladder,
+                                 predict_step=p1)
+    p8, t8 = counting_jit()
+    got, _ = run_fast_inference(state, graphs, 32, shape_set=ladder,
+                                predict_step=p8, devices=devices)
+    # THE pin: the 8-device run traces exactly what the single-device
+    # run traces (one program per dispatched shape — never per device)
+    assert t8[0] == t1[0] >= 1
+    assert t8[0] <= len(ladder)
+    executables = p8._cache_size()
+    assert executables <= t8[0] * len(devices)
+    # a second full pass must add neither traces nor executables
+    again, _ = run_fast_inference(state, graphs, 32, shape_set=ladder,
+                                  predict_step=p8, devices=devices)
+    assert t8[0] == t1[0]
+    assert p8._cache_size() == executables
+    np.testing.assert_array_equal(want, got)
+    np.testing.assert_array_equal(got, again)
+
+
+def test_fast_inference_multidev_buffer_fence_per_device(monkeypatch):
+    """The per-device buffer-release contract under stress: shrink the
+    in-flight window to 2 so pooled compact staging buffers recycle
+    constantly across 8 devices and 3 packer threads — any release
+    before the owning device's fence proved its dispatch done would
+    corrupt an in-flight batch and break bit-exactness. A spy pool
+    verifies recycling actually engaged (the contract was exercised,
+    not vacuously passed)."""
+    import cgnn_tpu.train.infer as infer_mod
+    from cgnn_tpu.data.compact import CompactSpec, make_expander
+    from cgnn_tpu.data.pipeline import BufferPool
+    from cgnn_tpu.serve.shapes import plan_shape_set
+
+    graphs = load_synthetic_mp(157, CFG, seed=9)
+    state = _tiny_state(graphs, batch_size=8)
+    spec = CompactSpec.build(graphs, CFG.gdf(), dense_m=12)
+    ladder = plan_shape_set(graphs, 8, rungs=2, dense_m=12, compact=spec)
+    pstep = jax.jit(make_predict_step(make_expander(spec)))
+
+    want, _ = run_fast_inference(state, graphs, 8, shape_set=ladder,
+                                 predict_step=pstep, pack_workers=0)
+
+    pools = []
+    real_pool = BufferPool
+
+    def spy_pool(*a, **k):
+        pools.append(real_pool(*a, **k))
+        return pools[-1]
+
+    # window 2 + 4 devices over ~20 batches: every device's fence fires
+    # repeatedly, so released buffers are re-acquired while other
+    # devices' dispatches are still in flight
+    monkeypatch.setattr(infer_mod, "_WINDOW", 2)
+    monkeypatch.setattr(infer_mod, "BufferPool", spy_pool)
+    got, _ = run_fast_inference(state, graphs, 8, shape_set=ladder,
+                                predict_step=pstep, pack_workers=3,
+                                devices=jax.devices()[:4])
+    np.testing.assert_array_equal(want, got)
+    assert pools and pools[0].reused > 0  # buffers really recycled
+
+
 def test_fast_inference_single_bucket_small():
     graphs = load_synthetic_mp(20, CFG, seed=6)
     model = CrystalGraphConvNet(atom_fea_len=8, n_conv=1, h_fea_len=16,

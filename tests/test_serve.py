@@ -623,6 +623,189 @@ class TestCompactServing:
         assert server.counts.get("pack_compact", 0) >= 1
 
 
+# ------------------------------------------------- device-parallel serving
+
+
+class TestDeviceParallelServing:
+    """ISSUE 5: the DeviceSet dispatch layer — replicated programs and
+    params across N devices, least-loaded routing, per-device windows —
+    with the load-bearing invariants pinned: distribution (every device
+    serves), the compile pin (programs trace once; executables build per
+    device at warmup and NEVER after), and hot-swap atomicity across
+    replicas (no response's param_version disagrees with the params that
+    computed it, under concurrent load spanning the swap)."""
+
+    N_DEV = 4
+
+    def _devices(self):
+        import jax as _jax
+
+        return _jax.devices()[: self.N_DEV]
+
+    def test_resolve_devices_semantics(self):
+        from cgnn_tpu.serve.devices import resolve_devices
+
+        # the PR-4 device-awareness lesson: CPU 'devices' share the
+        # host's cores, so auto stays single-device on this backend
+        assert len(resolve_devices("auto")) == 1
+        assert len(resolve_devices(3)) == 3
+        assert len(resolve_devices("8")) == 8
+        with pytest.raises(ValueError):
+            resolve_devices(99)  # silent clamp would fake a dryrun
+        with pytest.raises(ValueError):
+            resolve_devices(0)
+
+    def test_multidev_distribution_parity_and_compile_pin(
+            self, graphs, shape_set, model_state):
+        _, state = model_state
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              pack_workers=1, devices=self._devices())
+        server.warm(graphs[0])
+        # the compile pin, N-device form: one executable per (traced
+        # program, device), all built AT WARMUP
+        assert server._jit_cache_size() == len(shape_set) * self.N_DEV
+        server.start()
+        futs = [server.submit(g, timeout_ms=30000)
+                for _ in range(3) for g in graphs[:24]]
+        res = [f.result(30.0) for f in futs]
+        assert server.drain(timeout_s=30.0)
+        # zero drops, zero recompiles, and every device answered
+        assert len(res) == 72
+        assert server.stats()["recompiles_after_warm"] == 0
+        assert server._jit_cache_size() == len(shape_set) * self.N_DEV
+        assert {r.device_id for r in res} == set(range(self.N_DEV))
+        dev_stats = server.stats()["devices"]
+        assert [d["dispatches"] for d in dev_stats].count(0) == 0
+        assert sum(d["dispatches"] for d in dev_stats) == \
+            server.counts["batches"]
+        # parity: the answers equal the offline single-device reference
+        pstep = jax.jit(make_predict_step())
+        by_graph = {}
+        for g in graphs[:24]:
+            by_graph[id(g)] = np.asarray(
+                pstep(state, shape_set.pack([g])))[0]
+        for fut_graphs, r in zip(
+                [g for _ in range(3) for g in graphs[:24]], res):
+            np.testing.assert_allclose(
+                r.prediction, by_graph[id(fut_graphs)],
+                rtol=1e-4, atol=1e-5)
+
+    def test_multidev_hot_swap_atomic_under_concurrent_load(
+            self, graphs, shape_set, model_state, tmp_path):
+        """The ISSUE-3 cache-revalidation race, per-device: under load
+        spanning a swap, every response must carry numbers computed by
+        the params its ``param_version`` names — on whichever replica it
+        dispatched. A torn replica set (some devices old, some new,
+        under one version) fails the numeric check immediately."""
+        model_cfg, state = model_state
+        mgr = CheckpointManager(str(tmp_path / "mdckpt"),
+                                log_fn=lambda m: None)
+        _save_state(mgr, state, model_cfg)
+        v1 = mgr.newest_committed()
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              pack_workers=1, devices=self._devices(),
+                              version=v1, default_timeout_ms=60000.0,
+                              max_queue=4096)
+        server.warm(graphs[0])
+        watcher = server.attach_watcher(mgr, poll_interval_s=3600)
+        _save_state(mgr, state, model_cfg, nudge=0.5)
+        v2 = mgr.newest_committed()
+        server.start()
+
+        results = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(ci):
+            rng = np.random.default_rng(ci)
+            while not stop.is_set():
+                g = graphs[int(rng.integers(24))]
+                r = server.predict(g, timeout_ms=60000)
+                with lock:
+                    results.append((id(g), r))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        # let v1 traffic flow, swap mid-load, let v2 traffic flow
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with lock:
+                if len(results) >= 40:
+                    break
+            time.sleep(0.01)
+        assert watcher.poll_once()  # the swap lands under live load
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with lock:
+                if len(results) >= 120:
+                    break
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert server.drain(timeout_s=60.0)
+        assert server.stats()["recompiles_after_warm"] == 0
+
+        # per-version references (batch-composition independent to tol)
+        pstep = jax.jit(make_predict_step())
+
+        def nudged(s):
+            return s.replace(params=jax.tree_util.tree_map(
+                lambda x: (np.asarray(x) + 0.5).astype(
+                    np.asarray(x).dtype)
+                if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+                s.params))
+
+        refs = {}
+        for g in graphs[:24]:
+            refs[(id(g), v1)] = np.asarray(
+                pstep(state, shape_set.pack([g])))[0]
+            refs[(id(g), v2)] = np.asarray(
+                pstep(nudged(state), shape_set.pack([g])))[0]
+        seen_versions = set()
+        for gid, r in results:
+            assert r.param_version in (v1, v2)
+            seen_versions.add(r.param_version)
+            # THE atomicity pin: the numbers match the version label
+            np.testing.assert_allclose(
+                r.prediction, refs[(gid, r.param_version)],
+                rtol=1e-4, atol=1e-4,
+                err_msg=f"response labeled {r.param_version} (device "
+                        f"{r.device_id}) disagrees with those params")
+        assert seen_versions == {v1, v2}  # load really spanned the swap
+        mgr.close()
+
+    def test_multidev_device_gauges_in_run_summary(
+            self, graphs, shape_set, model_state, tmp_path):
+        telemetry = Telemetry(level="epoch", log_dir=str(tmp_path),
+                              use_clu=False)
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              pack_workers=1, devices=self._devices(),
+                              telemetry=telemetry)
+        server.warm(graphs[0])
+        server.start()
+        futs = [server.submit(g, timeout_ms=30000)
+                for _ in range(3) for g in graphs[:24]]
+        for f in futs:
+            f.result(30.0)
+        assert server.drain(timeout_s=30.0)
+        telemetry.close()
+        from cgnn_tpu.observe import read_jsonl
+
+        recs = read_jsonl(str(tmp_path / "metrics.jsonl"))
+        summary = [r for r in recs if r.get("event") == "run_summary"]
+        assert len(summary) == 1
+        gauges = summary[0]["gauges"]
+        assert gauges["device_count"] == self.N_DEV
+        assert gauges["devices_active"] == self.N_DEV
+        assert 0 < gauges["device_dispatch_min_share"]
+        assert gauges["device_dispatch_max_share"] < 1
+        for i in range(self.N_DEV):
+            assert gauges[f"device{i}_dispatches"] >= 1
+
+
 def server_predict_reference(state, ss, graph):
     """Offline reference for one graph through the set's compact path."""
     from cgnn_tpu.train.step import make_predict_step as _mps
