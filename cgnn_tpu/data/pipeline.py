@@ -162,6 +162,11 @@ def parallel_pack(
                 cond.notify_all()
             in_q.put(_STOP)
 
+    # the pack-pool hop in the Chrome-trace stream: one span per job on
+    # its worker's track, keyed by sequence number so a request trace
+    # (serve.pack carries the same wall window) lines up with the pool
+    spans = getattr(telemetry, "spans", None)
+
     def worker() -> None:
         while not stop.is_set():
             try:
@@ -177,11 +182,15 @@ def parallel_pack(
                 res = pack_fn(payload)
             except BaseException as e:  # noqa: BLE001 — delivered in-order
                 res = PackError(e)
-            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            dt = t1 - t0
             with cond:
                 pack_busy[0] += dt
                 results[seq] = res
                 cond.notify_all()
+            if spans is not None:
+                spans.complete(f"{name}.job", t0, t1, seq=seq,
+                               error=isinstance(res, PackError))
             if telemetry is not None:
                 telemetry.counter_add("pipeline_pack_s", dt)
                 telemetry.counter_add("pipeline_jobs", 1)

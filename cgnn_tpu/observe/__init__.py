@@ -25,6 +25,12 @@ the pre-existing behavior (epoch records in ``metrics.jsonl``) and
 stages NO callbacks into any compiled program — only ``step`` does.
 """
 
+from cgnn_tpu.observe.export import (
+    LiveMetricsWriter,
+    MetricsRegistry,
+    RollingSeries,
+    parse_prometheus_text,
+)
 from cgnn_tpu.observe.gauges import (
     device_hbm_table_bytes,
     hbm_gauges,
@@ -37,15 +43,23 @@ from cgnn_tpu.observe.metrics_io import (
     profile_trace,
     read_jsonl,
 )
+from cgnn_tpu.observe.profile import ProfileBusy, ProfileCapture, install_sigusr2
 from cgnn_tpu.observe.spans import SpanTracer
 from cgnn_tpu.observe.stream import StepStream
 from cgnn_tpu.observe.telemetry import Telemetry
 
 __all__ = [
+    "LiveMetricsWriter",
     "MetricsLogger",
+    "MetricsRegistry",
+    "ProfileBusy",
+    "ProfileCapture",
+    "RollingSeries",
     "SpanTracer",
     "StepStream",
     "Telemetry",
+    "install_sigusr2",
+    "parse_prometheus_text",
     "device_hbm_table_bytes",
     "enable_debug_nans",
     "hbm_gauges",

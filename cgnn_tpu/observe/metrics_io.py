@@ -78,15 +78,32 @@ def read_jsonl(path: str) -> list[dict]:
 
 @contextlib.contextmanager
 def profile_trace(log_dir: str, enabled: bool = True) -> Iterator[None]:
-    """jax.profiler.trace context (xprof/perfetto trace under log_dir)."""
+    """jax.profiler.trace context (xprof/perfetto trace under log_dir).
+
+    Tolerant of a profiler session already being active: jax.profiler
+    supports ONE trace at a time, and an on-demand SIGUSR2/POST-profile
+    capture (observe.profile.ProfileCapture) may hold it when the
+    ``--profile N`` window opens — a lost launch-time trace must not
+    kill the training run, so the window is skipped with a log line
+    instead of propagating."""
     if not enabled:
         yield
         return
     import jax
 
     os.makedirs(log_dir, exist_ok=True)
-    with jax.profiler.trace(log_dir):
+    try:
+        ctx = jax.profiler.trace(log_dir)
+        ctx.__enter__()
+    except Exception as e:  # noqa: BLE001 — profiler busy/unavailable
+        print(f"profile_trace: skipped ({e!r}); is another capture "
+              f"holding the profiler?")
         yield
+        return
+    try:
+        yield
+    finally:
+        ctx.__exit__(None, None, None)
 
 
 def enable_debug_nans() -> None:

@@ -22,18 +22,47 @@ from typing import Iterator
 
 
 class SpanTracer:
-    """Nested host spans; ``export()`` writes trace.json (Chrome format)."""
+    """Nested host spans; ``export()`` writes trace.json (Chrome format).
 
-    def __init__(self, process_name: str = "cgnn-tpu host"):
+    The event buffer is a BOUNDED RING (``max_events``): per-request
+    serving spans at thousands of rps would otherwise grow a days-long
+    server's trace without limit. Once full, the OLDEST events are
+    evicted (and counted in ``dropped``) — the live-tracing consumers
+    (reconstructing a recent slow request, a profile capture's host
+    window) need the most recent spans, not the startup era — and
+    ``export`` stamps the drop count into the trace metadata so a
+    truncated trace is never mistaken for a complete one.
+    """
+
+    def __init__(self, process_name: str = "cgnn-tpu host",
+                 max_events: int = 200_000):
+        import collections
+
         self._t0 = time.perf_counter()
-        self._events: list[dict] = []
+        self._events: collections.deque = collections.deque(
+            maxlen=int(max_events))
         self._lock = threading.Lock()
         self._depth = threading.local()
         self._tids: dict[int, int] = {}
         self._process_name = process_name
+        self.max_events = int(max_events)
+        self.dropped = 0
+
+    @staticmethod
+    def now_s() -> float:
+        """The stamp clock (``time.perf_counter`` seconds). Callers that
+        record per-stage timestamps for later ``complete()`` calls must
+        use THIS clock so retro-stamped spans line up with live ones."""
+        return time.perf_counter()
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
+
+    def _append(self, event: dict) -> None:
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1  # the deque evicts its oldest entry
+            self._events.append(event)
 
     def _tid(self) -> int:
         # stable small ints per thread (raw thread idents overflow the
@@ -62,12 +91,29 @@ class SpanTracer:
                 "tid": self._tid(),
                 "args": {k: v for k, v in args.items()} | {"depth": depth},
             }
-            with self._lock:
-                self._events.append(event)
+            self._append(event)
+
+    def complete(self, name: str, start_s: float, end_s: float,
+                 **args) -> None:
+        """Record a span from explicit ``now_s()`` stamps taken earlier
+        — the request-tracing path, where a stage's start was stamped on
+        one thread and its end observed on another. Emitted on the
+        calling thread's track."""
+        if end_s < start_s:
+            start_s, end_s = end_s, start_s
+        self._append({
+            "name": name,
+            "ph": "X",
+            "ts": (start_s - self._t0) * 1e6,
+            "dur": (end_s - start_s) * 1e6,
+            "pid": 0,
+            "tid": self._tid(),
+            "args": dict(args),
+        })
 
     def instant(self, name: str, **args) -> None:
         """Zero-duration marker event."""
-        event = {
+        self._append({
             "name": name,
             "ph": "i",
             "ts": self._now_us(),
@@ -75,9 +121,7 @@ class SpanTracer:
             "pid": 0,
             "tid": self._tid(),
             "args": dict(args),
-        }
-        with self._lock:
-            self._events.append(event)
+        })
 
     @property
     def events(self) -> list[dict]:
@@ -94,6 +138,16 @@ class SpanTracer:
                 "args": {"name": self._process_name},
             }
         ]
+        with self._lock:
+            dropped = self.dropped
+        if dropped:
+            meta.append({
+                "name": "events_dropped",
+                "ph": "M",
+                "pid": 0,
+                "args": {"dropped": dropped,
+                         "max_events": self.max_events},
+            })
         doc = {"traceEvents": meta + self.events, "displayTimeUnit": "ms"}
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:

@@ -42,10 +42,13 @@ class Telemetry:
     difference, which is exactly what ``stream is None`` gates."""
 
     def __init__(self, level: str = "epoch", log_dir: str = "",
-                 use_clu: bool = True):
+                 use_clu: bool = True, series_window_s: float = 900.0):
         if level not in LEVELS:
             raise ValueError(f"telemetry level {level!r} not in {LEVELS}")
         self.level = level
+        # value-series retention window (observe_value docstring); the
+        # run-summary quantiles at close cover at most this much history
+        self.series_window_s = float(series_window_s)
         self.enabled = level != "off"
         self.step_level = level == "step"
         self.log_dir = log_dir
@@ -125,39 +128,54 @@ class Telemetry:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def gauges(self) -> dict:
+        """Live gauge view (the export registry scrapes this)."""
+        with self._lock:
+            return dict(self._gauges)
+
     def observe_value(self, name: str, value: float,
                       keep: int = 8192) -> None:
-        """Append one sample to a bounded value series (latencies, batch
+        """Append one sample to a windowed value series (latencies, batch
         occupancies). At close the series flushes as p50/p95/p99 + mean +
-        count gauges in the run summary — the serving SLO numbers. The
-        deque bound keeps a long-running server's memory flat; quantiles
-        then cover the most recent ``keep`` samples, which is the window
-        an SLO report wants anyway."""
+        count gauges in the run summary — the serving SLO numbers.
+
+        Retention (observe.export.RollingSeries) is bounded BOTH ways:
+        at most ``keep`` samples AND nothing older than
+        ``series_window_s`` (default 15 min), with explicit eviction on
+        every append/read — a days-long server's series memory stays
+        flat and its quantiles describe recent traffic, not week-old
+        history. The export registry reads narrower sub-windows (60 s)
+        for live scrapes via ``series_quantiles(window_s=...)``."""
         if not self.enabled:
             return
-        import collections
+        from cgnn_tpu.observe.export import RollingSeries
 
         with self._lock:
             series = self._series.get(name)
-            if series is None or series.maxlen != keep:
-                series = collections.deque(series or (), maxlen=keep)
+            if series is None or series.max_samples != keep:
+                old = series
+                series = RollingSeries(window_s=self.series_window_s,
+                                       max_samples=keep)
+                if old is not None:
+                    series.reseed_from(old)
                 self._series[name] = series
-            series.append(float(value))
+        series.add(float(value))
 
-    def series_quantiles(self, name: str) -> dict:
-        """{p50, p95, p99, mean, count} for one series ({} if empty)."""
-        import numpy as np
-
+    def series_names(self) -> list[str]:
         with self._lock:
-            vals = list(self._series.get(name, ()))
-        if not vals:
+            return list(self._series)
+
+    def series_quantiles(self, name: str,
+                         window_s: float | None = None) -> dict:
+        """{p50, p95, p99, mean, count} for one series ({} if empty).
+
+        Default: everything retained (the run-summary view). Pass
+        ``window_s`` for a live sub-window — the /metrics scrape."""
+        with self._lock:
+            series = self._series.get(name)
+        if series is None:
             return {}
-        arr = np.asarray(vals, np.float64)
-        p50, p95, p99 = np.percentile(arr, [50, 95, 99])
-        return {
-            "p50": float(p50), "p95": float(p95), "p99": float(p99),
-            "mean": float(arr.mean()), "count": len(vals),
-        }
+        return series.quantiles(window_s=window_s)
 
     def observe_padding(self, stats) -> None:
         """Remember the run's PaddingStats; per-bucket gauges are derived

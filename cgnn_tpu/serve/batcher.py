@@ -104,6 +104,12 @@ class Request:
     # any non-compactable member demotes its flush to full-fidelity
     # packing (both programs are warmed, so neither path ever recompiles)
     compactable: bool = False
+    # per-request trace identity (minted at admission; an inbound
+    # X-Request-Id is honored) + the monotonic per-stage stamps
+    # (SpanTracer.now_s clock): queued / packed / dispatched / fetched /
+    # replied — the live-observability request journey
+    trace_id: str = ""
+    stamps: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -115,9 +121,19 @@ class Flush:
     shape: BatchShape | None
     expired: list
     reason: str = ""  # 'shape_full' | 'deadline' | 'drain' | ''
+    # batch identity: co-batched requests carry DISTINCT trace ids but
+    # share this flush id — the join key between a request's trace and
+    # the flush-level pack/dispatch/fetch spans
+    flush_id: str = ""
+    # per-flush stage stamps (packed/dispatched/fetched), merged into
+    # every member request's journey at reply time
+    stamps: dict = dataclasses.field(default_factory=dict)
 
     def __bool__(self) -> bool:
         return bool(self.requests or self.expired)
+
+    def trace_ids(self) -> list:
+        return [r.trace_id for r in self.requests]
 
 
 class MicroBatcher:
@@ -140,6 +156,7 @@ class MicroBatcher:
         self._queue: list[Request] = []
         self._cond = threading.Condition()
         self._closed = False
+        self._flush_seq = 0
 
     # ---- admission ----
 
@@ -223,7 +240,9 @@ class MicroBatcher:
                     sum(r.nodes for r in fired),
                     sum(r.edges for r in fired),
                 )
-            return Flush(fired, shape, expired, reason)
+            self._flush_seq += 1
+            return Flush(fired, shape, expired, reason,
+                         flush_id=f"flush-{self._flush_seq:06d}")
 
     def next_flush(self) -> Flush | None:
         """Block until the policy fires (worker-thread API).

@@ -154,6 +154,13 @@ class DeviceSet:
         with self._lock:
             return self._inflight[i]
 
+    def inflight_depths(self) -> list[int]:
+        """Every device's in-flight depth in one lock acquisition — the
+        live-observability view (/stats rolling + the /metrics scrape):
+        routed-but-unfetched flushes per device, right now."""
+        with self._lock:
+            return list(self._inflight)
+
     # ---- accounting ----
 
     def stats(self) -> list[dict]:

@@ -9,9 +9,22 @@ lives in server.py; this module only translates wire <-> core:
   atom_fea [N,D], edge_fea [E,G], centers [E], neighbors [E]) or
   ``{"structure": {...}}`` (lattice [3,3], frac_coords [N,3], numbers
   [N]) featurized server-side with the checkpoint's config. Response:
-  ``{"prediction": [T], "param_version", "latency_ms", "cached"}``.
+  ``{"prediction": [T], "param_version", "latency_ms", "cached",
+  "trace_id", "flush_id", "stamps"}``. An inbound ``X-Request-Id``
+  header (or body ``trace_id``) becomes the request's trace id; the
+  response echoes it in the ``X-Request-Id`` header and carries the
+  monotonic stage stamps (queued/packed/dispatched/fetched/replied) so
+  a slow request is attributable to its stage from the client side.
 - ``GET /healthz``   liveness + current param version.
-- ``GET /stats``     the server's full stats() dict (SLO numbers).
+- ``GET /stats``     the server's full stats() dict (SLO numbers,
+  including the live ``rolling`` window + per-device in-flight depth).
+- ``GET /metrics``   Prometheus text exposition from the server's
+  export registry (observe/export.py): serve_* counters, device
+  gauges (one ``device`` label per chip), pipeline_* counters, and
+  rolling-window latency/occupancy summaries — scrape mid-load.
+- ``POST /profile``  bounded on-demand ``jax.profiler`` capture (body
+  ``{"duration_ms": 500}``); 409 while one is running (captures are
+  rejected, never stacked), 501 when no profile dir was configured.
 
 Rejections map to the HTTP codes clients expect from a loaded service:
 429 queue-full (back off), 413 oversize (never retry), 504 deadline
@@ -99,10 +112,22 @@ def make_handler(server: InferenceServer,
         def log_message(self, fmt, *args):  # noqa: ARG002
             pass
 
-        def _reply(self, status: int, payload: dict) -> None:
+        def _reply(self, status: int, payload: dict,
+                   headers: dict | None = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_text(self, status: int, text: str,
+                        content_type: str) -> None:
+            body = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -116,16 +141,53 @@ def make_handler(server: InferenceServer,
                 })
             elif self.path == "/stats":
                 self._reply(200, server.stats())
+            elif self.path == "/metrics":
+                # the Prometheus scrape: live registry state, rendered
+                # in the text exposition format (version 0.0.4)
+                self._reply_text(
+                    200, server.registry.prometheus_text(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
+        def _do_profile(self, payload: dict) -> None:
+            from cgnn_tpu.observe.profile import ProfileBusy
+
+            if server.profiler is None:
+                self._reply(501, {
+                    "error": "profiling not configured "
+                             "(serve.py --profile-dir)",
+                })
+                return
+            duration_ms = payload.get("duration_ms")
+            try:
+                record = server.profiler.capture(
+                    None if duration_ms is None
+                    else float(duration_ms) / 1000.0
+                )
+            except ProfileBusy as e:
+                self._reply(409, {"error": str(e), "reason": "busy"})
+                return
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                self._reply(500, {"error": repr(e)})
+                return
+            self._reply(200, {"ok": True, **record})
+
         def do_POST(self):  # noqa: N802
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError as e:
+                self._reply(400, {"error": f"malformed JSON body: {e}"})
+                return
+            if self.path == "/profile":
+                self._do_profile(payload)
+                return
             if self.path != "/predict":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                payload = json.loads(self.rfile.read(length) or b"{}")
                 if "graph" in payload:
                     graph = graph_from_json(payload["graph"])
                 elif "structure" in payload and featurize is not None:
@@ -139,8 +201,13 @@ def make_handler(server: InferenceServer,
                 self._reply(400, {"error": str(e)})
                 return
             timeout_ms = payload.get("timeout_ms")
+            # per-request tracing: an inbound X-Request-Id (or a body
+            # trace_id) becomes the trace id minted at admission
+            trace_id = (self.headers.get("X-Request-Id")
+                        or payload.get("trace_id"))
             try:
-                result = server.predict(graph, timeout_ms=timeout_ms)
+                result = server.predict(graph, timeout_ms=timeout_ms,
+                                        trace_id=trace_id)
             except ServeRejection as e:
                 self._reply(_REJECT_STATUS.get(e.reason, 500), {
                     "error": str(e), "reason": e.reason,
@@ -157,7 +224,10 @@ def make_handler(server: InferenceServer,
                 "cached": result.cached,
                 "batch_occupancy": result.batch_occupancy,
                 "device_id": result.device_id,
-            })
+                "trace_id": result.trace_id,
+                "flush_id": result.flush_id,
+                "stamps": result.stamps,
+            }, headers={"X-Request-Id": result.trace_id})
 
     return ServeHandler
 

@@ -41,11 +41,31 @@ replays compilations from disk. After warmup the compile count is
 PINNED: the batcher only emits shapes from the warm set, so
 ``predict_step`` never traces again (asserted by tests via the jit
 cache-miss counter, and re-checked per flush when telemetry is on).
+
+Live observability plane (ISSUE 6), all host-side — predictions are
+bit-identical with it on or off and nothing new is staged into jitted
+code:
+
+- every request gets a trace id at admission (inbound ``X-Request-Id``
+  honored) and monotonic stage stamps (queued/packed/dispatched/
+  fetched/replied) that ride the ``ServeResult`` and, when telemetry is
+  on, land as ``serve.request``/``serve.pack``/``serve.dispatch`` spans
+  in the Chrome-trace stream, joined by the flush id co-batched
+  requests share;
+- ``self.registry`` (observe/export.py) is the scrape point behind
+  ``GET /metrics`` and ``stats()["rolling"]``: request counters,
+  per-device in-flight depth, and 60 s rolling-window latency/occupancy
+  quantiles, live at any moment of the run;
+- ``enable_profiling(dir)`` arms the on-demand bounded ``jax.profiler``
+  capture behind ``POST /profile`` and SIGUSR2 (one at a time;
+  concurrent requests are rejected).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import os
 import threading
 import time
 from typing import Callable, Sequence
@@ -81,6 +101,14 @@ class ServeResult:
     # device computed them, and attributing them to device 0 would skew
     # client-side per-device accounting on a multi-device server
     device_id: int = 0
+    # the request's journey (live observability plane): its trace id
+    # (minted at admission or inherited from X-Request-Id), the flush it
+    # was co-batched into, and the monotonic per-stage stamps
+    # (queued/packed/dispatched/fetched/replied; SpanTracer.now_s
+    # seconds — cache hits carry only queued/replied)
+    trace_id: str = ""
+    flush_id: str = ""
+    stamps: dict = dataclasses.field(default_factory=dict)
 
 
 class InferenceServer:
@@ -169,6 +197,25 @@ class InferenceServer:
         # from poisoning a whole co-batched flush (pack would raise) or
         # forcing a fresh trace (a recompile after warmup)
         self._feature_dims: tuple[int, int] | None = None
+        # ---- live observability plane ----
+        # trace ids are ALWAYS minted (cheap: prefix + counter); span
+        # emission additionally needs telemetry.spans (plane on)
+        self._trace_prefix = os.urandom(3).hex()
+        self._trace_seq = itertools.count(1)
+        from cgnn_tpu.observe.export import MetricsRegistry, RollingSeries
+
+        # rolling (time-windowed) twins of the run-lifetime SLO series:
+        # these answer "what is the p99 NOW", independent of telemetry
+        # level, and feed /stats["rolling"] + the /metrics scrape
+        self.rolling_window_s = 60.0
+        self._lat_rolling = RollingSeries(window_s=self.rolling_window_s)
+        self._occ_rolling = RollingSeries(window_s=self.rolling_window_s)
+        self.registry = MetricsRegistry(window_s=self.rolling_window_s)
+        self.registry.attach_telemetry(self.telemetry)
+        self.registry.add_provider("serve", self._registry_snapshot)
+        # on-demand device profiling (observe/profile.py); wired by
+        # enable_profiling — None until an output dir is chosen
+        self.profiler = None
 
     # ---- warmup ----
 
@@ -220,6 +267,88 @@ class InferenceServer:
             return int(self.predict_step._cache_size())
         except AttributeError:
             return None
+
+    # ---- live observability plane ----
+
+    def _mint_trace(self, requested: str | None = None) -> str:
+        """A request's trace id: the (sanitized) inbound X-Request-Id
+        when the client sent one, a fresh ``req-<prefix>-<seq>`` here
+        otherwise. Always minted — the id is how an operator joins an
+        HTTP response to its span chain and flush."""
+        if requested:
+            rid = "".join(c if c.isprintable() and c not in '\\"'
+                          else "_" for c in str(requested).strip())
+            if rid:
+                return rid[:128]
+        return f"req-{self._trace_prefix}-{next(self._trace_seq):06x}"
+
+    @staticmethod
+    def _stamp() -> float:
+        """The per-stage stamp clock (SpanTracer.now_s: perf_counter
+        seconds) — deliberately NOT the injectable request clock, so
+        stamps line up with the Chrome-trace span timeline even under a
+        fake test clock."""
+        return time.perf_counter()
+
+    def _span(self, name: str, start_s: float, end_s: float,
+              **args) -> None:
+        """Emit one retro-stamped hop span when the plane is on."""
+        spans = self.telemetry.spans
+        if spans is not None:
+            spans.complete(name, start_s, end_s, **args)
+
+    def enable_profiling(self, out_dir: str, *,
+                         default_duration_s: float = 1.0,
+                         max_duration_s: float = 10.0):
+        """Wire on-demand device profiling (POST /profile + SIGUSR2)
+        into ``out_dir``; returns the ProfileCapture (gated: concurrent
+        captures are rejected, never stacked)."""
+        from cgnn_tpu.observe.profile import ProfileCapture
+
+        self.profiler = ProfileCapture(
+            out_dir, spans=self.telemetry.spans,
+            default_duration_s=default_duration_s,
+            max_duration_s=max_duration_s, log_fn=self._log,
+        )
+        return self.profiler
+
+    def _registry_snapshot(self) -> dict:
+        """The serve provider for ``self.registry``: request counters,
+        live queue/in-flight gauges, and the rolling-window SLO series —
+        all readable with telemetry OFF (the registry's telemetry source
+        contributes the rest when the plane is on). The pipeline_* and
+        device* names are emitted from here too so every scrape carries
+        the three metric families CI checks, whatever the config."""
+        with self._lock:
+            # copy under the lock: _count() inserts NEW keys concurrently
+            # and a mid-iteration resize would raise, costing the scrape
+            # the whole serve provider
+            counts = dict(self.counts)
+        counters = {f"serve_{k}": float(v) for k, v in counts.items()}
+        tcounters = self.telemetry.counters()
+        for name in ("pipeline_jobs", "pipeline_pack_s", "pipeline_wait_s"):
+            counters[name] = float(tcounters.get(name, 0.0))
+        gauges = {
+            "serve_queue_depth": float(self.batcher.depth),
+            "serve_draining": float(self._draining),
+            "serve_warmed": float(self.warmed),
+            "serve_recompiles_after_warm": float(self._compiles_after_warm),
+            "serve_rolling_window_s": self.rolling_window_s,
+            "pipeline_pack_workers": float(self._pack_workers),
+            "device_count": float(len(self.device_set)),
+        }
+        for i, depth in enumerate(self.device_set.inflight_depths()):
+            gauges[f"device{i}_inflight"] = float(depth)
+        if self.profiler is not None:
+            gauges["profile_captures"] = float(self.profiler.captures)
+            gauges["profile_busy"] = float(self.profiler.busy)
+        series = {}
+        for name, roll in (("serve_latency_ms", self._lat_rolling),
+                           ("serve_batch_occupancy", self._occ_rolling)):
+            q = roll.quantiles()
+            if q:
+                series[name] = q
+        return {"counters": counters, "gauges": gauges, "series": series}
 
     # ---- lifecycle ----
 
@@ -290,6 +419,11 @@ class InferenceServer:
             # gets answers
             self._serve_loop()
             done = True
+        if self.profiler is not None:
+            # exiting while jax.profiler holds an active trace segfaults
+            # in the backend; a drain waits out an in-flight capture
+            # (bounded: captures are capped at max_duration_s)
+            self.profiler.wait_idle()
         self.telemetry.set_gauge("serve_drained_clean", float(done))
         # per-device occupancy/dispatch gauges -> run_summary (the
         # observe.gauges.device_gauges rollup reads these names)
@@ -334,10 +468,15 @@ class InferenceServer:
             raise ServeRejection(MALFORMED, "; ".join(problems))
 
     def submit(self, graph: CrystalGraph,
-               timeout_ms: float | None = None) -> RequestFuture:
+               timeout_ms: float | None = None,
+               trace_id: str | None = None) -> RequestFuture:
         """Admit one structure; returns its future (raises ServeRejection
-        on malformed / queue-full / oversize / draining)."""
+        on malformed / queue-full / oversize / draining). ``trace_id``
+        carries an inbound X-Request-Id; absent, one is minted here —
+        admission is where a request's journey starts."""
         now = self._clock()
+        queued = self._stamp()
+        tid = self._mint_trace(trace_id)
         self._count("requests")
         try:
             self._check_wellformed(graph)
@@ -357,11 +496,25 @@ class InferenceServer:
                 if version == self.param_store.version:
                     self._count("cache_hits")
                     fut = RequestFuture()
+                    replied = self._stamp()
+                    latency_ms = (self._clock() - now) * 1e3
                     fut.set_result(ServeResult(
                         prediction=row, param_version=version,
-                        latency_ms=(self._clock() - now) * 1e3, cached=True,
-                        device_id=-1,
+                        latency_ms=latency_ms, cached=True,
+                        device_id=-1, trace_id=tid,
+                        stamps={"queued": queued, "replied": replied},
                     ))
+                    # cache hits ARE served responses: they must feed the
+                    # same latency distributions clients measure, or the
+                    # scraped rolling p99 and a loadgen's own p99 describe
+                    # different populations under a warm cache
+                    self._record_latency(latency_ms)
+                    self._lat_rolling.add(latency_ms)
+                    self.telemetry.observe_value("serve_latency_ms",
+                                                 latency_ms)
+                    if self.telemetry.spans is not None:
+                        self._span("serve.request", queued, replied,
+                                   trace_id=tid, cached=True)
                     return fut
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
                    else self.default_timeout)
@@ -373,6 +526,8 @@ class InferenceServer:
             # decided once here: a flush packs compact only when EVERY
             # member can (batcher.Request docstring)
             compactable=self.shape_set.compactable(graph),
+            trace_id=tid,
+            stamps={"queued": queued},
         )
         try:
             self.batcher.offer(req)
@@ -382,9 +537,10 @@ class InferenceServer:
         return req.future
 
     def predict(self, graph: CrystalGraph,
-                timeout_ms: float | None = None) -> ServeResult:
+                timeout_ms: float | None = None,
+                trace_id: str | None = None) -> ServeResult:
         """Blocking convenience: submit + wait."""
-        fut = self.submit(graph, timeout_ms=timeout_ms)
+        fut = self.submit(graph, timeout_ms=timeout_ms, trace_id=trace_id)
         # wait slightly past the serving deadline: expiry is delivered by
         # the worker, not by this caller racing it
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
@@ -425,8 +581,17 @@ class InferenceServer:
             except Exception as e:  # noqa: BLE001 — fail the flush, not the stream
                 batch = buf = None
                 err = e
-            self.telemetry.observe_value("serve_pack_s",
-                                         time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            # the 'packed' hop: stamped on the flush (shared by its
+            # co-batched members) and emitted as a span keyed by
+            # flush_id + the member trace ids
+            flush.stamps["packed"] = t1
+            if self.telemetry.spans is not None:  # skip arg-building when off
+                self._span("serve.pack", t0, t1, flush_id=flush.flush_id,
+                           n=len(flush.requests),
+                           trace_ids=flush.trace_ids(),
+                           error=repr(err) if err is not None else "")
+            self.telemetry.observe_value("serve_pack_s", t1 - t0)
             return flush, batch, buf, err
 
         return pack_one
@@ -574,17 +739,12 @@ class InferenceServer:
 
     def _process(self, flush: Flush) -> None:
         """The in-line (pack_workers=0) flush path: expire, pack,
-        dispatch — all on the calling thread."""
+        dispatch — all on the calling thread (same stamp/span/telemetry
+        discipline as the pipelined pack stage)."""
         self._fail_expired(flush)
         if not flush.requests:
             return
-        try:
-            batch, buf = self._pack_flush(flush)
-            err = None
-        except Exception as e:  # noqa: BLE001 — fail the flush, not the server
-            batch = buf = None
-            err = e
-        self._run_flush(flush, batch, buf, err, pool=None)
+        self._run_flush(*self._make_pack_one(None)(flush), pool=None)
 
     def _run_flush(self, flush: Flush, batch, buf, err, *, pool,
                    device: int = 0, routed: bool = False) -> None:
@@ -626,7 +786,11 @@ class InferenceServer:
         # its dispatch-time replica alive by reference and finishes on it
         state, version = self.param_store.get(device)
         pre = self._jit_cache_size()
+        dispatched = self._stamp()
+        flush.stamps["dispatched"] = dispatched
         out = np.asarray(jax.device_get(self.predict_step(state, batch)))
+        fetched = self._stamp()
+        flush.stamps["fetched"] = fetched
         post = self._jit_cache_size()
         if self.warmed and pre is not None and post is not None and post > pre:
             # a recompile after warmup is a policy bug (the batcher left
@@ -638,6 +802,12 @@ class InferenceServer:
                 f"serve: UNEXPECTED recompile after warmup "
                 f"(shape {flush.shape}); latency SLO was broken this batch"
             )
+        # the dispatch->fetch hop (device compute + transfer), one span
+        # per flush with the co-batched trace ids as the join keys
+        if self.telemetry.spans is not None:  # skip arg-building when off
+            self._span("serve.dispatch", dispatched, fetched,
+                       flush_id=flush.flush_id, device=device,
+                       shape=str(flush.shape), trace_ids=flush.trace_ids())
         now = self._clock()
         occupancy = len(reqs) / flush.shape.graph_cap
         self._count(f"batches_device{device}")
@@ -646,12 +816,27 @@ class InferenceServer:
             latency_ms = (now - r.enqueued) * 1e3
             if self.cache is not None and r.fingerprint is not None:
                 self.cache.put(r.fingerprint, (row, version))
+            replied = self._stamp()
+            stamps = {**r.stamps, **flush.stamps, "replied": replied}
             r.future.set_result(ServeResult(
                 prediction=row, param_version=version,
                 latency_ms=latency_ms, batch_occupancy=occupancy,
-                device_id=device,
+                device_id=device, trace_id=r.trace_id,
+                flush_id=flush.flush_id, stamps=stamps,
             ))
+            # the whole journey, one span per request: admission ->
+            # reply, args carrying the flush join key and stage stamps
+            if self.telemetry.spans is not None:  # skip arg-building when off
+                self._span("serve.request", stamps["queued"], replied,
+                           trace_id=r.trace_id, flush_id=flush.flush_id,
+                           device=device,
+                           queue_ms=round(
+                               (stamps["packed"] - stamps["queued"]) * 1e3,
+                               3),
+                           dispatch_ms=round((fetched - dispatched) * 1e3,
+                                             3))
             self._record_latency(latency_ms)
+            self._lat_rolling.add(latency_ms)
             # per REQUEST, not per batch: the run-summary quantiles must
             # describe the same distribution stats() does (PERF.md §10)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
@@ -660,6 +845,7 @@ class InferenceServer:
         with self._lock:
             self._occupancies.append(occupancy)
             del self._occupancies[:-4096]
+        self._occ_rolling.add(occupancy)
         self.telemetry.observe_value("serve_batch_occupancy", occupancy)
         self.telemetry.set_gauge("serve_queue_depth", self.batcher.depth)
 
@@ -686,6 +872,10 @@ class InferenceServer:
         return {"p50": float(p50), "p95": float(p95), "p99": float(p99),
                 "mean": float(arr.mean()), "count": len(vals)}
 
+    def rolling_quantiles(self) -> dict:
+        """Live rolling-window latency quantiles (the /metrics view)."""
+        return self._lat_rolling.quantiles()
+
     def stats(self) -> dict:
         with self._lock:
             counts = dict(self.counts)
@@ -697,6 +887,15 @@ class InferenceServer:
             "devices": self.device_set.stats(),
             "draining": self._draining,
             "latency_ms": self.latency_quantiles(),
+            # the live plane (ISSUE 6): rolling-window quantiles — what
+            # the last `rolling_window_s` seconds looked like, not the
+            # whole run — plus each device's in-flight depth right now
+            "rolling": {
+                "window_s": self.rolling_window_s,
+                "latency_ms": self._lat_rolling.quantiles(),
+                "batch_occupancy": self._occ_rolling.quantiles(),
+                "device_inflight": self.device_set.inflight_depths(),
+            },
             "batch_occupancy_mean": float(np.mean(occ)) if occ else 0.0,
             "shapes": [s.to_meta() for s in self.shape_set],
             "recompiles_after_warm": self._compiles_after_warm,
@@ -744,6 +943,7 @@ def load_server(
     devices: str | int = "auto",
     watch: bool = True,
     poll_interval_s: float = 2.0,
+    profile_dir: str = "",
     log_fn: Callable = print,
 ):
     """Boot an InferenceServer from a training checkpoint directory.
@@ -856,6 +1056,8 @@ def load_server(
         pack_workers=pack_workers, devices=device_list, log_fn=log_fn,
     )
     server.warm(template)
+    if profile_dir:
+        server.enable_profiling(profile_dir)
     if watch:
         server.attach_watcher(mgr, poll_interval_s=poll_interval_s,
                               log_fn=log_fn)

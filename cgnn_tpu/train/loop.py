@@ -1120,11 +1120,23 @@ def fit(
         if is_best:
             best = metric
         history.append({"epoch": epoch, "train": train_m, "val": val_m})
+        epoch_s = time.perf_counter() - t0
         log_fn(
             f"Epoch {epoch}: train loss {train_m.get('loss', np.nan):.4f}"
             f"  val {best_key} {metric:.4f}{' *' if is_best else ''}"
-            f"  ({time.perf_counter() - t0:.1f}s)"
+            f"  ({epoch_s:.1f}s)"
         )
+        # live-progress gauges + windowed epoch-time series: a mid-run
+        # registry scrape (train.py --live-metrics / metrics_live.jsonl)
+        # sees where the run is and how fast it is moving, instead of
+        # waiting for the exit-time run_summary (host-side bookkeeping
+        # only — the trajectory is untouched)
+        telemetry.set_gauge("train_epoch", float(epoch))
+        telemetry.set_gauge("train_loss_last",
+                            float(train_m.get("loss", np.nan)))
+        telemetry.set_gauge(f"val_{best_key}_last", float(metric))
+        telemetry.set_gauge(f"val_{best_key}_best", float(best))
+        telemetry.observe_value("epoch_time_s", epoch_s)
         if on_epoch_metrics is not None:
             on_epoch_metrics(epoch, train_m, val_m)
         return is_best

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Bench-round regression diff: the latest ``BENCH_r*.json`` vs the
+previous one, failing loudly on >20% regression of any named key.
+
+The BENCH trajectory (BENCH_r01..r05) is the repo's performance memory,
+but nothing READ it — a silent 20% throughput slide would ship (PERF.md
+§8 only caught the r3->r4 drift because a human went looking). This
+script is the automated reader:
+
+- flattens each round's ``parsed`` payload (nested sections join with
+  '.'), selects the named higher-is-better keys (default: every
+  throughput figure plus MFU and padding efficiency),
+- prints the full old/new/delta table,
+- emits a GitHub annotation line (``::error``/``::notice``) per
+  regressed key, and exits 1 when any named key regressed beyond the
+  threshold.
+
+CI wires it as a NON-BLOCKING annotation step (continue-on-error: the
+bench numbers come from whatever machine ran the round, so a regression
+is a flag for the next bench run on real hardware, not a merge gate).
+
+Usage::
+
+    python scripts/bench_regress.py                 # repo-root BENCH_r*
+    python scripts/bench_regress.py --dir /path --threshold 0.2
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# higher-is-better keys checked against the threshold; everything else
+# in the flattened payload is printed for context only
+DEFAULT_KEYS = (
+    "value",
+    "atoms_per_sec",
+    "mfu",
+    "epoch_driver_structs_per_sec",
+    "inference_structs_per_sec",
+    "inference_e2e_structs_per_sec",
+    "inference_e2e_multidev_structs_per_sec",
+    "padding_eff_nodes",
+    "padding_eff_edges",
+    "oc20.oc20_structs_per_sec",
+    "tiny.tiny_structs_per_sec",
+    "coo_layout.coo_structs_per_sec",
+    "force_task.force_coo_structs_per_sec",
+    "force_task.force_dense_structs_per_sec",
+)
+
+_ROUND = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def find_rounds(bench_dir: str) -> list[tuple[int, str]]:
+    """[(round number, path)] sorted ascending."""
+    out = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = _ROUND.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def flatten(payload: dict, prefix: str = "") -> dict:
+    """Nested dicts -> {'a.b': v} for every numeric leaf."""
+    out = {}
+    for k, v in payload.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten(v, prefix=f"{key}."))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def load_parsed(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    return flatten(doc.get("parsed", doc))
+
+
+def diff_rounds(old: dict, new: dict, keys, threshold: float) -> dict:
+    """-> {"rows": [...], "regressions": [...]} (rows cover every named
+    key present in either round; a key missing from the NEW round is a
+    regression too — a bench that stopped measuring something is how a
+    regression hides)."""
+    rows, regressions = [], []
+    for key in keys:
+        o, n = old.get(key), new.get(key)
+        if o is None and n is None:
+            continue
+        row = {"key": key, "old": o, "new": n}
+        if o is None:
+            row["note"] = "new key"
+        elif n is None:
+            row["note"] = "DROPPED from latest round"
+            regressions.append(row)
+        elif o > 0:
+            ratio = n / o
+            row["ratio"] = round(ratio, 4)
+            if ratio < 1.0 - threshold:
+                row["note"] = (
+                    f"REGRESSION: {100 * (1 - ratio):.1f}% below previous"
+                )
+                regressions.append(row)
+        rows.append(row)
+    return {"rows": rows, "regressions": regressions}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    p.add_argument("--threshold", type=float, default=0.2,
+                   help="fractional drop that counts as a regression")
+    p.add_argument("--keys", default="",
+                   help="comma-separated override of the named keys")
+    p.add_argument("--github", action="store_true",
+                   help="emit GitHub workflow annotation lines")
+    args = p.parse_args(argv)
+
+    rounds = find_rounds(args.dir)
+    if len(rounds) < 2:
+        print(f"bench_regress: found {len(rounds)} BENCH_r*.json under "
+              f"{args.dir}; need 2 to diff — nothing to do")
+        return 0
+    (old_n, old_path), (new_n, new_path) = rounds[-2], rounds[-1]
+    keys = ([k.strip() for k in args.keys.split(",") if k.strip()]
+            or list(DEFAULT_KEYS))
+    result = diff_rounds(load_parsed(old_path), load_parsed(new_path),
+                         keys, args.threshold)
+
+    print(f"bench_regress: r{old_n:02d} -> r{new_n:02d} "
+          f"(threshold {args.threshold:.0%})")
+    for row in result["rows"]:
+        o = "-" if row["old"] is None else f"{row['old']:.4g}"
+        n = "-" if row["new"] is None else f"{row['new']:.4g}"
+        ratio = f"{row['ratio']:.3f}x" if "ratio" in row else ""
+        note = row.get("note", "")
+        print(f"  {row['key']:<45} {o:>12} -> {n:>12}  {ratio:>8}  {note}")
+
+    if result["regressions"]:
+        for row in result["regressions"]:
+            msg = (f"BENCH r{old_n:02d}->r{new_n:02d} {row['key']}: "
+                   f"{row.get('note', '')} "
+                   f"(old {row['old']}, new {row['new']})")
+            if args.github:
+                print(f"::error title=bench regression::{msg}")
+            print(f"bench_regress: {msg}", file=sys.stderr)
+        return 1
+    msg = (f"no >{args.threshold:.0%} regressions across "
+           f"{len(result['rows'])} named keys (r{old_n:02d}->r{new_n:02d})")
+    if args.github:
+        print(f"::notice title=bench regression check::{msg}")
+    print(f"bench_regress: {msg}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

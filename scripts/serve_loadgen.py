@@ -79,6 +79,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-queue", type=int, default=4096)
     p.add_argument("--report", default="slo_report.json")
     p.add_argument("--seed", type=int, default=0)
+    # ---- live observability plane (ISSUE 6) ----
+    p.add_argument("--telemetry", choices=["off", "epoch"], default="off",
+                   help="serving telemetry level: 'epoch' turns the live "
+                        "plane fully on (span tracing + metrics.jsonl + "
+                        "trace.json under --telemetry-dir) — the A/B leg "
+                        "for the tracing-overhead measurement (PERF §13)")
+    p.add_argument("--telemetry-dir", default="",
+                   help="artifact dir for --telemetry epoch (default: a "
+                        "temp dir next to the report)")
+    p.add_argument("--no-scrape", action="store_true",
+                   help="skip the mid-load /metrics scrape + the "
+                        "scraped-vs-measured p99 agreement assertion")
+    p.add_argument("--scrape-tolerance", type=float, default=0.5,
+                   help="relative p99 disagreement tolerated between the "
+                        "mid-load scrape and the loadgen's own "
+                        "measurement (plus a 15 ms absolute floor)")
+    p.add_argument("--profile-mid", action="store_true",
+                   help="fire one bounded on-demand profile capture "
+                        "mid-load (POST /profile on --http, the gated "
+                        "ProfileCapture in-process) and assert it wrote "
+                        "a non-empty artifact")
     return p
 
 
@@ -154,16 +175,63 @@ class _ClientStats:
         # device_id -> param versions it answered with (the per-device
         # hot-swap consistency record)
         self.device_versions: dict[int, set] = {}
+        # per-request tracing (ISSUE 6): every response must carry a
+        # trace id, and co-batched requests must carry DISTINCT ids —
+        # global uniqueness across the run covers both
+        self.trace_ids: set = set()
+        self.missing_trace = 0
+        self.flush_ids: set = set()
+
+
+def _measured_p99(stats: _ClientStats) -> float:
+    import numpy as np
+
+    with stats.lock:
+        lat = list(stats.latencies)
+    return float(np.percentile(np.asarray(lat), 99)) if lat else 0.0
+
+
+def _scrape_check(text: str, scraped_p99: float,
+                  measured_p99: float, tolerance: float) -> dict:
+    """Validate one /metrics scrape: the exposition format must parse,
+    the three metric families must be present, and the scraped rolling
+    p99 must agree with the loadgen's own measurement within tolerance
+    (relative, with a 15 ms absolute floor — the two windows and the
+    two measurement points differ, so exact equality is not the bar)."""
+    from cgnn_tpu.observe.export import parse_prometheus_text
+
+    out = {"scraped_p99_ms": scraped_p99, "measured_p99_ms": measured_p99}
+    try:
+        fams = parse_prometheus_text(text)
+        out["families"] = len(fams)
+        out["parse_ok"] = True
+    except ValueError as e:
+        out["parse_ok"] = False
+        out["parse_error"] = str(e)
+        return out
+    missing = [p for p in ("cgnn_serve_", "cgnn_device", "cgnn_pipeline_")
+               if not any(f.startswith(p) for f in fams)]
+    out["missing_families"] = missing
+    tol = max(15.0, tolerance * max(scraped_p99, measured_p99))
+    out["tolerance_ms"] = round(tol, 2)
+    out["agree"] = abs(scraped_p99 - measured_p99) <= tol
+    return out
 
 
 def _run_inproc(args) -> dict:
+    import tempfile
+
     import numpy as np
 
     from cgnn_tpu.observe import Telemetry
     from cgnn_tpu.serve.batcher import ServeRejection
     from cgnn_tpu.serve.server import load_server
 
-    telemetry = Telemetry.disabled()
+    if args.telemetry != "off":
+        tdir = args.telemetry_dir or tempfile.mkdtemp(prefix="loadgen-obs-")
+        telemetry = Telemetry(args.telemetry, tdir)
+    else:
+        telemetry = Telemetry.disabled()
     server, parts = load_server(
         args.ckpt_dir,
         batch_size=args.batch_size,
@@ -180,6 +248,8 @@ def _run_inproc(args) -> dict:
         watch=args.hot_swap,
         poll_interval_s=0.2,
     )
+    if args.profile_mid:
+        server.enable_profiling(tempfile.mkdtemp(prefix="loadgen-prof-"))
     server.start()
     compiles_at_warm = server._jit_cache_size()
 
@@ -230,6 +300,14 @@ def _run_inproc(args) -> dict:
                 stats.device_versions.setdefault(di, set()).add(
                     res.param_version
                 )
+                tid = getattr(res, "trace_id", "")
+                if tid:
+                    stats.trace_ids.add(tid)
+                else:
+                    stats.missing_trace += 1
+                fid = getattr(res, "flush_id", "")
+                if fid:
+                    stats.flush_ids.add(fid)
                 if res.cached:
                     stats.cached += 1
                 else:
@@ -242,6 +320,58 @@ def _run_inproc(args) -> dict:
     t_start = time.monotonic()
     for t in threads:
         t.start()
+
+    # mid-load plane checks, each on its own timer thread so the load
+    # keeps running underneath — that is the whole point of a LIVE plane
+    scrape_result: dict = {}
+    profile_result: dict = {}
+
+    def mid_scrape():
+        time.sleep(args.duration * 0.6)
+        text = server.registry.prometheus_text()
+        rolling = server.rolling_quantiles()
+        scrape_result.update(
+            at_s=round(time.monotonic() - t_start, 2),
+            text_bytes=len(text),
+            rolling=rolling,
+            text=text,
+            # the loadgen's own p99 over everything answered SO FAR —
+            # the same window the 60 s rolling scrape covers; comparing
+            # against the end-of-run p99 would mix in traffic the
+            # scrape could not have seen yet
+            measured_now_p99=_measured_p99(stats),
+        )
+
+    def mid_profile():
+        time.sleep(args.duration * 0.4)
+        from cgnn_tpu.observe import ProfileBusy
+
+        try:
+            profile_result.update(server.profiler.capture(0.5), ok=True)
+        except ProfileBusy as e:
+            profile_result.update(ok=False, error=str(e))
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            profile_result.update(ok=False, error=repr(e))
+
+    checkers = []
+    if not args.no_scrape:
+        checkers.append(threading.Thread(target=mid_scrape, daemon=True))
+    if args.profile_mid:
+        checkers.append(threading.Thread(target=mid_profile, daemon=True))
+    for t in checkers:
+        t.start()
+
+    # trace-id probe: a request submitted with an explicit id must echo
+    # it back on its result (the X-Request-Id contract, in-process form)
+    probe_trace = None
+    if pool:
+        try:
+            probe = server.submit(pool[0], timeout_ms=args.timeout_ms,
+                                  trace_id="loadgen-probe-1")
+            probe_trace = probe.result(
+                timeout=args.timeout_ms / 1000.0 + 60.0).trace_id
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            probe_trace = f"ERROR: {e!r}"
 
     swapped_to = None
     if args.hot_swap:
@@ -261,9 +391,13 @@ def _run_inproc(args) -> dict:
     stop.set()
     for t in threads:
         t.join(timeout=args.timeout_ms / 1000.0 + 90.0)
+    for t in checkers:
+        t.join(timeout=30.0)
     wall = time.monotonic() - t_start
     server.drain(timeout_s=60.0)
     compiles_at_end = server._jit_cache_size()
+    if telemetry.enabled:
+        telemetry.close()  # exports trace.json with the request spans
 
     lat = np.asarray(stats.latencies) if stats.latencies else np.zeros(1)
     report = {
@@ -311,8 +445,31 @@ def _run_inproc(args) -> dict:
             "at_end": compiles_at_end,
             "after_warm": (compiles_at_end or 0) - (compiles_at_warm or 0),
         },
+        "tracing": {
+            "unique_trace_ids": len(stats.trace_ids),
+            "missing_trace_ids": stats.missing_trace,
+            "flushes_observed": len(stats.flush_ids),
+            "probe_trace_id": probe_trace,
+            "telemetry": args.telemetry,
+            "trace_json": (os.path.join(telemetry.log_dir, "trace.json")
+                           if telemetry.enabled else None),
+        },
         "server_stats": server.stats(),
     }
+    if scrape_result:
+        report["metrics_scrape"] = {
+            "at_s": scrape_result["at_s"],
+            "text_bytes": scrape_result["text_bytes"],
+            "final_measured_p99_ms": _measured_p99(stats),
+            **_scrape_check(
+                scrape_result["text"],
+                scrape_result.get("rolling", {}).get("p99", 0.0),
+                scrape_result.get("measured_now_p99", 0.0),
+                args.scrape_tolerance,
+            ),
+        }
+    if profile_result:
+        report["profile"] = profile_result
     return report
 
 
@@ -333,6 +490,8 @@ def _run_http(args) -> dict:
     stats = _ClientStats()
     stop = threading.Event()
 
+    base = args.http.rstrip("/")
+
     def client(ci: int):
         rng = np.random.default_rng(args.seed + ci)
         while not stop.is_set():
@@ -345,7 +504,7 @@ def _run_http(args) -> dict:
                 "id": g.cif_id,
             }, "timeout_ms": args.timeout_ms}).encode()
             req = urllib.request.Request(
-                args.http.rstrip("/") + "/predict", data=body,
+                base + "/predict", data=body,
                 headers={"Content-Type": "application/json"},
             )
             with stats.lock:
@@ -367,19 +526,95 @@ def _run_http(args) -> dict:
                 stats.latencies.append(float(payload["latency_ms"]))
                 v = payload["param_version"]
                 stats.versions[v] = stats.versions.get(v, 0) + 1
+                tid = payload.get("trace_id", "")
+                if tid:
+                    stats.trace_ids.add(tid)
+                else:
+                    stats.missing_trace += 1
+                fid = payload.get("flush_id", "")
+                if fid:
+                    stats.flush_ids.add(fid)
+
+    # mid-load wire-path plane checks (GET /metrics, POST /profile) —
+    # fired against the LIVE server while the clients keep hammering it
+    scrape_result: dict = {}
+    profile_result: dict = {}
+
+    def mid_scrape():
+        time.sleep(args.duration * 0.6)
+        try:
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=30.0) as resp:
+                text = resp.read().decode()
+            scrape_result.update(text=text, text_bytes=len(text),
+                                 at_s=round(time.monotonic() - t_start, 2),
+                                 measured_now_p99=_measured_p99(stats))
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            scrape_result.update(error=repr(e))
+
+    def mid_profile():
+        time.sleep(args.duration * 0.4)
+        req = urllib.request.Request(
+            base + "/profile",
+            data=json.dumps({"duration_ms": 500}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60.0) as resp:
+                profile_result.update(json.loads(resp.read()))
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            profile_result.update(ok=False, error=repr(e))
 
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(args.clients)]
+    checkers = []
+    if not args.no_scrape:
+        checkers.append(threading.Thread(target=mid_scrape, daemon=True))
+    if args.profile_mid:
+        checkers.append(threading.Thread(target=mid_profile, daemon=True))
     t_start = time.monotonic()
     for t in threads:
         t.start()
-    time.sleep(args.duration)
+    for t in checkers:
+        t.start()
+
+    # the X-Request-Id contract, over the wire: a probe's inbound header
+    # must come back as its trace id (response body AND echo header)
+    probe_trace = None
+    try:
+        g = pool[0]
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"graph": {
+                "atom_fea": g.atom_fea.tolist(),
+                "edge_fea": g.edge_fea.tolist(),
+                "centers": g.centers.tolist(),
+                "neighbors": g.neighbors.tolist(),
+            }, "timeout_ms": args.timeout_ms}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "loadgen-probe-1"},
+        )
+        with urllib.request.urlopen(
+            req, timeout=args.timeout_ms / 1000.0 + 30.0
+        ) as resp:
+            payload = json.loads(resp.read())
+            header_echo = resp.headers.get("X-Request-Id")
+        probe_trace = payload.get("trace_id")
+        if header_echo != probe_trace:
+            probe_trace = (f"ERROR: body {probe_trace!r} != header "
+                           f"{header_echo!r}")
+    except Exception as e:  # noqa: BLE001 — reported as a failure
+        probe_trace = f"ERROR: {e!r}"
+
+    time.sleep(max(0.0, args.duration - (time.monotonic() - t_start)))
     stop.set()
     for t in threads:
         t.join(timeout=60.0)
+    for t in checkers:
+        t.join(timeout=60.0)
     wall = time.monotonic() - t_start
     lat = np.asarray(stats.latencies) if stats.latencies else np.zeros(1)
-    return {
+    report = {
         "mode": "http",
         "clients": args.clients,
         "duration_s": round(wall, 2),
@@ -393,7 +628,40 @@ def _run_http(args) -> dict:
             "p99": float(np.percentile(lat, 99)),
         },
         "param_versions": stats.versions,
+        "tracing": {
+            "unique_trace_ids": len(stats.trace_ids),
+            "missing_trace_ids": stats.missing_trace,
+            "flushes_observed": len(stats.flush_ids),
+            "probe_trace_id": probe_trace,
+        },
     }
+    if scrape_result:
+        scraped_p99 = 0.0
+        if "text" in scrape_result:
+            from cgnn_tpu.observe.export import parse_prometheus_text
+
+            try:
+                fams = parse_prometheus_text(scrape_result["text"])
+                for name, value in fams.get(
+                        "cgnn_serve_latency_ms", {}).get("samples", []):
+                    if 'quantile="0.99"' in name:
+                        scraped_p99 = value
+            except ValueError:
+                pass
+            report["metrics_scrape"] = {
+                "at_s": scrape_result["at_s"],
+                "text_bytes": scrape_result["text_bytes"],
+                "final_measured_p99_ms": _measured_p99(stats),
+                **_scrape_check(scrape_result["text"], scraped_p99,
+                                scrape_result.get("measured_now_p99", 0.0),
+                                args.scrape_tolerance),
+            }
+        else:
+            report["metrics_scrape"] = {"parse_ok": False,
+                                        **scrape_result}
+    if profile_result:
+        report["profile"] = profile_result
+    return report
 
 
 def main(argv=None) -> int:
@@ -419,6 +687,52 @@ def main(argv=None) -> int:
             f"{report['compiles']['after_warm']} recompiles after warmup "
             f"(must be 0)"
         )
+    tracing = report.get("tracing", {})
+    if tracing:
+        if tracing["missing_trace_ids"]:
+            failures.append(
+                f"{tracing['missing_trace_ids']} responses carried no "
+                f"trace id (every response must)"
+            )
+        if (report["answered"]
+                and tracing["unique_trace_ids"] != report["answered"]):
+            failures.append(
+                f"trace ids not unique: {tracing['unique_trace_ids']} "
+                f"distinct over {report['answered']} answered (co-batched "
+                f"requests must carry DISTINCT ids)"
+            )
+        if tracing["probe_trace_id"] != "loadgen-probe-1":
+            failures.append(
+                f"X-Request-Id probe not honored: sent 'loadgen-probe-1', "
+                f"got {tracing['probe_trace_id']!r}"
+            )
+    scrape = report.get("metrics_scrape")
+    if scrape is not None:
+        if not scrape.get("parse_ok"):
+            failures.append(
+                f"/metrics scrape did not parse as Prometheus exposition "
+                f"format: {scrape.get('parse_error', scrape.get('error'))}"
+            )
+        elif scrape.get("missing_families"):
+            failures.append(
+                f"/metrics missing required metric families: "
+                f"{scrape['missing_families']}"
+            )
+        elif report["answered"] >= 100 and not scrape.get("agree"):
+            failures.append(
+                f"scraped rolling p99 {scrape['scraped_p99_ms']:.1f} ms "
+                f"disagrees with measured p99 "
+                f"{scrape['measured_p99_ms']:.1f} ms beyond tolerance "
+                f"{scrape['tolerance_ms']} ms"
+            )
+    if args.profile_mid:
+        prof = report.get("profile", {})
+        if not prof.get("ok", prof.get("bytes", 0) > 0):
+            failures.append(f"mid-load profile capture failed: {prof}")
+        elif not prof.get("bytes"):
+            failures.append(
+                f"mid-load profile capture wrote an EMPTY artifact: {prof}"
+            )
     if args.hot_swap and not args.http:
         versions = report["param_versions"]
         if report["hot_swap"]["watcher_swaps"] < 1:

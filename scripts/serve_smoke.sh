@@ -11,7 +11,16 @@
 #     (ISSUE 5: distribution + per-replica swap consistency);
 #  2. HTTP front-end: start serve.py, wait for /healthz, fire concurrent
 #     HTTP requests, then SIGTERM -> the server must drain gracefully
-#     (queued requests answered) and exit 0.
+#     (queued requests answered) and exit 0. ISSUE 6 adds the
+#     metrics-scrape leg MID-LOAD: GET /metrics must parse as Prometheus
+#     exposition format with the serve_*/device*/pipeline_* families
+#     present, and POST /profile must complete a bounded on-demand
+#     device-trace capture with a non-empty artifact while traffic
+#     keeps flowing (concurrent captures are rejected 409, not stacked).
+#     Leg 1 additionally turns the full tracing plane on (--telemetry
+#     epoch --profile-mid): every response must carry a distinct trace
+#     id, the X-Request-Id probe must echo, and the scraped rolling p99
+#     must agree with the loadgen's own measurement.
 #
 # Runs anywhere jax[cpu] does (synthetic data, CPU device).
 set -euo pipefail
@@ -25,9 +34,15 @@ PORT="${SERVE_SMOKE_PORT:-18437}"
 echo "== setup: tiny synthetic checkpoint =="
 python scripts/serve_loadgen.py --make-ckpt "$WORK/ckpt"
 
-echo "== leg 1: 64-client in-process load + mid-load hot swap =="
+echo "== leg 1: 64-client in-process load + hot swap + live plane =="
+# --telemetry epoch turns the full tracing/export plane on (span
+# stream + registry + rolling quantiles); --profile-mid fires one gated
+# device-trace capture mid-load. The loadgen's own failure checks cover
+# the new invariants (trace ids on every response, X-Request-Id probe
+# echo, scraped-vs-measured p99 agreement, non-empty profile artifact).
 python scripts/serve_loadgen.py "$WORK/ckpt" \
   --clients 64 --duration 8 --hot-swap \
+  --telemetry epoch --telemetry-dir "$WORK/obs" --profile-mid \
   --report "$WORK/slo_report.json"
 python - "$WORK/slo_report.json" <<'EOF'
 import json, sys
@@ -36,9 +51,32 @@ assert r["dropped"] == 0, r
 assert r["compiles"]["after_warm"] == 0, r["compiles"]
 assert len(r["param_versions"]) >= 2, r["param_versions"]
 assert not r["failures"], r["failures"]
+t = r["tracing"]
+assert t["missing_trace_ids"] == 0 and t["probe_trace_id"] == "loadgen-probe-1", t
+assert r["metrics_scrape"]["parse_ok"] and r["metrics_scrape"]["agree"], (
+    r["metrics_scrape"])
+assert r["profile"]["ok"] and r["profile"]["bytes"] > 0, r["profile"]
 print("leg 1 ok:", r["answered"], "answered @", r["throughput_rps"], "rps,",
       "p99", round(r["latency_ms"]["p99"], 1), "ms, versions",
-      list(r["param_versions"]))
+      list(r["param_versions"]), "| scrape p99",
+      round(r["metrics_scrape"]["scraped_p99_ms"], 1), "ms | profile",
+      r["profile"]["bytes"], "bytes |", t["unique_trace_ids"], "trace ids")
+EOF
+python - "$WORK/obs/trace.json" <<'EOF'
+import json, sys
+# the span-chain acceptance pin: at least one non-cached request span
+# whose flush id joins to pack AND dispatch hop spans
+doc = json.load(open(sys.argv[1]))
+ev = doc["traceEvents"]
+by_flush = {}
+for e in ev:
+    fid = e.get("args", {}).get("flush_id")
+    if fid:
+        by_flush.setdefault(fid, set()).add(e["name"])
+chains = [f for f, names in by_flush.items()
+          if {"serve.request", "serve.pack", "serve.dispatch"} <= names]
+assert chains, f"no full request->pack->dispatch chain in trace: {by_flush}"
+print("leg 1 trace ok:", len(chains), "flushes with full span chains")
 EOF
 
 echo "== leg 1b: compact-staged + pipelined packer (forced; ISSUE 4) =="
@@ -106,8 +144,27 @@ for _ in $(seq 1 600); do
 done
 curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null
 
+# the HTTP loadgen itself scrapes GET /metrics and POSTs /profile
+# MID-LOAD (the wire-path metrics-scrape leg); run it, then re-validate
+# the exposition format + required families with an independent curl
+# while the server is still up
 python scripts/serve_loadgen.py --http "http://127.0.0.1:$PORT" \
-  --clients 8 --duration 4 --report "$WORK/slo_http.json"
+  --clients 8 --duration 6 --profile-mid --report "$WORK/slo_http.json"
+
+echo "== leg 2b: metrics-scrape (exposition format + families) =="
+curl -sf "http://127.0.0.1:$PORT/metrics" > "$WORK/metrics.prom"
+python - "$WORK/metrics.prom" <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from cgnn_tpu.observe.export import parse_prometheus_text
+fams = parse_prometheus_text(open(sys.argv[1]).read())
+for prefix in ("cgnn_serve_", "cgnn_device", "cgnn_pipeline_"):
+    present = [f for f in fams if f.startswith(prefix)]
+    assert present, f"no {prefix}* family in /metrics: {sorted(fams)}"
+assert fams["cgnn_serve_responses_total"]["samples"][0][1] > 0, (
+    "no responses counted by scrape time")
+print("leg 2b ok:", len(fams), "metric families, exposition format parses")
+EOF
 
 kill -TERM "$SPID"
 set +e; wait "$SPID"; RC=$?; set -e
@@ -121,8 +178,17 @@ python - "$WORK/slo_http.json" <<'EOF'
 import json, sys
 r = json.load(open(sys.argv[1]))
 assert r["answered"] > 0, "HTTP leg answered nothing"
+assert not r["failures"], r["failures"]
+t = r["tracing"]
+assert t["missing_trace_ids"] == 0, t
+assert t["probe_trace_id"] == "loadgen-probe-1", t
+s = r["metrics_scrape"]
+assert s["parse_ok"] and not s["missing_families"], s
+p = r["profile"]
+assert p.get("ok") and p.get("bytes", 0) > 0, p
 print("leg 2 ok:", r["answered"], "HTTP responses @",
-      r["throughput_rps"], "rps")
+      r["throughput_rps"], "rps | mid-load /metrics",
+      s["text_bytes"], "bytes | /profile", p["bytes"], "bytes")
 EOF
 
 echo "serve smoke: ALL LEGS PASSED"

@@ -58,6 +58,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(real traffic distribution beats synthetic)")
     p.add_argument("--telemetry-dir", type=str, default="",
                    help="write serving metrics.jsonl here ('' disables)")
+    p.add_argument("--live-metrics", type=float, default=0.0, metavar="SECS",
+                   help="append a registry snapshot (counters/gauges/"
+                        "rolling quantiles) to metrics_live.jsonl every "
+                        "SECS seconds (0 disables); the same live view "
+                        "GET /metrics serves in Prometheus format")
+    p.add_argument("--profile-dir", type=str, default="auto",
+                   help="where POST /profile and SIGUSR2 write bounded "
+                        "on-demand jax.profiler captures ('auto' = the "
+                        "telemetry dir when set, else CKPT_DIR/profiles; "
+                        "'' disables)")
     p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache",
                    metavar="DIR", help="persistent XLA compile cache "
                                        "('' disables; warm restarts replay "
@@ -96,6 +106,10 @@ def main(argv=None) -> int:
         from cgnn_tpu.data.cache import load_graph_cache
 
         calibration = load_graph_cache(args.calibration_cache)
+    profile_dir = args.profile_dir
+    if profile_dir == "auto":
+        profile_dir = args.telemetry_dir or os.path.join(
+            args.ckpt_dir, "profiles")
     try:
         server, parts = load_server(
             args.ckpt_dir,
@@ -113,11 +127,30 @@ def main(argv=None) -> int:
             devices=args.devices,
             watch=args.poll_interval > 0,
             poll_interval_s=args.poll_interval or 2.0,
+            profile_dir=profile_dir,
         )
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
     server.start()
+
+    # the live plane's two push/pull surfaces beyond HTTP: SIGUSR2 ->
+    # bounded on-demand device profile; --live-metrics -> periodic
+    # registry snapshots for fleets scraped by file instead of port
+    if server.profiler is not None:
+        from cgnn_tpu.observe import install_sigusr2
+
+        install_sigusr2(server.profiler, log_fn=print)
+    live_writer = None
+    if args.live_metrics > 0:
+        from cgnn_tpu.observe import LiveMetricsWriter
+
+        live_writer = LiveMetricsWriter(
+            server.registry,
+            os.path.join(args.telemetry_dir or args.ckpt_dir,
+                         "metrics_live.jsonl"),
+            interval_s=args.live_metrics,
+        ).start()
 
     httpd = make_http_server(
         server, host=args.host, port=args.port,
@@ -136,7 +169,9 @@ def main(argv=None) -> int:
     )
     print(f"serving on http://{args.host}:{args.port} "
           f"(params {server.param_store.version}; shapes {shapes}; "
-          f"{len(server.device_set)} device(s))")
+          f"{len(server.device_set)} device(s); live plane: GET /metrics"
+          + (f", POST /profile -> {profile_dir}" if profile_dir else "")
+          + ")")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
@@ -144,6 +179,8 @@ def main(argv=None) -> int:
     httpd.server_close()
     clean = server.drain(timeout_s=30.0)
     handler.uninstall()
+    if live_writer is not None:
+        live_writer.stop()
     stats = server.stats()
     lat = stats["latency_ms"]
     if lat:

@@ -1,0 +1,381 @@
+"""Live observability plane tests (observe/export.py, observe/profile.py,
+the telemetry/spans extensions).
+
+The load-bearing guarantees, pinned:
+
+- RollingSeries retention is bounded by BOTH the sample cap and the time
+  window, with explicit eviction — pushing far more than a window's
+  worth of samples cannot grow memory (the days-long-server invariant);
+- Telemetry value series ride the same windowed retention, and the live
+  sub-window quantiles (the /metrics view) differ from the full-window
+  view exactly when old traffic ages out;
+- the MetricsRegistry snapshot merges telemetry + providers live, its
+  Prometheus rendering parses under the sibling validator with
+  counter/gauge/summary families and per-device labels, and a broken
+  provider cannot take down the scrape;
+- LiveMetricsWriter appends schema-stable snapshots;
+- ProfileCapture is gated (concurrent captures rejected, never
+  stacked), bounded, and writes a non-empty artifact on this backend;
+- SpanTracer's event buffer is bounded with an explicit drop counter,
+  and retro-stamped complete() spans land on the shared timeline.
+"""
+
+import json
+import threading
+
+import pytest
+
+from cgnn_tpu.observe import (
+    LiveMetricsWriter,
+    MetricsRegistry,
+    ProfileBusy,
+    ProfileCapture,
+    RollingSeries,
+    SpanTracer,
+    Telemetry,
+    parse_prometheus_text,
+)
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestRollingSeries:
+    def test_window_eviction_is_explicit_and_bounded(self):
+        clock = _FakeClock()
+        s = RollingSeries(window_s=10.0, max_samples=10_000, clock=clock)
+        # push WAY more than a window's worth: 50 windows of samples
+        for i in range(5000):
+            clock.t = i * 0.1
+            s.add(float(i))
+        # only the last window survives (10s / 0.1s = ~100 samples)
+        assert len(s) <= 101
+        assert s.evicted >= 4890
+        assert s.total_count == 5000  # lifetime accounting intact
+        vals = s.values()
+        assert min(vals) >= 4899.0  # everything old is GONE, not hidden
+        # quantiles describe the window, not the run
+        q = s.quantiles()
+        assert q["count"] == len(vals)
+        assert q["p50"] >= 4899.0
+
+    def test_count_bound_still_applies(self):
+        clock = _FakeClock()
+        s = RollingSeries(window_s=1e9, max_samples=16, clock=clock)
+        for i in range(100):
+            s.add(float(i))
+        assert len(s) == 16
+        assert s.values() == [float(i) for i in range(84, 100)]
+
+    def test_lifetime_totals_are_cumulative_past_eviction(self):
+        # the Prometheus _count/_sum contract: they may NEVER decrease,
+        # even after the window evicts every sample that produced them
+        clock = _FakeClock()
+        s = RollingSeries(window_s=10.0, clock=clock)
+        for i in range(100):
+            clock.t = float(i)
+            s.add(2.0)
+        q = s.quantiles()
+        assert q["count"] < 100  # window shrank...
+        assert q["count_total"] == 100  # ...totals did not
+        assert q["sum_total"] == 200.0
+        clock.t = 1000.0  # everything evicts -> quantiles empty, but a
+        s.evict()         # later sample still reports full totals
+        s.add(5.0)
+        q2 = s.quantiles()
+        assert q2["count"] == 1
+        assert q2["count_total"] == 101 and q2["sum_total"] == 205.0
+
+    def test_time_passes_with_no_appends(self):
+        clock = _FakeClock()
+        s = RollingSeries(window_s=5.0, clock=clock)
+        s.add(1.0)
+        s.add(2.0)
+        clock.t = 100.0
+        s.evict()
+        assert len(s) == 0 and s.quantiles() == {}
+
+    def test_sub_window_narrows(self):
+        clock = _FakeClock()
+        s = RollingSeries(window_s=100.0, clock=clock)
+        s.add(1.0)
+        clock.t = 90.0
+        s.add(9.0)
+        assert sorted(s.values()) == [1.0, 9.0]
+        assert s.values(window_s=20.0) == [9.0]
+        assert s.quantiles(window_s=20.0)["count"] == 1
+
+
+class TestTelemetryWindowedSeries:
+    def test_series_memory_bounded_past_window(self, tmp_path):
+        """The satellite pin: push >window samples through the telemetry
+        facade and the retained series stays bounded, with quantiles
+        covering the window only."""
+        t = Telemetry("epoch", str(tmp_path), use_clu=False,
+                      series_window_s=30.0)
+        clock = _FakeClock()
+        # drive the underlying series with a fake clock (the facade
+        # builds it on first observe_value)
+        t.observe_value("lat", 0.0, keep=100_000)
+        series = t._series["lat"]
+        series._clock = clock
+        series._samples.clear()  # drop the real-clock bootstrap sample
+        for i in range(20_000):
+            clock.t = i * 0.01  # 200s of traffic vs a 30s window
+            t.observe_value("lat", float(i), keep=100_000)
+        assert len(series) <= 3001  # 30s / 0.01s (+1 for the first add)
+        q = t.series_quantiles("lat")
+        assert q["count"] == len(series)
+        assert q["p50"] >= 16_998  # only the recent window
+        # the live sub-window narrows further
+        q5 = t.series_quantiles("lat", window_s=5.0)
+        assert q5["count"] <= 501
+        assert q5["p50"] > q["p50"]
+        t.close()
+
+    def test_run_summary_series_unchanged_for_short_runs(self, tmp_path):
+        from cgnn_tpu.observe import read_jsonl
+
+        t = Telemetry("epoch", str(tmp_path), use_clu=False)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            t.observe_value("serve_latency_ms", v)
+        t.close()
+        recs = read_jsonl(str(tmp_path / "metrics.jsonl"))
+        summary = [r for r in recs if r.get("event") == "run_summary"]
+        assert summary[0]["gauges"]["serve_latency_ms_count"] == 4
+        assert summary[0]["gauges"]["serve_latency_ms_p50"] == 2.5
+
+
+class TestMetricsRegistry:
+    def _registry(self, tmp_path):
+        t = Telemetry("epoch", str(tmp_path), use_clu=False)
+        t.counter_add("serve_requests", 5)
+        t.set_gauge("pipeline_workers", 2.0)
+        t.set_gauge("device0_inflight", 1.0)
+        t.set_gauge("device1_inflight", 3.0)
+        t.observe_value("serve_latency_ms", 10.0)
+        t.observe_value("serve_latency_ms", 30.0)
+        r = MetricsRegistry().attach_telemetry(t)
+        return t, r
+
+    def test_snapshot_merges_live(self, tmp_path):
+        t, r = self._registry(tmp_path)
+        r.add_provider("extra", lambda: {
+            "counters": {"pipeline_jobs": 7},
+            "gauges": {"serve_queue_depth": 4.0},
+        })
+        snap = r.snapshot()
+        assert snap["counters"]["serve_requests"] == 5
+        assert snap["counters"]["pipeline_jobs"] == 7
+        assert snap["gauges"]["serve_queue_depth"] == 4.0
+        assert snap["series"]["serve_latency_ms"]["count"] == 2
+        # live: a counter bump is visible on the NEXT snapshot without
+        # any flush/close
+        t.counter_add("serve_requests", 1)
+        assert r.snapshot()["counters"]["serve_requests"] == 6
+        t.close()
+
+    def test_prometheus_round_trip_and_families(self, tmp_path):
+        t, r = self._registry(tmp_path)
+        text = r.prometheus_text()
+        fams = parse_prometheus_text(text)
+        assert fams["cgnn_serve_requests_total"]["type"] == "counter"
+        assert fams["cgnn_serve_requests_total"]["samples"][0][1] == 5.0
+        # device gauges fold into ONE labeled family
+        dev = fams["cgnn_device_inflight"]
+        assert dev["type"] == "gauge"
+        assert sorted(dev["samples"]) == [
+            ('cgnn_device_inflight{device="0"}', 1.0),
+            ('cgnn_device_inflight{device="1"}', 3.0),
+        ]
+        # series render as summaries with quantile labels + sum/count
+        lat = fams["cgnn_serve_latency_ms"]
+        assert lat["type"] == "summary"
+        names = [n for n, _ in lat["samples"]]
+        assert any('quantile="0.99"' in n for n in names)
+        assert "cgnn_serve_latency_ms_count" in names
+        t.close()
+
+    def test_broken_provider_cannot_kill_scrape(self, tmp_path):
+        t, r = self._registry(tmp_path)
+        r.add_provider("broken", lambda: 1 / 0)
+        snap = r.snapshot()  # no raise
+        assert snap["counters"]["serve_requests"] == 5
+        assert "broken" in r.last_provider_errors
+        parse_prometheus_text(r.prometheus_text())
+        t.close()
+
+    def test_telemetry_off_contributes_nothing(self):
+        r = MetricsRegistry().attach_telemetry(Telemetry.disabled())
+        r.add_provider("serve", lambda: {"counters": {"serve_requests": 1}})
+        snap = r.snapshot()
+        assert snap["counters"] == {"serve_requests": 1}
+
+
+class TestLiveMetricsWriter:
+    def test_appends_snapshots(self, tmp_path):
+        r = MetricsRegistry()
+        ticks = [0]
+
+        def provider():
+            ticks[0] += 1
+            return {"gauges": {"tick": float(ticks[0])}}
+
+        r.add_provider("t", provider)
+        w = LiveMetricsWriter(r, str(tmp_path / "metrics_live.jsonl"),
+                              interval_s=0.05)
+        w.write_once()
+        w.start()
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while w.writes < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        w.stop()
+        lines = [json.loads(ln) for ln in
+                 open(tmp_path / "metrics_live.jsonl")]
+        assert len(lines) >= 3
+        for rec in lines:
+            assert {"time", "counters", "gauges", "series"} <= set(rec)
+        # monotone ticks prove each line is a FRESH snapshot
+        assert lines[1]["gauges"]["tick"] > lines[0]["gauges"]["tick"]
+
+
+class TestProfileCapture:
+    def test_capture_writes_nonempty_artifact(self, tmp_path):
+        spans = SpanTracer()
+        with spans.span("pre_capture"):
+            pass
+        cap = ProfileCapture(str(tmp_path), spans=spans,
+                             log_fn=lambda *a: None)
+        # give the profiler something to see
+        import jax.numpy as jnp
+
+        def work():
+            x = jnp.ones((32, 32))
+            for _ in range(50):
+                x = (x @ x) / 32.0
+            x.block_until_ready()
+
+        t = threading.Thread(target=work)
+        t.start()
+        rec = cap.capture(0.3)
+        t.join()
+        assert rec["bytes"] > 0 and rec["files"] > 0
+        assert cap.captures == 1
+        # the host span window landed next to the device trace
+        doc = json.load(open(rec["host_trace"]))
+        assert any(e["name"] == "pre_capture" for e in doc["traceEvents"])
+
+    def test_concurrent_capture_rejected_not_stacked(self, tmp_path):
+        cap = ProfileCapture(str(tmp_path), log_fn=lambda *a: None)
+        # hold the gate as a running capture would (two real overlapping
+        # jax profiler sessions would crash the process, which is
+        # exactly why the gate exists)
+        assert cap._gate.acquire(blocking=False)
+        try:
+            assert cap.busy
+            with pytest.raises(ProfileBusy):
+                cap.capture(0.05)
+        finally:
+            cap._gate.release()
+        assert cap.rejected == 1 and cap.captures == 0
+        assert not cap.busy
+
+    def test_wait_idle_blocks_until_capture_done(self, tmp_path):
+        # shutdown paths wait out an in-flight capture: tearing the
+        # process down mid-trace segfaults in the profiler backend
+        cap = ProfileCapture(str(tmp_path), log_fn=lambda *a: None)
+        assert cap.wait_idle(timeout_s=0.1)  # idle: returns immediately
+        assert cap._gate.acquire(blocking=False)
+        try:
+            assert not cap.wait_idle(timeout_s=0.05)  # busy: times out
+            timer = threading.Timer(0.2, cap._gate.release)
+            timer.start()
+            assert cap.wait_idle(timeout_s=5.0)  # released: unblocks
+        finally:
+            timer.cancel()
+            if cap._gate.acquire(blocking=False):
+                cap._gate.release()
+
+    def test_duration_is_bounded(self, tmp_path):
+        cap = ProfileCapture(str(tmp_path), max_duration_s=0.2,
+                             log_fn=lambda *a: None)
+        import time
+
+        t0 = time.perf_counter()
+        rec = cap.capture(60.0)  # an operator typo, clamped
+        # generous bound: the sleep is 0.2s; trace write adds overhead
+        assert time.perf_counter() - t0 < 30.0
+        assert rec["duration_s"] >= 0.2
+
+
+class TestSpanTracerBounds:
+    def test_event_cap_counts_drops(self, tmp_path):
+        tr = SpanTracer(max_events=10)
+        for i in range(25):
+            tr.instant("e", i=i)
+        assert len(tr.events) == 10
+        assert tr.dropped == 15
+        # ring semantics: the NEWEST events survive (a live trace must
+        # show recent requests, not the startup era)
+        assert [e["args"]["i"] for e in tr.events] == list(range(15, 25))
+        doc = json.load(open(tr.export(str(tmp_path / "t.json"))))
+        meta = [e for e in doc["traceEvents"]
+                if e.get("name") == "events_dropped"]
+        assert meta and meta[0]["args"]["dropped"] == 15
+
+    def test_complete_retro_stamps_on_shared_timeline(self, tmp_path):
+        tr = SpanTracer()
+        t0 = tr.now_s()
+        with tr.span("live"):
+            pass
+        t1 = tr.now_s()
+        tr.complete("retro", t0, t1, trace_id="req-1")
+        doc = json.load(open(tr.export(str(tmp_path / "t.json"))))
+        retro = [e for e in doc["traceEvents"] if e["name"] == "retro"][0]
+        live = [e for e in doc["traceEvents"] if e["name"] == "live"][0]
+        assert retro["args"]["trace_id"] == "req-1"
+        # the retro span covers the live one on the same clock
+        assert retro["ts"] <= live["ts"]
+        assert retro["ts"] + retro["dur"] >= live["ts"] + live["dur"]
+
+
+class TestBenchRegress:
+    def test_regression_detected_and_annotated(self, tmp_path, capsys):
+        import sys
+
+        sys.path.insert(0, "scripts")
+        import bench_regress
+
+        old = {"parsed": {"value": 100.0, "mfu": 0.03,
+                          "oc20": {"oc20_structs_per_sec": 50.0}}}
+        new_ok = {"parsed": {"value": 95.0, "mfu": 0.03,
+                             "oc20": {"oc20_structs_per_sec": 55.0}}}
+        new_bad = {"parsed": {"value": 70.0, "mfu": 0.03,
+                              "oc20": {"oc20_structs_per_sec": 55.0}}}
+        json.dump(old, open(tmp_path / "BENCH_r01.json", "w"))
+        json.dump(new_ok, open(tmp_path / "BENCH_r02.json", "w"))
+        assert bench_regress.main(["--dir", str(tmp_path)]) == 0
+        json.dump(new_bad, open(tmp_path / "BENCH_r03.json", "w"))
+        rc = bench_regress.main(["--dir", str(tmp_path), "--github"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "::error" in out and "value" in out
+
+    def test_dropped_key_is_a_regression(self, tmp_path):
+        import sys
+
+        sys.path.insert(0, "scripts")
+        import bench_regress
+
+        json.dump({"parsed": {"value": 100.0, "mfu": 0.03}},
+                  open(tmp_path / "BENCH_r01.json", "w"))
+        json.dump({"parsed": {"value": 101.0}},
+                  open(tmp_path / "BENCH_r02.json", "w"))
+        assert bench_regress.main(["--dir", str(tmp_path)]) == 1

@@ -813,3 +813,163 @@ def server_predict_reference(state, ss, graph):
     step = jax.jit(_mps(ss.expander()))
     out = np.asarray(step(state, ss.pack([graph])))
     return out[0]
+
+
+# ------------------------------------------- live observability (ISSUE 6)
+
+
+class TestRequestTracing:
+    """Per-request tracing propagation: trace ids minted at admission
+    appear on the response, the span stream, and the cache-hit fast
+    path; co-batched requests carry distinct ids under one flush id;
+    and the plane changes NO served number (bit-exact on vs off)."""
+
+    def test_trace_id_on_response_spans_and_cache_hit(
+            self, graphs, shape_set, model_state, tmp_path):
+        import json
+
+        telemetry = Telemetry(level="epoch", log_dir=str(tmp_path),
+                              use_clu=False)
+        server = _make_server(model_state, shape_set, cache_size=16,
+                              telemetry=telemetry)
+        server.warm(graphs[0])
+        server.start()
+        first = server.predict(graphs[2], timeout_ms=30000,
+                               trace_id="client-supplied-42")
+        hit = server.predict(graphs[2], timeout_ms=30000)
+        minted = server.predict(graphs[3], timeout_ms=30000)
+        assert server.drain(timeout_s=30.0)
+        # response: the inbound id is honored verbatim; absent one, the
+        # server mints req-<prefix>-<seq>; the cache hit gets its OWN id
+        assert first.trace_id == "client-supplied-42"
+        assert not first.cached and hit.cached
+        assert hit.trace_id and hit.trace_id != first.trace_id
+        assert minted.trace_id.startswith("req-")
+        # stage stamps: full journey on a computed result, the two-stop
+        # journey on a cache hit, and monotone ordering throughout
+        assert set(first.stamps) == {"queued", "packed", "dispatched",
+                                     "fetched", "replied"}
+        s = first.stamps
+        assert (s["queued"] <= s["packed"] <= s["dispatched"]
+                <= s["fetched"] <= s["replied"])
+        assert set(hit.stamps) == {"queued", "replied"}
+        assert first.flush_id and hit.flush_id == ""
+        telemetry.close()
+        doc = json.load(open(tmp_path / "trace.json"))
+        reqs = {e["args"].get("trace_id"): e for e in doc["traceEvents"]
+                if e["name"] == "serve.request"}
+        # every journey (incl. the cache hit) is a span carrying its id
+        assert "client-supplied-42" in reqs
+        assert hit.trace_id in reqs and reqs[hit.trace_id]["args"]["cached"]
+        assert minted.trace_id in reqs
+        # the flush-level hops join to the request via flush_id
+        packs = [e for e in doc["traceEvents"] if e["name"] == "serve.pack"]
+        dispatches = [e for e in doc["traceEvents"]
+                      if e["name"] == "serve.dispatch"]
+        fid = reqs["client-supplied-42"]["args"]["flush_id"]
+        assert any(e["args"]["flush_id"] == fid
+                   and "client-supplied-42" in e["args"]["trace_ids"]
+                   for e in packs)
+        assert any(e["args"]["flush_id"] == fid for e in dispatches)
+
+    def test_cobatched_requests_distinct_ids_shared_flush(
+            self, graphs, shape_set, model_state):
+        # a large max_wait lets one deadline flush coalesce the burst
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              max_wait_ms=150.0)
+        server.warm(graphs[0])
+        server.start()
+        futs = [server.submit(g, timeout_ms=30000) for g in graphs[:6]]
+        results = [f.result(timeout=30.0) for f in futs]
+        assert server.drain(timeout_s=30.0)
+        ids = [r.trace_id for r in results]
+        assert len(set(ids)) == len(ids)  # DISTINCT per request
+        flushes = {r.flush_id for r in results}
+        assert len(flushes) == 1  # ONE shared flush/batch id
+        (fid,) = flushes
+        assert fid.startswith("flush-")
+        # co-batched => identical flush-level stamps, distinct queued
+        packed = {r.stamps["packed"] for r in results}
+        dispatched = {r.stamps["dispatched"] for r in results}
+        assert len(packed) == 1 and len(dispatched) == 1
+
+    def test_served_numbers_bit_exact_plane_on_vs_off(
+            self, graphs, shape_set, model_state, tmp_path):
+        """The PR-1 invariant, serving flavor: the full plane (tracing +
+        registry + rolling series) must not move ONE BIT of any served
+        value."""
+        def run(telemetry):
+            server = _make_server(model_state, shape_set, cache_size=0,
+                                  telemetry=telemetry)
+            server.warm(graphs[0])
+            server.start()
+            futs = [server.submit(g, timeout_ms=30000)
+                    for g in graphs[:16]]
+            preds = [f.result(timeout=30.0).prediction for f in futs]
+            assert server.drain(timeout_s=30.0)
+            return np.stack(preds)
+
+        off = run(Telemetry.disabled())
+        on_t = Telemetry(level="epoch", log_dir=str(tmp_path),
+                         use_clu=False)
+        on = run(on_t)
+        on_t.close()
+        np.testing.assert_array_equal(off, on)  # bitwise
+
+    def test_stats_rolling_window_and_inflight(self, graphs, shape_set,
+                                               model_state):
+        server = _make_server(model_state, shape_set, cache_size=0)
+        server.warm(graphs[0])
+        server.start()
+        for g in graphs[:8]:
+            server.predict(g, timeout_ms=30000)
+        stats = server.stats()
+        rolling = stats["rolling"]
+        assert rolling["window_s"] == server.rolling_window_s
+        assert rolling["latency_ms"]["count"] >= 8
+        assert rolling["latency_ms"]["p99"] >= rolling["latency_ms"]["p50"]
+        assert rolling["device_inflight"] == [0]
+        assert server.drain(timeout_s=30.0)
+
+    def test_metrics_endpoint_families(self, graphs, shape_set,
+                                       model_state):
+        """GET /metrics renders the registry with the three required
+        families present whatever the telemetry level (here: off)."""
+        from cgnn_tpu.observe import parse_prometheus_text
+
+        server = _make_server(model_state, shape_set, cache_size=0)
+        server.warm(graphs[0])
+        server.start()
+        for g in graphs[:4]:
+            server.predict(g, timeout_ms=30000)
+        text = server.registry.prometheus_text()
+        assert server.drain(timeout_s=30.0)
+        fams = parse_prometheus_text(text)
+        for prefix in ("cgnn_serve_", "cgnn_device", "cgnn_pipeline_"):
+            assert any(f.startswith(prefix) for f in fams), (prefix, fams)
+        assert fams["cgnn_serve_responses_total"]["samples"][0][1] == 4.0
+        lat = fams["cgnn_serve_latency_ms"]
+        assert any('quantile="0.99"' in n for n, _ in lat["samples"])
+
+    def test_profile_endpoint_gate_and_artifact(self, graphs, shape_set,
+                                                model_state, tmp_path):
+        from cgnn_tpu.observe import ProfileBusy
+
+        server = _make_server(model_state, shape_set, cache_size=0)
+        server.warm(graphs[0])
+        server.start()
+        profiler = server.enable_profiling(str(tmp_path))
+        rec = profiler.capture(0.2)
+        assert rec["bytes"] > 0
+        assert profiler._gate.acquire(blocking=False)
+        try:
+            with pytest.raises(ProfileBusy):
+                profiler.capture(0.1)
+        finally:
+            profiler._gate.release()
+        # profiling staged nothing: the compile pin survives a capture
+        n0 = server._jit_cache_size()
+        server.predict(graphs[0], timeout_ms=30000)
+        assert server._jit_cache_size() == n0
+        assert server.drain(timeout_s=30.0)
+        assert server.stats()["recompiles_after_warm"] == 0

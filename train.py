@@ -117,6 +117,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-dir", type=str, default="",
                    help="metrics dir (metrics.jsonl + TensorBoard when "
                         "available); default: <ckpt-dir>/logs")
+    p.add_argument("--live-metrics", type=float, default=0.0, metavar="SECS",
+                   help="append a live registry snapshot (counters, "
+                        "gauges, rolling-window quantiles) to "
+                        "metrics_live.jsonl in the log dir every SECS "
+                        "seconds, so a multi-hour run is scrapeable "
+                        "MID-FLIGHT instead of only at exit (0 disables; "
+                        "needs --telemetry != off). SIGUSR2 additionally "
+                        "captures a bounded on-demand jax.profiler trace "
+                        "into the log dir at any time")
     p.add_argument("--profile", type=int, default=0, metavar="N",
                    help="trace N post-compile steps of the first epoch with "
                         "jax.profiler (xprof/perfetto trace in the log dir)")
@@ -276,6 +285,33 @@ def main(argv=None) -> int:
 
     log_dir = args.log_dir or os.path.join(args.ckpt_dir, "logs")
     telemetry = Telemetry(args.telemetry, log_dir)
+
+    # the live observability plane (ISSUE 6), training flavor: a
+    # periodic metrics_live.jsonl appender over the export registry
+    # (scrape a run mid-flight by file), and SIGUSR2 -> one bounded
+    # on-demand device-profile capture — both host-side only, so the
+    # trajectory stays bit-identical with the plane on or off
+    live_writer = None
+    if args.live_metrics > 0 and telemetry.enabled:
+        from cgnn_tpu.observe import LiveMetricsWriter, MetricsRegistry
+
+        # window matched to the telemetry retention (15 min), NOT the
+        # serving 60 s default: training observes epoch_time_s once per
+        # epoch, and a 60 s window would report an empty series on
+        # nearly every tick of a run with multi-minute epochs
+        live_writer = LiveMetricsWriter(
+            MetricsRegistry(
+                window_s=telemetry.series_window_s
+            ).attach_telemetry(telemetry),
+            os.path.join(log_dir, "metrics_live.jsonl"),
+            interval_s=args.live_metrics,
+        ).start()
+    profiler = None
+    if telemetry.enabled:
+        from cgnn_tpu.observe import ProfileCapture, install_sigusr2
+
+        profiler = ProfileCapture(log_dir, spans=telemetry.spans)
+        install_sigusr2(profiler, log_fn=print)
 
     # SIGTERM/SIGINT -> checkpoint at the next epoch/chunk boundary and
     # exit resumable (75); a second signal kills immediately
@@ -707,6 +743,11 @@ def main(argv=None) -> int:
         from cgnn_tpu.resilience.preempt import resumable_exit
 
         ckpt.close()
+        if live_writer is not None:
+            live_writer.stop()
+        if profiler is not None:
+            # exiting mid-capture segfaults in the profiler backend
+            profiler.wait_idle()
         telemetry.sample_hbm("preempted")
         telemetry.close()
         return resumable_exit(print)
@@ -752,6 +793,11 @@ def main(argv=None) -> int:
 
     telemetry.write_scalars(args.epochs, test_m, prefix="test")
     telemetry.sample_hbm("end_of_run")
+    if live_writer is not None:
+        live_writer.stop()
+    if profiler is not None:
+        # exiting mid-capture segfaults in the profiler backend
+        profiler.wait_idle()
     telemetry.close()  # flushes gauges/counters; exports trace.json
     ckpt.close()
     return 0
