@@ -28,6 +28,8 @@ from __future__ import annotations
 import json
 import time
 
+from cgnn_tpu.observe.metrics_io import jsonfinite
+
 # bf16 matmul peak by device kind (dense bf16, not the int8 headline).
 _PEAK_FLOPS = {
     "TPU v5 lite": 197e12,  # v5e
@@ -378,7 +380,7 @@ def main() -> None:
 
     value = mp["structs_per_sec"]
     print(
-        json.dumps(
+        json.dumps(jsonfinite(
             {
                 "metric": "train_structures_per_sec_per_chip_mp_distribution",
                 "value": value,
@@ -428,7 +430,7 @@ def main() -> None:
                 "tiny": tiny,
                 "coo_layout": flat,
                 "force_task": {**force_coo, **force_dense},
-            }
+            })
         )
     )
 

@@ -1,0 +1,37 @@
+"""Static analysis + runtime race detection for the repo's invariants.
+
+Six PRs of hard-won correctness rules — donation/aliasing safety, the
+stop-event thread-shutdown contract, counts-under-lock scrapes, the
+zero-post-warmup-recompile discipline — lived only in CHANGES.md prose
+and scattered tests. This package makes them *mechanical*:
+
+- ``engine`` + ``rules``: the AST linter behind ``graftcheck.py`` —
+  repo-specific rules, each carrying the CHANGES.md incident that
+  motivated it, with ``# graftcheck: disable=RULE -- why`` escape
+  hatches that REQUIRE a justification string (see INVARIANTS.md);
+- ``racecheck``: the opt-in (``CGNN_TPU_RACECHECK=1``) runtime
+  companion — instrumented locks that record acquisition order per
+  thread and flag lock-order inversions, cross-thread unprotected
+  access to registered shared fields, and a deadlock watchdog that
+  dumps every thread's stack (with names) when a serving thread goes
+  silent past a bound. Zero overhead when the env gate is off.
+
+Everything in ``engine``/``rules`` is stdlib-only (ast + tokenize): the
+CI ``static-analysis`` job runs without jax installed.
+"""
+
+from cgnn_tpu.analysis.engine import (
+    Finding,
+    check_file,
+    check_paths,
+    default_targets,
+)
+from cgnn_tpu.analysis.rules import RULES
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "check_file",
+    "check_paths",
+    "default_targets",
+]

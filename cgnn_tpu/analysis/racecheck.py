@@ -1,0 +1,431 @@
+"""Runtime race / lock-discipline detector (the graftcheck companion).
+
+Static rules (rules.py) catch what an AST can see; this module catches
+what only execution shows: the ORDER locks are really taken in, fields
+really shared across threads, and threads that really wedge. Opt-in via
+``CGNN_TPU_RACECHECK=1`` — the serve-smoke CI leg runs the full
+64-client load under it and asserts zero inversions/violations — and
+ZERO overhead when off: ``make_lock`` returns a plain
+``threading.Lock`` and every hook is a no-op (PERF.md §14).
+
+Three detectors:
+
+- **Lock-order inversions** (:func:`make_lock` / :func:`make_condition`):
+  every successful acquisition records held-lock -> new-lock edges per
+  thread; a pair of locks observed in BOTH orders is a deadlock waiting
+  for the right interleaving — flagged immediately, with the thread
+  names that produced each direction.
+- **Unprotected shared-field access** (:func:`watch_fields`): registered
+  fields of an object (the server's counts/latency buffers) are checked
+  on every get/set — a touch from a thread other than the registering
+  one without the guarding lock held is a violation. This is the PR-6
+  scrape bug as a runtime tripwire.
+- **Deadlock watchdog** (:func:`start_watchdog` + :func:`heartbeat`):
+  loops that matter (serve dispatch, pack workers, the reload watcher)
+  call ``heartbeat()`` each iteration; a registered thread silent past
+  the bound triggers a faulthandler dump of EVERY thread's stack,
+  prefixed with an ident -> thread-name map so the dump is attributable
+  (thread names are a graftcheck rule for exactly this reason).
+
+``report()`` aggregates all three; the loadgen folds it into the SLO
+report and fails the run on any nonzero count.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import io
+import os
+import sys
+import threading
+import time
+
+ENV_VAR = "CGNN_TPU_RACECHECK"
+
+_enabled = os.environ.get(ENV_VAR, "") not in ("", "0", "false", "no")
+
+_state_lock = threading.Lock()  # guards the registries below
+_held = threading.local()       # per-thread list of held _LockInfo
+_edges: dict = {}               # (id_a, id_b) -> (name_a, name_b, thread)
+_inversions: list = []
+_inversion_keys: set = set()
+_violations: list = []
+_beats: dict = {}               # thread name -> (last beat, ident)
+_beats_seen: set = set()        # every name that EVER heartbeated (never
+                                # pruned: the "watchdog watched something"
+                                # assertion must survive clean exits)
+_watchdog = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Flip the gate programmatically (tests; production uses the env
+    var at import). Locks made while off stay plain — only NEW locks
+    are instrumented."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def reset() -> None:
+    """Drop all recorded state (test isolation)."""
+    global _watchdog
+    with _state_lock:
+        _edges.clear()
+        _inversions.clear()
+        _inversion_keys.clear()
+        _violations.clear()
+        _beats.clear()
+        _beats_seen.clear()
+    if _watchdog is not None:
+        _watchdog.stop()
+        _watchdog = None
+
+
+class _LockInfo:
+    __slots__ = ("name", "lock_id")
+
+    def __init__(self, name: str, lock_id: int):
+        self.name = name
+        self.lock_id = lock_id
+
+
+def _held_list() -> list:
+    lst = getattr(_held, "list", None)
+    if lst is None:
+        lst = _held.list = []
+    return lst
+
+
+def _note_acquired(info: _LockInfo) -> None:
+    held = _held_list()
+    tname = threading.current_thread().name
+    if held:
+        with _state_lock:
+            for h in held:
+                if h.lock_id == info.lock_id:
+                    continue  # re-entrant acquire of the same lock
+                edge = (h.lock_id, info.lock_id)
+                back = (info.lock_id, h.lock_id)
+                _edges.setdefault(edge, (h.name, info.name, tname))
+                if back in _edges:
+                    key = tuple(sorted(edge))
+                    if key not in _inversion_keys:
+                        _inversion_keys.add(key)
+                        a_name, b_name, other = _edges[back]
+                        _inversions.append({
+                            "locks": sorted((h.name, info.name)),
+                            "order_a": f"{h.name} -> {info.name} "
+                                       f"in {tname}",
+                            "order_b": f"{a_name} -> {b_name} "
+                                       f"in {other}",
+                        })
+    held.append(info)
+
+
+def _note_released(info: _LockInfo) -> None:
+    held = _held_list()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is info or held[i].lock_id == info.lock_id:
+            del held[i]
+            break
+
+
+class InstrumentedLock:
+    """A Lock/RLock wrapper recording acquisition order per thread.
+
+    Duck-compatible with ``threading.Lock`` (acquire/release/context
+    manager) and with ``threading.Condition``'s lock protocol
+    (``_is_owned`` is provided so Condition never runs its acquire(0)
+    probe, which would record phantom acquisitions).
+    """
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self._info = _LockInfo(name, id(self))
+        self._owner: int | None = None
+        self._depth = 0
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            ident = threading.get_ident()
+            if self._owner == ident:
+                self._depth += 1
+            else:
+                self._owner = ident
+                self._depth = 1
+                _note_acquired(self._info)
+        return ok
+
+    def release(self) -> None:
+        ident = threading.get_ident()
+        if self._owner == ident:
+            self._depth -= 1
+            if self._depth == 0:
+                self._owner = None
+                _note_released(self._info)
+        self._lock.release()
+
+    def held_by_current(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    # Condition's lock protocol: _is_owned avoids the acquire(0) probe
+    # (which would record phantom acquisitions); _release_save /
+    # _acquire_restore make recursive holds survive Condition.wait()
+    def _is_owned(self) -> bool:
+        return self.held_by_current()
+
+    def _release_save(self):
+        depth = self._depth
+        self._depth = 0
+        self._owner = None
+        _note_released(self._info)
+        for _ in range(depth):
+            self._lock.release()
+        return depth
+
+    def _acquire_restore(self, depth) -> None:
+        for _ in range(depth):
+            self._lock.acquire()
+        self._owner = threading.get_ident()
+        self._depth = depth
+        _note_acquired(self._info)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+
+def make_lock(name: str):
+    """A named, instrumented lock when racecheck is on; a plain
+    ``threading.Lock`` (zero overhead) when off."""
+    if not _enabled:
+        return threading.Lock()
+    return InstrumentedLock(name)
+
+
+def make_condition(name: str):
+    """A Condition over an instrumented (reentrant) lock when on."""
+    if not _enabled:
+        return threading.Condition()
+    return threading.Condition(InstrumentedLock(name, reentrant=True))
+
+
+# ---- shared-field watching ------------------------------------------
+
+_WATCH_ATTR = "__racecheck_watch__"
+
+
+def watch_fields(obj, lock, fields) -> None:
+    """Register ``fields`` of ``obj`` as guarded by ``lock``: any
+    get/set from a thread other than the registering one without the
+    lock held records a violation. No-op unless racecheck is on AND
+    ``lock`` is an :class:`InstrumentedLock` (the plain-lock fallback
+    cannot answer 'held by current thread?').
+
+    Implementation: the instance's class is swapped for a one-off
+    subclass overriding ``__getattribute__``/``__setattr__`` — the
+    overhead lands only on watched instances, only when enabled.
+    """
+    if not _enabled or not isinstance(lock, InstrumentedLock):
+        return
+    fields = frozenset(fields)
+    owner_thread = threading.current_thread().name
+    cls = type(obj)
+
+    def _check(name: str, mode: str) -> None:
+        t = threading.current_thread().name
+        if t == owner_thread or lock.held_by_current():
+            return
+        with _state_lock:
+            if len(_violations) < 1000:
+                _violations.append({
+                    "class": cls.__name__,
+                    "field": name,
+                    "mode": mode,
+                    "thread": t,
+                    "lock": lock.name,
+                })
+
+    class _Watched(cls):  # type: ignore[misc, valid-type]
+        def __getattribute__(self, name):
+            if name in fields:
+                _check(name, "read")
+            return super().__getattribute__(name)
+
+        def __setattr__(self, name, value):
+            if name in fields:
+                _check(name, "write")
+            super().__setattr__(name, value)
+
+    _Watched.__name__ = cls.__name__
+    _Watched.__qualname__ = cls.__qualname__
+    setattr(_Watched, _WATCH_ATTR, True)
+    obj.__class__ = _Watched
+
+
+# ---- heartbeats + deadlock watchdog ---------------------------------
+
+
+def heartbeat() -> None:
+    """Record 'this thread is alive and looping'. First beat registers
+    the thread with the watchdog (by NAME — graftcheck's GC-THREADNAME
+    rule exists so this registry is readable). No-op when off."""
+    if not _enabled:
+        return
+    t = threading.current_thread()
+    with _state_lock:
+        _beats[t.name] = (time.monotonic(), t.ident)
+        _beats_seen.add(t.name)
+
+
+class Watchdog:
+    """Dump every thread's stack when a heartbeating thread goes silent.
+
+    ``bound_s`` is the silence tolerance; a thread that exited cleanly
+    (no live thread with its ident) is unregistered, not reported. The
+    dump goes to ``sink`` (default stderr) prefixed with an
+    ident -> name map so faulthandler's nameless stacks are
+    attributable.
+    """
+
+    def __init__(self, bound_s: float = 30.0, interval_s: float | None = None,
+                 sink=None, log_fn=None):
+        self.bound_s = float(bound_s)
+        self.interval_s = (interval_s if interval_s is not None
+                           else max(0.2, self.bound_s / 4))
+        self.sink = sink
+        self._log = log_fn or (lambda m: print(m, file=sys.stderr))
+        self._stop = threading.Event()
+        self.dumps = 0
+        self.stalled: list = []
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="racecheck-watchdog"
+        )
+
+    def start(self) -> "Watchdog":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def check_once(self, now: float | None = None) -> list:
+        """The synchronous unit: names silent past the bound right now
+        (dead threads pruned, not reported)."""
+        now = time.monotonic() if now is None else now
+        # ident -> name, not a bare ident set: CPython reuses thread
+        # idents, so "ident still alive" alone would pin a cleanly
+        # exited thread's stale beat to an unrelated newcomer and dump
+        # a spurious deadlock 30 s later
+        alive = {t.ident: t.name for t in threading.enumerate()}
+        stalled = []
+        with _state_lock:
+            for name in list(_beats):
+                last, ident = _beats[name]
+                if alive.get(ident) != name:
+                    del _beats[name]  # clean exit, not a deadlock
+                    continue
+                if now - last > self.bound_s:
+                    stalled.append(name)
+        return stalled
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            stalled = self.check_once()
+            if stalled:
+                self.dumps += 1
+                self.stalled.extend(n for n in stalled
+                                    if n not in self.stalled)
+                self.dump(stalled)
+                # one dump per stall: re-arm the beats so a recovered
+                # thread isn't re-reported every tick
+                now = time.monotonic()
+                with _state_lock:
+                    for name in stalled:
+                        if name in _beats:
+                            _beats[name] = (now, _beats[name][1])
+
+    def dump(self, stalled) -> None:
+        sink = self.sink or sys.stderr
+        names = {t.ident: t.name for t in threading.enumerate()}
+        sink.write(
+            f"\n=== racecheck deadlock watchdog: thread(s) {stalled} "
+            f"silent > {self.bound_s:.1f}s ===\n"
+        )
+        for ident, name in sorted(names.items(), key=lambda kv: kv[1]):
+            sink.write(f"  thread 0x{ident:x} = {name}\n")
+        sink.flush()
+        try:
+            faulthandler.dump_traceback(file=sink, all_threads=True)
+        except (ValueError, io.UnsupportedOperation):
+            # sink without a real fd (StringIO in tests): names + the
+            # stall report above are still the attributable part
+            pass
+        sink.flush()
+        self._log(
+            f"racecheck: WATCHDOG dump #{self.dumps + 0} — {stalled} "
+            f"silent past {self.bound_s:.1f}s (see stderr for stacks)"
+        )
+
+
+def start_watchdog(bound_s: float = 30.0, **kw):
+    """Start the singleton watchdog (None when racecheck is off).
+
+    A later call re-arms the existing singleton with the new bound and
+    log/sink targets rather than silently ignoring them: a second
+    server started in the same process must not leave stall logs wired
+    to (and the closure pinning) a drained predecessor.
+    """
+    global _watchdog
+    if not _enabled:
+        return None
+    if _watchdog is None:
+        _watchdog = Watchdog(bound_s=bound_s, **kw).start()
+    else:
+        _watchdog.bound_s = float(bound_s)
+        _watchdog.interval_s = (kw.get("interval_s")
+                                or max(0.2, _watchdog.bound_s / 4))
+        if kw.get("log_fn") is not None:
+            _watchdog._log = kw["log_fn"]
+        if kw.get("sink") is not None:
+            _watchdog.sink = kw["sink"]
+    return _watchdog
+
+
+# ---- reporting -------------------------------------------------------
+
+
+def report() -> dict:
+    """The aggregate the loadgen folds into its SLO report."""
+    with _state_lock:
+        inversions = list(_inversions)
+        violations = list(_violations)
+        beats = sorted(_beats)
+        seen = sorted(_beats_seen)
+    dumps = 0 if _watchdog is None else _watchdog.dumps
+    stalled = [] if _watchdog is None else list(_watchdog.stalled)
+    return {
+        "enabled": _enabled,
+        "inversions": inversions,
+        "violations": violations,
+        "deadlock_dumps": dumps,
+        "stalled_threads": stalled,
+        # live beats only (cleanly exited threads pruned) vs every name
+        # that ever registered — asserts about "the watchdog watched
+        # SOMETHING" must use the latter or they race thread shutdown
+        "heartbeating_threads": beats,
+        "heartbeats_seen": seen,
+        "clean": not (inversions or violations or dumps),
+    }

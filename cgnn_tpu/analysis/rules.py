@@ -1,0 +1,686 @@
+"""The graftcheck rule catalog: repo-specific AST checks.
+
+Each rule encodes an invariant this repo already paid for once; the
+message cites the CHANGES.md incident so a finding explains *why* it is
+a bug here, not just what pattern matched. INVARIANTS.md is the prose
+catalog. Rules are deliberately narrow — a linter the tree cannot run
+clean against gets disabled, not obeyed — and every rule has a
+``# graftcheck: disable=RULE -- justification`` escape hatch (engine.py)
+for the audited exceptions.
+
+Stdlib-only (ast): no jax import, so the CI job runs anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+# rule id -> one-line description (the --list-rules output; INVARIANTS.md
+# carries the full incident write-ups)
+RULES = {
+    "GC-ALIAS": (
+        "device_get/device_put aliasing: on CPU jax.device_get returns "
+        "views ALIASING device buffers (the PR-2 checkpoint-corruption "
+        "incident: donated train steps mutated checkpoint bytes "
+        "mid-write) and device_put(x, x.sharding) aliases instead of "
+        "copying (the PR-1 warm() donation trap). Fetches must copy "
+        "(np.array / tree_map(np.array, ...)), be a bare fence "
+        "statement, or carry an audited disable."
+    ),
+    "GC-HOSTCALL": (
+        "host callback / Python side effect staged inside a jitted body "
+        "outside the sanctioned telemetry tap (observe/stream.py): "
+        "host calls in traced code either burn a trace-time constant or "
+        "stage unordered side effects the PR-1 stream was built to "
+        "contain."
+    ),
+    "GC-RECOMPILE": (
+        "recompile hazard: data-dependent-shape ops inside a jitted "
+        "body, or a jit-callable call site passing Python scalars / "
+        "shape expressions as traced args — both defeat the warm shape "
+        "ladder's zero-post-warmup-recompile pin (PR 3)."
+    ),
+    "GC-THREAD": (
+        "thread target loops forever with no stop-event/sentinel exit "
+        "path: the loader/pipeline shutdown contract (PR 2/PR 4) — a "
+        "consumer that abandons the stream must release every helper "
+        "thread within one timeout tick."
+    ),
+    "GC-THREADNAME": (
+        "threading.Thread created without a stable name=: racecheck "
+        "reports and faulthandler deadlock dumps are unattributable "
+        "without one (PR 7)."
+    ),
+    "GC-LOCKSHARE": (
+        "a field mutated under the class lock is read/written from a "
+        "method that never acquires it — the PR-6 scrape bug (counts "
+        "dict resized mid-iteration under a concurrent _count), found "
+        "mechanically this time. Also flags read-modify-write (+=) on "
+        "shared fields outside any lock in a lock-bearing class."
+    ),
+    "GC-BLOCKING": (
+        "blocking call (block_until_ready, device_get, zero-arg "
+        "queue.get, join/wait without timeout, sleep) inside a held-lock "
+        "region: every other thread touching that lock stalls behind "
+        "device/IO latency — the serving-fleet deadlock shape."
+    ),
+    "GC-JSONFINITE": (
+        "float telemetry serialized without the non-finite->null guard: "
+        "bare NaN/Infinity tokens are invalid strict JSON (the PR-6 "
+        "metrics_live.jsonl fix) — route payloads through jsonfinite() "
+        "or pass allow_nan=False to fail loudly."
+    ),
+    "GC-DISABLE": (
+        "a graftcheck disable comment without a justification string "
+        "(or naming an unknown rule): escape hatches must say WHY "
+        "(INVARIANTS.md policy)."
+    ),
+    "GC-PARSE": (
+        "file does not parse: graftcheck cannot vouch for invariants "
+        "in code the AST cannot see — an unparseable file is a finding "
+        "in its own right, never a silent skip."
+    ),
+}
+
+# the one module allowed to stage host callbacks into jitted code: the
+# PR-1 telemetry tap (unordered jax.debug.callback, bit-identical
+# on/off, pinned by test)
+_SANCTIONED_CALLBACK_SUFFIX = "observe/stream.py"
+
+_CALLBACK_NAMES = ("debug.print", "debug.callback", "io_callback",
+                   "pure_callback")
+_HOSTCALLS_IN_JIT = ("print", "open", "input")
+_HOSTCALL_DOTTED = ("time.time", "time.perf_counter", "time.monotonic")
+_DATA_DEP_SHAPE = ("nonzero", "unique", "argwhere", "flatnonzero")
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "make_lock",
+                   "make_condition")
+_COPY_WRAPPERS = ("array", "float", "int", "bool", "copy", "deepcopy")
+_FINITE_GUARDS = ("finite", "jsonsafe", "sanitiz")
+
+
+@dataclasses.dataclass
+class RawFinding:
+    rule: str
+    line: int
+    end_line: int
+    message: str
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.debug.callback' for nested Attribute/Name chains ('' when the
+    expression is not a plain dotted name)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _tail(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _same_expr(a: ast.AST, b: ast.AST) -> bool:
+    return ast.dump(a) == ast.dump(b)
+
+
+def _raw(rule: str, node: ast.AST, message: str) -> RawFinding:
+    return RawFinding(rule, node.lineno,
+                      getattr(node, "end_lineno", node.lineno), message)
+
+
+# ---- shared module inventory ----------------------------------------
+
+
+def _jitted_functions(tree: ast.Module):
+    """(jitted function defs, jitted callable names, names jitted WITH
+    static args) resolvable inside this module.
+
+    Covers ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators,
+    ``x = jax.jit(f)`` bindings, bare ``jax.jit(f)`` calls on local
+    defs, and ``lax.scan(body, ...)`` bodies (scanned code is traced
+    code). Cross-module jitting (a make_* factory jitted by its caller)
+    is invisible to a single-file pass — accepted coverage gap.
+    """
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    jitted: dict[str, ast.AST] = {}
+    jitted_names: set[str] = set()
+    static_names: set[str] = set()
+
+    def is_jit(call: ast.Call) -> bool:
+        d = _dotted(call.func)
+        if d == "jax.jit":
+            return True
+        # partial(jax.jit, ...) used as a decorator factory
+        if _tail(d) == "partial" and call.args:
+            return _dotted(call.args[0]) == "jax.jit"
+        return False
+
+    def has_static(call: ast.Call) -> bool:
+        return any(kw.arg in ("static_argnums", "static_argnames")
+                   for kw in call.keywords)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _dotted(dec) == "jax.jit":
+                    jitted[node.name] = node
+                    jitted_names.add(node.name)
+                elif isinstance(dec, ast.Call) and is_jit(dec):
+                    jitted[node.name] = node
+                    jitted_names.add(node.name)
+                    if has_static(dec):
+                        static_names.add(node.name)
+        if isinstance(node, ast.Call):
+            target = None
+            if is_jit(node) and node.args:
+                arg0 = node.args[0]
+                # partial(jax.jit, f)? jax.jit(f) is the common shape
+                if _dotted(node.func) == "jax.jit":
+                    target = arg0
+                elif len(node.args) > 1:
+                    target = node.args[1]
+            elif _tail(_dotted(node.func)) == "scan" and node.args:
+                target = node.args[0]
+            if isinstance(target, ast.Name):
+                jitted_names.add(target.id)
+                if target.id in defs:
+                    jitted[target.id] = defs[target.id]
+                if is_jit(node) and has_static(node):
+                    static_names.add(target.id)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if is_jit(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted_names.add(t.id)
+                        if has_static(node.value):
+                            static_names.add(t.id)
+    return jitted, jitted_names, static_names
+
+
+# ---- per-rule checks -------------------------------------------------
+
+
+def _check_alias(tree: ast.Module) -> list[RawFinding]:
+    out = []
+    # statement-only device_get calls are fences (train/loop.py's window
+    # fence); their result never escapes, so aliasing cannot bite
+    fence_calls = {
+        id(stmt.value)
+        for stmt in ast.walk(tree)
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+    }
+    copied_calls = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _tail(_dotted(node.func))
+        if tail in _COPY_WRAPPERS:
+            for a in node.args:
+                copied_calls.add(id(a))
+        if tail == "tree_map" and node.args:
+            # jax.tree_util.tree_map(np.array, device_get(...)) is the
+            # PR-2 checkpoint fix shape: a per-leaf copy barrier
+            if _tail(_dotted(node.args[0])) in ("array", "copy"):
+                for a in node.args[1:]:
+                    copied_calls.add(id(a))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        tail = _tail(d)
+        if tail == "device_put" and len(node.args) >= 2:
+            dst = node.args[1]
+            if (isinstance(dst, ast.Attribute) and dst.attr == "sharding"
+                    and _same_expr(dst.value, node.args[0])):
+                out.append(_raw(
+                    "GC-ALIAS", node,
+                    "device_put(x, x.sharding) returns an ALIAS of x, not "
+                    "a copy — donating the result donates x too (the PR-1 "
+                    "warm() trap; CHANGES.md PR 1). Copy-then-place: "
+                    "device_put(jnp.array(x), x.sharding).",
+                ))
+        if tail == "device_get":
+            if id(node) in fence_calls or id(node) in copied_calls:
+                continue
+            out.append(_raw(
+                "GC-ALIAS", node,
+                "unaudited jax.device_get: on CPU backends the result "
+                "ALIASES device buffers, and a donated step mutates them "
+                "under you (the PR-2 checkpoint-corruption incident; "
+                "CHANGES.md PR 2). Wrap in np.array(...) / "
+                "tree_map(np.array, ...) (np.asarray does NOT copy), "
+                "use it as a bare fence statement, or add a disable "
+                "with the audit justification.",
+            ))
+    return out
+
+
+def _check_hostcall(tree: ast.Module, path: str) -> list[RawFinding]:
+    out = []
+    sanctioned = path.replace("\\", "/").endswith(
+        _SANCTIONED_CALLBACK_SUFFIX)
+    jitted, _, _ = _jitted_functions(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if any(d.endswith(cb) for cb in _CALLBACK_NAMES):
+            if not sanctioned:
+                out.append(_raw(
+                    "GC-HOSTCALL", node,
+                    f"host callback {d or 'callback'}(...) outside the "
+                    "sanctioned telemetry tap (observe/stream.py): the "
+                    "PR-1 stream is the ONE audited place side effects "
+                    "are staged into jitted code (unordered, muted at "
+                    "warmup, bit-identical on/off).",
+                ))
+    for fn in jitted.values():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d in _HOSTCALLS_IN_JIT or d in _HOSTCALL_DOTTED:
+                out.append(_raw(
+                    "GC-HOSTCALL", node,
+                    f"{d}(...) inside the jitted body {fn.name!r}: host "
+                    "calls in traced code run at TRACE time (a burned-in "
+                    "constant or a once-per-compile side effect), not "
+                    "per step — route telemetry through the "
+                    "observe/stream.py tap (CHANGES.md PR 1).",
+                ))
+    return out
+
+
+def _check_recompile(tree: ast.Module) -> list[RawFinding]:
+    out = []
+    jitted, jitted_names, static_names = _jitted_functions(tree)
+    for fn in jitted.values():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            tail = _tail(d)
+            if tail in _DATA_DEP_SHAPE and d.split(".")[0] in (
+                    "jnp", "jax", "np", "numpy"):
+                out.append(_raw(
+                    "GC-RECOMPILE", node,
+                    f"{d}(...) inside the jitted body {fn.name!r} has a "
+                    "data-dependent output shape: it cannot stage into "
+                    "one fixed program, so every batch re-traces — the "
+                    "warm shape ladder's zero-post-warmup-recompile pin "
+                    "(CHANGES.md PR 3) is built on fixed shapes.",
+                ))
+            if (tail == "where" and d.split(".")[0] in ("jnp", "jax")
+                    and len(node.args) == 1):
+                out.append(_raw(
+                    "GC-RECOMPILE", node,
+                    f"single-arg {d}(cond) inside the jitted body "
+                    f"{fn.name!r} returns data-dependent-shape indices; "
+                    "use the three-arg select form.",
+                ))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in jitted_names
+                and node.func.id not in static_names):
+            continue
+        for arg in node.args:
+            hazard = None
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, (int, float))
+                    and not isinstance(arg.value, bool)):
+                hazard = f"Python scalar {arg.value!r}"
+            elif (isinstance(arg, ast.Call)
+                    and _dotted(arg.func) == "len"):
+                hazard = "len(...)"
+            elif (isinstance(arg, ast.Subscript)
+                    and isinstance(arg.value, ast.Attribute)
+                    and arg.value.attr == "shape"):
+                hazard = "a .shape[...] expression"
+            if hazard:
+                out.append(_raw(
+                    "GC-RECOMPILE", node,
+                    f"jitted callable {node.func.id!r} called with "
+                    f"{hazard} as a traced argument: weak-typed scalars "
+                    "and shape-derived values silently re-trace when "
+                    "their dtype or value class shifts — pass device "
+                    "arrays, or declare it static_argnums at the jit "
+                    "site (warm-ladder discipline, CHANGES.md PR 3).",
+                ))
+    return out
+
+
+def _loop_has_exit(loop: ast.While) -> bool:
+    """A ``while True`` loop passes when it has a stop-event check or a
+    sentinel-style conditional exit (the loader/pipeline contract:
+    `if item is _STOP: return`, `stop.is_set()`, `stop.wait(t)`)."""
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.Return, ast.Break)):
+            return True
+        if isinstance(node, ast.Call):
+            tail = _tail(_dotted(node.func))
+            if tail in ("is_set", "wait"):
+                return True
+    return False
+
+
+def _thread_targets(tree: ast.Module):
+    """[(Thread() call node, target fn def or None)] for every
+    threading.Thread constructed in this module."""
+    defs: dict[str, ast.AST] = {}
+    methods: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods[item.name] = item
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _tail(_dotted(node.func)) == "Thread"):
+            continue
+        target = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        fn = None
+        if isinstance(target, ast.Name):
+            fn = defs.get(target.id)
+        elif (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            fn = methods.get(target.attr)
+        out.append((node, fn))
+    return out
+
+
+def _check_thread(tree: ast.Module) -> list[RawFinding]:
+    out = []
+    for call, fn in _thread_targets(tree):
+        has_name = any(kw.arg == "name" for kw in call.keywords)
+        if not has_name:
+            out.append(_raw(
+                "GC-THREADNAME", call,
+                "threading.Thread without a stable name=: racecheck "
+                "reports and the deadlock watchdog's faulthandler dumps "
+                "attribute stacks by thread name (CHANGES.md PR 7) — "
+                "anonymous Thread-5 is undebuggable at 3am.",
+            ))
+        if fn is None:
+            continue
+        for loop in ast.walk(fn):
+            if (isinstance(loop, ast.While)
+                    and isinstance(loop.test, ast.Constant)
+                    and loop.test.value is True
+                    and not _loop_has_exit(loop)):
+                out.append(_raw(
+                    "GC-THREAD", loop,
+                    f"thread target {fn.name!r} loops forever with no "
+                    "stop-event / sentinel exit path: the loader "
+                    "contract (CHANGES.md PR 2/PR 4) — every blocking "
+                    "helper loop must be bounded by a stop event or a "
+                    "queue sentinel so an abandoning consumer releases "
+                    "it within one timeout tick.",
+                ))
+    return out
+
+
+# ---- lock discipline -------------------------------------------------
+
+
+class _LockScan(ast.NodeVisitor):
+    """Per-method field accesses, split by under-lock / outside-lock."""
+
+    def __init__(self, lock_attrs: set[str]):
+        self.lock_attrs = lock_attrs
+        self.depth = 0
+        self.writes_locked: set[str] = set()
+        self.writes_unlocked: dict[str, ast.AST] = {}
+        self.reads_unlocked: dict[str, ast.AST] = {}
+        self.aug_unlocked: dict[str, ast.AST] = {}
+        self.calls_acquire = False
+        self.locked_regions: list = []  # (with node, lock expr)
+
+    def _is_lock_expr(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in self.lock_attrs)
+
+    def visit_With(self, node: ast.With):
+        locked = any(self._is_lock_expr(item.context_expr)
+                     for item in node.items)
+        if locked:
+            for item in node.items:
+                if self._is_lock_expr(item.context_expr):
+                    self.locked_regions.append((node, item.context_expr))
+            self.depth += 1
+            self.generic_visit(node)
+            self.depth -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("acquire", "release")
+                and self._is_lock_expr(node.func.value)):
+            self.calls_acquire = True
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and node.attr not in self.lock_attrs):
+            if isinstance(node.ctx, ast.Store):
+                if self.depth:
+                    self.writes_locked.add(node.attr)
+                else:
+                    self.writes_unlocked.setdefault(node.attr, node)
+            elif isinstance(node.ctx, ast.Load) and not self.depth:
+                self.reads_unlocked.setdefault(node.attr, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        t = node.target
+        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            if self.depth:
+                self.writes_locked.add(t.attr)
+            else:
+                self.aug_unlocked.setdefault(t.attr, node)
+                self.writes_unlocked.setdefault(t.attr, t)
+        self.generic_visit(node)
+
+
+def _class_locks(cls: ast.ClassDef) -> set[str]:
+    locks = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and _tail(_dotted(node.value.func)) in _LOCK_FACTORIES):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                locks.add(t.attr)
+    return locks
+
+
+def _check_lockshare(tree: ast.Module) -> list[RawFinding]:
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _class_locks(cls)
+        if not locks:
+            continue
+        scans: dict[str, _LockScan] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            scan = _LockScan(locks)
+            for stmt in item.body:
+                scan.visit(stmt)
+            scans[item.name] = scan
+        # guarded = fields MUTATED under the lock anywhere outside
+        # __init__ (reads under lock don't make a field shared: plenty
+        # of immutable config is read inside critical sections)
+        guarded: set[str] = set()
+        for name, scan in scans.items():
+            if name != "__init__" and not name.endswith("_locked"):
+                guarded |= scan.writes_locked
+        for name, scan in scans.items():
+            if (name == "__init__" or name.endswith("_locked")
+                    or scan.calls_acquire):
+                # *_locked methods run with the lock held by contract;
+                # acquire()-style methods manage the lock imperatively
+                # (too coarse to track per-access)
+                continue
+            hits = {}
+            for f, node in scan.reads_unlocked.items():
+                if f in guarded:
+                    hits[f] = node
+            for f, node in scan.writes_unlocked.items():
+                if f in guarded:
+                    hits[f] = node
+            for f, node in sorted(hits.items()):
+                out.append(_raw(
+                    "GC-LOCKSHARE", node,
+                    f"{cls.name}.{f} is mutated under self lock(s) "
+                    f"{sorted(locks)} elsewhere but accessed here "
+                    f"({name}) without acquiring it — the PR-6 scrape "
+                    "bug shape (CHANGES.md PR 6: a concurrent _count "
+                    "resized counts mid-iteration and cost the scrape "
+                    "the whole provider). Read/write it under the lock, "
+                    "or rename the method *_locked if callers hold it.",
+                ))
+            for f, node in sorted(scan.aug_unlocked.items()):
+                if f in hits or f in guarded:
+                    continue  # already reported above
+                out.append(_raw(
+                    "GC-LOCKSHARE", node,
+                    f"read-modify-write {cls.name}.{f} += ... outside "
+                    "any lock in a lock-bearing class: += is not atomic "
+                    "across threads (lost updates under the GIL's "
+                    "bytecode boundaries) — move it under "
+                    f"{sorted(locks)} or document why only one thread "
+                    "ever writes it.",
+                ))
+    return out
+
+
+def _check_blocking(tree: ast.Module) -> list[RawFinding]:
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _class_locks(cls)
+        if not locks:
+            continue
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            scan = _LockScan(locks)
+            for stmt in item.body:
+                scan.visit(stmt)
+            for region, lock_expr in scan.locked_regions:
+                for node in ast.walk(region):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = _dotted(node.func)
+                    tail = _tail(d)
+                    blocking = None
+                    if tail in ("block_until_ready", "device_get"):
+                        blocking = f"{d}(...)"
+                    elif tail == "sleep":
+                        blocking = f"{d}(...)"
+                    elif (tail == "get" and not node.args
+                            and not any(kw.arg == "timeout"
+                                        for kw in node.keywords)):
+                        blocking = "queue .get() with no timeout"
+                    elif tail in ("join", "wait"):
+                        # cond.wait on the HELD lock releases it (fine);
+                        # joining/waiting anything else under a lock
+                        # without a timeout blocks every other holder
+                        receiver = (node.func.value
+                                    if isinstance(node.func, ast.Attribute)
+                                    else None)
+                        on_this_lock = (receiver is not None
+                                        and _same_expr(receiver, lock_expr))
+                        has_timeout = (bool(node.args) or any(
+                            kw.arg == "timeout" for kw in node.keywords))
+                        if not on_this_lock and not has_timeout:
+                            blocking = f".{tail}() with no timeout"
+                    if blocking:
+                        out.append(_raw(
+                            "GC-BLOCKING", node,
+                            f"{blocking} inside the held-lock region "
+                            f"({cls.name}.{item.name}): every thread "
+                            "touching that lock stalls behind device/IO "
+                            "latency — the PR-6 counts-under-lock rule "
+                            "is 'copy under the lock, work outside it' "
+                            "(CHANGES.md PR 6).",
+                        ))
+    return out
+
+
+def _check_jsonfinite(tree: ast.Module) -> list[RawFinding]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d not in ("json.dump", "json.dumps"):
+            continue
+        strict = any(
+            kw.arg == "allow_nan"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+            for kw in node.keywords
+        )
+        guarded = False
+        if node.args:
+            payload = node.args[0]
+            if isinstance(payload, ast.Call):
+                fname = _tail(_dotted(payload.func)).lower()
+                guarded = any(g in fname for g in _FINITE_GUARDS)
+        if not strict and not guarded:
+            out.append(_raw(
+                "GC-JSONFINITE", node,
+                f"{d}(...) without the non-finite guard: a NaN/inf float "
+                "serializes as a bare NaN/Infinity token — invalid "
+                "strict JSON that breaks jq/pandas/non-Python consumers "
+                "(the PR-6 metrics_live.jsonl incident, CHANGES.md "
+                "PR 6). Wrap the payload in jsonfinite(...) "
+                "(observe/metrics_io.py) to map non-finite -> null, or "
+                "pass allow_nan=False to fail loudly on data that must "
+                "be finite.",
+            ))
+    return out
+
+
+def check_module(tree: ast.Module, path: str) -> list[RawFinding]:
+    """Run every rule over one parsed module."""
+    out: list[RawFinding] = []
+    out += _check_alias(tree)
+    out += _check_hostcall(tree, path)
+    out += _check_recompile(tree)
+    out += _check_thread(tree)
+    out += _check_lockshare(tree)
+    out += _check_blocking(tree)
+    out += _check_jsonfinite(tree)
+    out.sort(key=lambda f: (f.line, f.rule))
+    return out

@@ -61,6 +61,8 @@ import threading
 import time
 from typing import Any, Callable, Hashable, Iterable, Iterator
 
+from cgnn_tpu.analysis import racecheck
+
 _STOP = object()
 _TICK = 0.05  # seconds; the shutdown-latency bound for every blocking op
 
@@ -169,6 +171,7 @@ def parallel_pack(
 
     def worker() -> None:
         while not stop.is_set():
+            racecheck.heartbeat()  # ticks every _TICK even when starved
             try:
                 item = in_q.get(timeout=_TICK)
             except queue.Empty:
@@ -195,9 +198,17 @@ def parallel_pack(
                 telemetry.counter_add("pipeline_pack_s", dt)
                 telemetry.counter_add("pipeline_jobs", 1)
 
-    feed_t = threading.Thread(target=feeder, daemon=True, name=f"{name}-feed")
+    # stable names (graftcheck GC-THREADNAME): racecheck heartbeats and
+    # faulthandler deadlock dumps key on them. The pool prefix stays in
+    # the worker name — the beats registry is keyed BY name, so two
+    # pools in one process (serve's 'cgnn-serve-pack' + an inference
+    # 'cgnn-pack') must not share a key, or one pool's fresh beat masks
+    # the other pool's wedged worker
+    feed_t = threading.Thread(target=feeder, daemon=True,
+                              name=f"{name}-feeder")
     work_ts = [
-        threading.Thread(target=worker, daemon=True, name=f"{name}-{i}")
+        threading.Thread(target=worker, daemon=True,
+                         name=f"{name}-worker-{i}")
         for i in range(workers)
     ]
     t_start = time.perf_counter()

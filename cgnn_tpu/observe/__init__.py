@@ -40,6 +40,7 @@ from cgnn_tpu.observe.manifest import write_manifest
 from cgnn_tpu.observe.metrics_io import (
     MetricsLogger,
     enable_debug_nans,
+    jsonfinite,
     profile_trace,
     read_jsonl,
 )
@@ -63,6 +64,7 @@ __all__ = [
     "device_hbm_table_bytes",
     "enable_debug_nans",
     "hbm_gauges",
+    "jsonfinite",
     "padding_gauges",
     "profile_trace",
     "read_jsonl",

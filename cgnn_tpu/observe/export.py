@@ -40,6 +40,8 @@ import threading
 import time
 from typing import Callable
 
+from cgnn_tpu.observe.metrics_io import jsonfinite
+
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
 # device{i}_metric gauges become one labeled family per metric
@@ -350,28 +352,16 @@ class LiveMetricsWriter:
         self._lock = threading.Lock()
         self.writes = 0
 
-    @staticmethod
-    def _finite(obj):
-        """Non-finite floats -> None: ``json.dumps`` would emit bare
-        ``NaN``/``Infinity`` tokens (invalid standard JSON), and a
-        diverging run's NaN val gauge must not make the line
-        unparseable to strict consumers (jq, pandas, non-Python)."""
-        if isinstance(obj, dict):
-            return {k: LiveMetricsWriter._finite(v) for k, v in obj.items()}
-        if isinstance(obj, (list, tuple)):
-            return [LiveMetricsWriter._finite(v) for v in obj]
-        if isinstance(obj, float) and (obj != obj or obj in
-                                       (float("inf"), float("-inf"))):
-            return None
-        return obj
-
     def write_once(self) -> dict:
         """Append one snapshot now; returns it (the testable core)."""
         snap = self.registry.snapshot()
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with self._lock:
             with open(self.path, "a") as f:
-                f.write(json.dumps(self._finite(snap)) + "\n")
+                # non-finite floats -> null: a diverging run's NaN val
+                # gauge must not make the line unparseable to strict
+                # consumers (graftcheck GC-JSONFINITE)
+                f.write(json.dumps(jsonfinite(snap)) + "\n")
             self.writes += 1
         return snap
 

@@ -71,5 +71,9 @@ def write_manifest(log_dir: str, config: dict | None = None, **extra) -> str:
     os.makedirs(log_dir, exist_ok=True)
     path = os.path.join(log_dir, "manifest.json")
     with open(path, "w") as f:
-        json.dump(build_manifest(config, **extra), f, indent=1)
+        # config/versions/inventory are finite by construction:
+        # allow_nan=False makes a violation loud instead of emitting an
+        # invalid bare-NaN token (graftcheck GC-JSONFINITE)
+        json.dump(build_manifest(config, **extra), f, indent=1,
+                  allow_nan=False)
     return path

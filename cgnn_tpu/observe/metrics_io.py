@@ -18,6 +18,22 @@ import time
 from typing import Iterator
 
 
+def jsonfinite(obj):
+    """Non-finite floats -> None, recursively: ``json.dumps`` would emit
+    bare ``NaN``/``Infinity`` tokens — invalid strict JSON that breaks
+    jq/pandas/non-Python consumers. The shared guard every telemetry/
+    report serialization routes through (graftcheck GC-JSONFINITE; the
+    PR-6 metrics_live.jsonl incident)."""
+    if isinstance(obj, dict):
+        return {k: jsonfinite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonfinite(v) for v in obj]
+    if isinstance(obj, float) and (obj != obj or obj in
+                                   (float("inf"), float("-inf"))):
+        return None
+    return obj
+
+
 class MetricsLogger:
     """Epoch/event metrics -> metrics.jsonl (+ TensorBoard when available)."""
 
@@ -45,7 +61,7 @@ class MetricsLogger:
         }
         rec = {"step": int(step), "time": time.time(), **scalars}
         with self._lock:
-            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.write(json.dumps(jsonfinite(rec)) + "\n")
         if self._writer is not None:
             self._writer.write_scalars(int(step), scalars)
 
@@ -57,7 +73,7 @@ class MetricsLogger:
         """
         rec = {"event": event, "time": time.time(), **record}
         with self._lock:
-            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.write(json.dumps(jsonfinite(rec)) + "\n")
 
     def flush(self) -> None:
         with self._lock:

@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Iterator
 
+from cgnn_tpu.observe.metrics_io import jsonfinite
+
 
 class SpanTracer:
     """Nested host spans; ``export()`` writes trace.json (Chrome format).
@@ -150,6 +152,15 @@ class SpanTracer:
             })
         doc = {"traceEvents": meta + self.events, "displayTimeUnit": "ms"}
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # span args can carry request-derived floats; non-finite -> null
+        # keeps trace.json loadable by Perfetto's strict parser
+        # (graftcheck GC-JSONFINITE). Serialize BEFORE opening so the
+        # all-finite common case never deep-copies a 200k-event ring and
+        # a non-finite fallback can't leave a truncated file behind.
+        try:
+            body = json.dumps(doc, allow_nan=False)
+        except ValueError:
+            body = json.dumps(jsonfinite(doc))
         with open(path, "w") as f:
-            json.dump(doc, f)
+            f.write(body)
         return path

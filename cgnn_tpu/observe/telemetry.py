@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 from typing import Callable, Iterator
 
 from cgnn_tpu.observe.gauges import (
@@ -28,6 +27,7 @@ from cgnn_tpu.observe.gauges import (
     padding_gauges,
     pipeline_gauges,
 )
+from cgnn_tpu.analysis import racecheck
 from cgnn_tpu.observe.metrics_io import MetricsLogger
 from cgnn_tpu.observe.spans import SpanTracer
 from cgnn_tpu.observe.stream import StepStream
@@ -60,7 +60,10 @@ class Telemetry:
             self.spans = SpanTracer()
         if self.step_level:
             self.stream = StepStream(self.logger)
-        self._lock = threading.Lock()
+        # instrumented under CGNN_TPU_RACECHECK=1: this lock is taken
+        # from serve workers, scrape threads, and host callbacks — the
+        # exact cross-thread surface lock-order inversions hide in
+        self._lock = racecheck.make_lock("observe.telemetry")
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._series: dict = {}

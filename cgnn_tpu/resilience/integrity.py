@@ -79,7 +79,10 @@ def write_manifest(directory: str, manifest: dict) -> str:
     committed save with a torn manifest."""
     path = os.path.join(directory, MANIFEST_NAME)
     with open(path, "w") as f:
-        json.dump(manifest, f, indent=1)
+        # crc/shape/dtype entries are finite by construction — fail
+        # LOUDLY on a NaN rather than commit an unparseable marker
+        # (graftcheck GC-JSONFINITE)
+        json.dump(manifest, f, indent=1, allow_nan=False)
         f.flush()
         os.fsync(f.fileno())
     return path

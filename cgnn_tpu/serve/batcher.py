@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Callable
 
+from cgnn_tpu.analysis import racecheck
 from cgnn_tpu.data.graph import CrystalGraph
 from cgnn_tpu.serve.shapes import BatchShape, ShapeSet
 
@@ -154,7 +155,10 @@ class MicroBatcher:
         self.max_wait = max_wait_ms / 1000.0
         self._clock = clock
         self._queue: list[Request] = []
-        self._cond = threading.Condition()
+        # a plain Condition normally; instrumented (lock-order + held-by
+        # tracking) under CGNN_TPU_RACECHECK=1 — racecheck.make_condition
+        # returns threading.Condition() when the gate is off
+        self._cond = racecheck.make_condition("serve.batcher")
         self._closed = False
         self._flush_seq = 0
 
@@ -186,8 +190,10 @@ class MicroBatcher:
 
     # ---- flush policy ----
 
-    def _take(self, now: float) -> tuple[list, list, bool]:
-        """(batchable FIFO prefix, expired, hit-shape-full). Lock held."""
+    def _take_locked(self, now: float) -> tuple[list, list, bool]:
+        """(batchable FIFO prefix, expired, hit-shape-full). The _locked
+        suffix is the graftcheck GC-LOCKSHARE contract: callers hold
+        self._cond."""
         big = self.shape_set.largest
         take: list[Request] = []
         expired: list[Request] = []
@@ -217,7 +223,7 @@ class MicroBatcher:
         core of the batcher."""
         now = self._clock() if now is None else now
         with self._cond:
-            take, expired, full = self._take(now)
+            take, expired, full = self._take_locked(now)
             waited = (
                 take and now - min(r.enqueued for r in take) >= self.max_wait
             )
@@ -250,6 +256,9 @@ class MicroBatcher:
         Returns None exactly once the batcher is closed AND empty — the
         worker's signal to exit after the drain is complete."""
         while True:
+            # ticks every <= max_wait even when idle, so the racecheck
+            # deadlock watchdog can tell 'no traffic' from 'wedged'
+            racecheck.heartbeat()
             with self._cond:
                 if self._closed and not self._queue:
                     return None
@@ -258,7 +267,8 @@ class MicroBatcher:
                     continue
                 oldest = min(r.enqueued for r in self._queue)
                 remaining = self.max_wait - (self._clock() - oldest)
-            if remaining > 0 and not self._closed:
+                closed = self._closed  # read under the lock (GC-LOCKSHARE)
+            if remaining > 0 and not closed:
                 # sleep until the deadline can fire (a new arrival that
                 # makes the batch shape-full wakes us early)
                 with self._cond:
@@ -277,4 +287,5 @@ class MicroBatcher:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._cond:
+            return self._closed

@@ -42,9 +42,10 @@ in-container.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Sequence
+
+from cgnn_tpu.analysis import racecheck
 
 
 def resolve_devices(spec="auto"):
@@ -106,7 +107,7 @@ class DeviceSet:
             raise ValueError("a DeviceSet needs at least one device")
         self.devices = tuple(devices)
         self.window = max(1, int(window))
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("serve.devices")
         n = len(self.devices)
         self._inflight = [0] * n     # routed or dispatched, not yet fetched
         self._dispatches = [0] * n

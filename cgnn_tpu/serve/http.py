@@ -40,6 +40,7 @@ from typing import Callable
 import numpy as np
 
 from cgnn_tpu.data.graph import CrystalGraph
+from cgnn_tpu.observe.metrics_io import jsonfinite
 from cgnn_tpu.serve.batcher import (
     MALFORMED,
     OVERSIZE,
@@ -114,7 +115,15 @@ def make_handler(server: InferenceServer,
 
         def _reply(self, status: int, payload: dict,
                    headers: dict | None = None) -> None:
-            body = json.dumps(payload).encode()
+            # a NaN prediction must reach the client as null, not as a
+            # bare NaN token no strict JSON parser accepts (graftcheck
+            # GC-JSONFINITE). The recursive rebuild is the RARE path:
+            # allow_nan=False serializes the all-finite common case in
+            # one C-level pass and only a ValueError pays for jsonfinite.
+            try:
+                body = json.dumps(payload, allow_nan=False).encode()
+            except ValueError:
+                body = json.dumps(jsonfinite(payload)).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

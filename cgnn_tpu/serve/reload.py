@@ -26,6 +26,7 @@ import sys
 import threading
 from typing import Callable
 
+from cgnn_tpu.analysis import racecheck
 from cgnn_tpu.train.checkpoint import CheckpointManager
 
 
@@ -45,7 +46,7 @@ class ParamStore:
     """
 
     def __init__(self, state, version: str = "init", devices=None):
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("serve.paramstore")
         self._devices = tuple(devices) if devices else None
         self._states = self._replicate(state)
         self._version = version
@@ -154,13 +155,14 @@ class CheckpointWatcher:
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._thread = threading.Thread(
-                target=self._run, daemon=True, name="cgnn-serve-reload"
+                target=self._run, daemon=True, name="reload-watcher"
             )
             self._thread.start()
         return self
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_interval):
+            racecheck.heartbeat()
             try:
                 self.poll_once()
             except Exception as e:  # noqa: BLE001 — watcher must survive

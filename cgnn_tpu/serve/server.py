@@ -72,6 +72,7 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from cgnn_tpu.analysis import racecheck
 from cgnn_tpu.data.graph import CrystalGraph
 from cgnn_tpu.serve.batcher import (
     MALFORMED,
@@ -179,7 +180,9 @@ class InferenceServer:
         self._worker: threading.Thread | None = None
         self._watcher: CheckpointWatcher | None = None
         self._draining = False
-        self._lock = threading.Lock()
+        # plain Lock normally; instrumented under CGNN_TPU_RACECHECK=1
+        # (lock-order recording + held-by-current for watch_fields)
+        self._lock = racecheck.make_lock("serve.server")
         # serving counters (mirrored into telemetry; kept locally so
         # stats() works with telemetry off)
         self.counts: dict[str, int] = {
@@ -216,6 +219,14 @@ class InferenceServer:
         # on-demand device profiling (observe/profile.py); wired by
         # enable_profiling — None until an output dir is chosen
         self.profiler = None
+        # racecheck shared-field tripwire (no-op when the gate is off):
+        # every field mutated under self._lock is registered, so a
+        # future stats path touching one without the lock is a recorded
+        # violation at runtime, not a 3am scrape mystery (the PR-6 bug)
+        racecheck.watch_fields(self, self._lock, (
+            "counts", "_latencies", "_occupancies", "_draining",
+            "_compiles_after_warm",
+        ))
 
     # ---- warmup ----
 
@@ -322,17 +333,20 @@ class InferenceServer:
         with self._lock:
             # copy under the lock: _count() inserts NEW keys concurrently
             # and a mid-iteration resize would raise, costing the scrape
-            # the whole serve provider
+            # the whole serve provider; _draining/_compiles_after_warm
+            # are mutated under this lock too (graftcheck GC-LOCKSHARE)
             counts = dict(self.counts)
+            draining = self._draining
+            compiles_after_warm = self._compiles_after_warm
         counters = {f"serve_{k}": float(v) for k, v in counts.items()}
         tcounters = self.telemetry.counters()
         for name in ("pipeline_jobs", "pipeline_pack_s", "pipeline_wait_s"):
             counters[name] = float(tcounters.get(name, 0.0))
         gauges = {
             "serve_queue_depth": float(self.batcher.depth),
-            "serve_draining": float(self._draining),
+            "serve_draining": float(draining),
             "serve_warmed": float(self.warmed),
-            "serve_recompiles_after_warm": float(self._compiles_after_warm),
+            "serve_recompiles_after_warm": float(compiles_after_warm),
             "serve_rolling_window_s": self.rolling_window_s,
             "pipeline_pack_workers": float(self._pack_workers),
             "device_count": float(len(self.device_set)),
@@ -353,6 +367,10 @@ class InferenceServer:
     # ---- lifecycle ----
 
     def start(self) -> "InferenceServer":
+        # the deadlock watchdog (racecheck-gated): any heartbeating
+        # serve/pack/watcher thread silent past the bound triggers a
+        # named faulthandler dump of every stack
+        racecheck.start_watchdog(bound_s=30.0, log_fn=self._log)
         if self._worker is None or not self._worker.is_alive():
             self._worker = threading.Thread(
                 target=self._serve_loop, daemon=True, name="cgnn-serve"
@@ -555,6 +573,7 @@ class InferenceServer:
         if self._pack_workers > 0:
             return self._serve_loop_pipelined()
         while True:
+            racecheck.heartbeat()
             flush = self.batcher.next_flush()
             if flush is None:
                 return
@@ -629,6 +648,7 @@ class InferenceServer:
         pool = BufferPool()
         stream = self._packed_stream(pool)
         while True:
+            racecheck.heartbeat()
             t0 = time.perf_counter()
             try:
                 item = next(stream)
@@ -670,14 +690,21 @@ class InferenceServer:
 
         def device_worker(i: int) -> None:
             while True:
-                item = qs[i].get()
+                racecheck.heartbeat()
+                try:
+                    # bounded get: the idle tick is what lets the
+                    # racecheck watchdog tell 'no traffic routed here'
+                    # from 'wedged mid-dispatch'
+                    item = qs[i].get(timeout=1.0)
+                except queue_mod.Empty:
+                    continue
                 if item is None:
                     return
                 self._run_flush(*item, pool=pool, device=i, routed=True)
 
         workers = [
             threading.Thread(target=device_worker, args=(i,), daemon=True,
-                             name=f"cgnn-serve-dev{i}")
+                             name=f"serve-dispatch-{i}")
             for i in range(n)
         ]
         for t in workers:
@@ -685,6 +712,7 @@ class InferenceServer:
         stream = self._packed_stream(pool)
         try:
             while True:
+                racecheck.heartbeat()
                 t0 = time.perf_counter()
                 try:
                     item = next(stream)
@@ -788,14 +816,20 @@ class InferenceServer:
         pre = self._jit_cache_size()
         dispatched = self._stamp()
         flush.stamps["dispatched"] = dispatched
-        out = np.asarray(jax.device_get(self.predict_step(state, batch)))
+        # np.array, not asarray: a true host copy (device_get ALIASES
+        # device buffers on CPU — graftcheck GC-ALIAS) so response rows
+        # never share memory with a buffer the pool is about to recycle
+        out = np.array(jax.device_get(self.predict_step(state, batch)))
         fetched = self._stamp()
         flush.stamps["fetched"] = fetched
         post = self._jit_cache_size()
         if self.warmed and pre is not None and post is not None and post > pre:
             # a recompile after warmup is a policy bug (the batcher left
-            # the warm shape set) — LOUD, and counted for the loadgen
-            self._compiles_after_warm += post - pre
+            # the warm shape set) — LOUD, and counted for the loadgen.
+            # Under the lock: one dispatch thread PER device writes this
+            # (a bare += loses updates across threads; GC-LOCKSHARE)
+            with self._lock:
+                self._compiles_after_warm += post - pre
             self.telemetry.counter_add("serve_recompiles_after_warm",
                                        post - pre)
             self._log(
@@ -880,12 +914,14 @@ class InferenceServer:
         with self._lock:
             counts = dict(self.counts)
             occ = list(self._occupancies)
+            draining = self._draining
+            compiles_after_warm = self._compiles_after_warm
         out = {
             "counts": counts,
             "queue_depth": self.batcher.depth,
             "param_version": self.param_store.version,
             "devices": self.device_set.stats(),
-            "draining": self._draining,
+            "draining": draining,
             "latency_ms": self.latency_quantiles(),
             # the live plane (ISSUE 6): rolling-window quantiles — what
             # the last `rolling_window_s` seconds looked like, not the
@@ -898,7 +934,7 @@ class InferenceServer:
             },
             "batch_occupancy_mean": float(np.mean(occ)) if occ else 0.0,
             "shapes": [s.to_meta() for s in self.shape_set],
-            "recompiles_after_warm": self._compiles_after_warm,
+            "recompiles_after_warm": compiles_after_warm,
             "ingest": {
                 "compact": self.shape_set.compact is not None,
                 "pack_workers": self._pack_workers,

@@ -273,7 +273,7 @@ def run_fast_inference(
         _dispatch(span, batch, key, buf)
 
     for group in outs_by_shape.values():
-        stacked = np.asarray(
+        stacked = np.array(  # true copy, not an aliasing view (GC-ALIAS)
             jax.device_get(jnp.stack([out for _, out in group]))
         )
         if preds is None:

@@ -43,7 +43,9 @@ def fetch_device_sums(dev_sums: dict | None) -> dict:
     packed = jnp.stack(
         [jnp.asarray(dev_sums[k], jnp.float32) for k in keys]
     )
-    vals = np.asarray(jax.device_get(packed))
+    # np.array, not asarray: device_get ALIASES device buffers on CPU
+    # (graftcheck GC-ALIAS) and these sums outlive the next dispatch
+    vals = np.array(jax.device_get(packed))
     return dict(zip(keys, (float(v) for v in vals)))
 
 

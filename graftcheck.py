@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""graftcheck: the repo's invariant linter (cgnn_tpu/analysis).
+
+Every rule encodes an invariant a previous PR paid for in debugging
+time — donation/aliasing safety (PR 1/2), the thread-shutdown contract
+(PR 2/4), the zero-post-warmup-recompile pin (PR 3), counts-under-lock
+scrapes (PR 6) — so the next refactor can't silently reintroduce the
+incident. INVARIANTS.md is the catalog; ``--list-rules`` the summary.
+
+Usage::
+
+    python graftcheck.py                  # scan the repo, human output
+    python graftcheck.py --ci             # concise; exit 1 on findings
+    python graftcheck.py path/ other.py   # scan specific targets
+    python graftcheck.py --list-rules
+
+Exit status: 0 when clean, 1 when any finding survives its disables,
+2 on usage errors. The CI ``static-analysis`` job runs ``--ci`` as a
+BLOCKING step (tier1.yml) — intentional exceptions get
+``# graftcheck: disable=RULE -- justification`` at the site, never a
+weaker rule.
+
+Scans ``cgnn_tpu/``, ``scripts/``, and the root entrypoints by
+default. ``tests/`` is excluded (test code fakes locks and threads on
+purpose; the fixture corpus under tests/analysis_fixtures is exercised
+by tests/test_analysis.py, which also pins that THIS scan stays clean);
+``__graft_entry__.py`` is the frozen seed harness.
+
+Stdlib-only: runs without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _ROOT)
+
+from cgnn_tpu.analysis.engine import (  # noqa: E402
+    check_paths,
+    default_targets,
+)
+from cgnn_tpu.analysis.rules import RULES  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: the repo scan set)")
+    p.add_argument("--ci", action="store_true",
+                   help="concise one-line-per-finding output + GitHub "
+                        "error annotations; exit 1 on any finding")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES):
+            print(f"{rule}\n    {RULES[rule]}\n")
+        return 0
+
+    if args.paths:
+        findings = check_paths(args.paths, rel_to=os.getcwd())
+        scanned = args.paths
+    else:
+        targets = default_targets(_ROOT)
+        findings = check_paths(targets, rel_to=_ROOT)
+        scanned = targets
+
+    for f in findings:
+        if args.ci:
+            print(f"::error file={f.path},line={f.line},"
+                  f"title={f.rule}::{f.message}")
+        print(f.format(verbose=not args.ci))
+        if args.ci:
+            # one explanatory line even in concise mode: the fix-it
+            # message is the point of the tool
+            print(f"    {f.message}")
+
+    n_files = len(scanned)
+    if findings:
+        print(f"\ngraftcheck: {len(findings)} finding(s) "
+              f"({len({f.path for f in findings})} file(s)); see "
+              f"INVARIANTS.md for the rule catalog and the disable "
+              f"policy", file=sys.stderr)
+        return 1
+    print(f"graftcheck: clean ({n_files} target(s), "
+          f"{len(RULES)} rules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -227,7 +227,8 @@ def _run(args, mgr) -> int:
         for batch in batch_iterator(graphs, args.batch_size, node_cap,
                                     edge_cap, dense_m=layout_m, in_cap=0,
                                     snug=snug, edge_dtype=edge_dtype):
-            out = jax.device_get(predict_step(state, batch))
+            out = jax.tree_util.tree_map(  # true copies (GC-ALIAS)
+                np.array, jax.device_get(predict_step(state, batch)))
             energies, forces = (np.asarray(out[0]), np.asarray(out[1]))
             node_graph = np.asarray(batch.node_graph)
             node_mask = np.asarray(batch.node_mask) > 0

@@ -27,6 +27,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -166,8 +168,8 @@ def main(argv=None) -> int:
         "fencing": "value-fetch per round",
     }
     with open(args.out, "w") as f:
-        json.dump(out, f, indent=1)
-    print(json.dumps(out))
+        json.dump(jsonfinite(out), f, indent=1)
+    print(json.dumps(jsonfinite(out)))
     return 0
 
 

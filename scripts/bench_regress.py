@@ -126,9 +126,24 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     rounds = find_rounds(args.dir)
-    if len(rounds) < 2:
-        print(f"bench_regress: found {len(rounds)} BENCH_r*.json under "
-              f"{args.dir}; need 2 to diff — nothing to do")
+    if not rounds:
+        print(f"bench_regress: no BENCH_r*.json under {args.dir} — "
+              f"nothing to do")
+        return 0
+    if len(rounds) == 1:
+        # exactly one round is NOT a silent pass: it is the baseline
+        # every later round will be judged against — say so explicitly
+        # (an empty-looking step that "succeeded" is how a broken glob
+        # or a wiped artifact dir hides)
+        n, path = rounds[0]
+        named = len([k for k in DEFAULT_KEYS
+                     if k in load_parsed(path)])
+        msg = (f"single bench round r{n:02d} "
+               f"({os.path.basename(path)}, {named} named keys present) "
+               f"— baseline recorded, nothing to diff yet")
+        if args.github:
+            print(f"::notice title=bench baseline recorded::{msg}")
+        print(f"bench_regress: {msg}")
         return 0
     (old_n, old_path), (new_n, new_path) = rounds[-2], rounds[-1]
     keys = ([k.strip() for k in args.keys.split(",") if k.strip()]

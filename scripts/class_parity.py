@@ -27,6 +27,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def binary_labeled_dataset(n: int, seed: int):
     """MP-like structures with label = target above/below the median.
@@ -181,9 +183,17 @@ def jax_train_eval(split, *, epochs, batch_size, lr, seed,
 
     def on_epoch_end(s, _epoch, val_m, is_best):
         if is_best:
-            best.update(params=jax.device_get(s.params),
-                        batch_stats=jax.device_get(s.batch_stats),
-                        val=val_m["correct"])
+            # true host SNAPSHOTS: on CPU, device_get returns views
+            # ALIASING the device buffers, which the donated train step
+            # mutates in later epochs (the PR-2 checkpoint-corruption
+            # incident) — without the np.array copy, "best" params
+            # silently drift toward the LAST epoch's values
+            best.update(
+                params=jax.tree_util.tree_map(
+                    np.array, jax.device_get(s.params)),
+                batch_stats=jax.tree_util.tree_map(
+                    np.array, jax.device_get(s.batch_stats)),
+                val=val_m["correct"])
 
     state, result = fit(
         state, train_g, val_g, epochs=epochs, batch_size=batch_size,
@@ -197,7 +207,7 @@ def jax_train_eval(split, *, epochs, batch_size, lr, seed,
     logps, labels = [], []
     idx = 0
     for b in batch_iterator(test_g, batch_size, node_cap, edge_cap):
-        out = np.asarray(jax.device_get(pstep(state, b)))
+        out = np.array(jax.device_get(pstep(state, b)))  # copy: GC-ALIAS
         n_real = int(np.asarray(b.graph_mask).sum())
         logps.append(out[:n_real])
         labels.extend(int(test_g[idx + k].target[0]) for k in range(n_real))
@@ -257,7 +267,7 @@ def main(argv=None) -> int:
 
     mean = lambda k: float(np.mean([r[k] for r in runs]))  # noqa: E731
     acc_t, acc_j = mean("torch_accuracy"), mean("jax_accuracy")
-    print(json.dumps({
+    print(json.dumps(jsonfinite({
         "metric": "classification_parity",
         "matched_init": bool(args.matched_init),
         "torch_accuracy": round(acc_t, 4),
@@ -271,7 +281,7 @@ def main(argv=None) -> int:
         "epochs": args.epochs,
         "torch_train_s": round(t_torch, 1),
         "jax_train_s": round(t_jax, 1),
-    }))
+    })))
     return 0 if acc_j / acc_t >= 1.0 - args.tolerance else 1
 
 

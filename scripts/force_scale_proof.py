@@ -44,6 +44,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -236,7 +238,7 @@ def main(argv=None) -> int:
         "device": getattr(jax.devices()[0], "device_kind",
                           jax.devices()[0].platform),
     }
-    line = json.dumps(out)
+    line = json.dumps(jsonfinite(out))
     print(line)
     if args.out:
         with open(args.out, "w") as fh:

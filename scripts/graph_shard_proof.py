@@ -47,6 +47,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def _timed_rounds(step, state, device_batches, structs_per_batch, n_timed):
     import numpy as np
@@ -221,7 +223,7 @@ def main(argv=None) -> int:
                  "ratios above show is preserved under the graph axis"),
         "bench_r4_dense_vs_coo_mp": 2.2,
     }
-    line = json.dumps(result)
+    line = json.dumps(jsonfinite(result))
     print(line)
     if args.out:
         with open(args.out, "w") as fh:

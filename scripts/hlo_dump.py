@@ -25,6 +25,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 _DTYPE_BYTES = {
     "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
     "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
@@ -118,7 +120,7 @@ def main(argv=None) -> int:
         "explicit_formatting_bytes": total,
         "top": findings[: args.top],
     }
-    print(json.dumps(out))
+    print(json.dumps(jsonfinite(out)))
     return 0
 
 

@@ -36,6 +36,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -176,7 +178,7 @@ def main(argv=None) -> int:
     )
     np.testing.assert_array_equal(preds, mdev_preds)
 
-    print(json.dumps({
+    print(json.dumps(jsonfinite({
         "pack_structs_per_sec": round(args.n / pack_s, 1),
         "e2e_structs_per_sec": round(e2e, 1),
         "e2e_multidev_structs_per_sec": round(mdev_e2e, 1),
@@ -189,7 +191,7 @@ def main(argv=None) -> int:
         "n": args.n,
         "workers": args.workers,
         "compact": True,
-    }))
+    })))
     return 0
 
 

@@ -35,6 +35,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def run_config(
     name: str,
@@ -119,7 +121,7 @@ def run_config(
         "best_val_mae": round(float(result["best"]), 5),
         "wall_s": round(time.perf_counter() - t0, 1),
     }
-    print(json.dumps(rec), file=sys.stderr)
+    print(json.dumps(jsonfinite(rec)), file=sys.stderr)
     return rec
 
 
@@ -180,8 +182,9 @@ def main(argv=None) -> int:
         "records": records,
     }
     with open(args.out, "w") as f:
-        json.dump(out, f, indent=2)
-    print(json.dumps({r["name"]: r["best_val_mae"] for r in records}))
+        json.dump(jsonfinite(out), f, indent=2)
+    print(json.dumps(jsonfinite(
+        {r["name"]: r["best_val_mae"] for r in records})))
     return 0
 
 

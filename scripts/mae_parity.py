@@ -33,6 +33,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def torch_train_eval(graphs, split, *, epochs, batch_size, lr, seed,
                      max_num_nbr):
@@ -185,10 +187,17 @@ def jax_train_eval(split, *, epochs, batch_size, lr, seed,
 
     def on_epoch_end(s, _epoch, val_m, is_best):
         if is_best:
-            # host copies: the donated train step will delete live buffers
-            best.update(params=jax.device_get(s.params),
-                        batch_stats=jax.device_get(s.batch_stats),
-                        val=val_m["mae"])
+            # true host SNAPSHOTS, not just fetches: on CPU, device_get
+            # returns views ALIASING the device buffers, which the
+            # donated train step mutates in later epochs (the PR-2
+            # checkpoint-corruption incident) — without the np.array
+            # copy, "best" params silently drift to the last epoch's
+            best.update(
+                params=jax.tree_util.tree_map(
+                    np.array, jax.device_get(s.params)),
+                batch_stats=jax.tree_util.tree_map(
+                    np.array, jax.device_get(s.batch_stats)),
+                val=val_m["mae"])
 
     state, result = fit(
         state, train_g, val_g, epochs=epochs, batch_size=batch_size,
@@ -290,7 +299,7 @@ def main(argv=None) -> int:
     # lucky 2-3-seed draw as superiority (VERDICT r4 weak #3) — report
     # mean +/- sample std so the claim strength is visible in the artifact
     per_seed = [r["jax_test_mae"] / r["torch_test_mae"] for r in runs]
-    print(json.dumps({
+    print(json.dumps(jsonfinite({
         "metric": "formation_energy_mae_parity",
         "dataset": args.dataset,
         "matched_init": bool(args.matched_init),
@@ -308,7 +317,7 @@ def main(argv=None) -> int:
         "epochs": args.epochs,
         "torch_train_s": round(t_torch, 1),
         "jax_train_s": round(t_jax, 1),
-    }))
+    })))
     return 0 if ratio <= 1.0 + args.tolerance else 1
 
 

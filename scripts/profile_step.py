@@ -27,6 +27,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def build_workload(dense_m=12):
     """The bench.py PRIMARY workload: MP-like distribution, dense layout,
@@ -337,9 +339,9 @@ def main():
         "trace": trace,
     }
     with open(args.out, "w") as fo:
-        json.dump(result, fo, indent=1)
-    print(json.dumps({k: v for k, v in result.items() if k != "trace"},
-                     indent=1))
+        json.dump(jsonfinite(result), fo, indent=1)
+    print(json.dumps(jsonfinite({k: v for k, v in result.items()
+                              if k != "trace"}), indent=1))
 
 
 if __name__ == "__main__":

@@ -33,6 +33,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -217,7 +219,7 @@ def main(argv=None) -> int:
     out["layout"] = args.layout
     out["final_val_mae"] = round(float(result["best"]), 5)
     out["device"] = str(jax.devices()[0].device_kind)
-    print(json.dumps(out))
+    print(json.dumps(jsonfinite(out)))
     return 0
 
 

@@ -22,6 +22,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def train_once(graphs, *, epochs, batch_size, buckets, seed, scan):
     import jax
@@ -79,7 +81,7 @@ def main(argv=None) -> int:
 
     noise = abs(per_step[-1] - per_step2[-1])
     gap = abs(scan[-1] - per_step[-1])
-    print(json.dumps({
+    print(json.dumps(jsonfinite({
         "metric": "scan_vs_per_step_val_mae",
         "per_step": per_step,
         "scan": scan,
@@ -87,7 +89,7 @@ def main(argv=None) -> int:
         "final_gap": round(gap, 5),
         "seed_noise": round(noise, 5),
         "within_noise": bool(gap <= max(noise, 0.002) * 1.5),
-    }))
+    })))
     return 0
 
 

@@ -28,6 +28,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -147,10 +149,10 @@ def main(argv=None) -> int:
     out["dispatches_per_epoch"] = round(
         driver.timings.get("train_dispatches", 0.0) / args.epochs, 1
     )
-    print(json.dumps(out))
+    print(json.dumps(jsonfinite(out)))
     if args.out:
         with open(args.out, "w") as fh:
-            json.dump(out, fh, indent=2)
+            json.dump(jsonfinite(out), fh, indent=2)
     return 0
 
 

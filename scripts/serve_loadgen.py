@@ -36,6 +36,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -315,7 +317,8 @@ def _run_inproc(args) -> dict:
             if interval:
                 stop.wait(max(0.0, interval - (time.monotonic() - t0)))
 
-    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+    threads = [threading.Thread(target=client, args=(i,), daemon=True,
+                                name=f"loadgen-client-{i}")
                for i in range(args.clients)]
     t_start = time.monotonic()
     for t in threads:
@@ -355,9 +358,11 @@ def _run_inproc(args) -> dict:
 
     checkers = []
     if not args.no_scrape:
-        checkers.append(threading.Thread(target=mid_scrape, daemon=True))
+        checkers.append(threading.Thread(target=mid_scrape, daemon=True,
+                                         name="loadgen-scrape"))
     if args.profile_mid:
-        checkers.append(threading.Thread(target=mid_profile, daemon=True))
+        checkers.append(threading.Thread(target=mid_profile, daemon=True,
+                                         name="loadgen-profile"))
     for t in checkers:
         t.start()
 
@@ -496,13 +501,16 @@ def _run_http(args) -> dict:
         rng = np.random.default_rng(args.seed + ci)
         while not stop.is_set():
             g = pool[int(rng.integers(len(pool)))]
+            # allow_nan=False, not jsonfinite(): features are finite by
+            # construction, and the recursive rebuild in N client hot
+            # loops would skew the rps/p99 this tool exists to measure
             body = json.dumps({"graph": {
                 "atom_fea": g.atom_fea.tolist(),
                 "edge_fea": g.edge_fea.tolist(),
                 "centers": g.centers.tolist(),
                 "neighbors": g.neighbors.tolist(),
                 "id": g.cif_id,
-            }, "timeout_ms": args.timeout_ms}).encode()
+            }, "timeout_ms": args.timeout_ms}, allow_nan=False).encode()
             req = urllib.request.Request(
                 base + "/predict", data=body,
                 headers={"Content-Type": "application/json"},
@@ -556,7 +564,7 @@ def _run_http(args) -> dict:
         time.sleep(args.duration * 0.4)
         req = urllib.request.Request(
             base + "/profile",
-            data=json.dumps({"duration_ms": 500}).encode(),
+            data=json.dumps({"duration_ms": 500}, allow_nan=False).encode(),
             headers={"Content-Type": "application/json"},
         )
         try:
@@ -565,13 +573,16 @@ def _run_http(args) -> dict:
         except Exception as e:  # noqa: BLE001 — reported as a failure
             profile_result.update(ok=False, error=repr(e))
 
-    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+    threads = [threading.Thread(target=client, args=(i,), daemon=True,
+                                name=f"loadgen-http-client-{i}")
                for i in range(args.clients)]
     checkers = []
     if not args.no_scrape:
-        checkers.append(threading.Thread(target=mid_scrape, daemon=True))
+        checkers.append(threading.Thread(target=mid_scrape, daemon=True,
+                                         name="loadgen-scrape"))
     if args.profile_mid:
-        checkers.append(threading.Thread(target=mid_profile, daemon=True))
+        checkers.append(threading.Thread(target=mid_profile, daemon=True,
+                                         name="loadgen-profile"))
     t_start = time.monotonic()
     for t in threads:
         t.start()
@@ -590,7 +601,7 @@ def _run_http(args) -> dict:
                 "edge_fea": g.edge_fea.tolist(),
                 "centers": g.centers.tolist(),
                 "neighbors": g.neighbors.tolist(),
-            }, "timeout_ms": args.timeout_ms}).encode(),
+            }, "timeout_ms": args.timeout_ms}, allow_nan=False).encode(),
             headers={"Content-Type": "application/json",
                      "X-Request-Id": "loadgen-probe-1"},
         )
@@ -759,9 +770,45 @@ def main(argv=None) -> int:
                 f"devices {silent} answered no responses under load "
                 f"(distribution broken: {dev['responses_by_device']})"
             )
+    # racecheck leg (CGNN_TPU_RACECHECK=1): the runtime lock-discipline
+    # report rides the SLO report and fails the run like any other
+    # invariant — zero lock-order inversions, zero unguarded shared-field
+    # touches, zero deadlock-watchdog dumps under the full client load.
+    # In-proc ONLY: in --http mode the server runs in another process and
+    # this process's racecheck state is empty — reporting that as "clean"
+    # would be a vacuous verdict about a server never instrumented here.
+    from cgnn_tpu.analysis import racecheck
+
+    if args.http and racecheck.enabled():
+        print("racecheck: gate is on but --http drives a remote process; "
+              "no verdict (run the in-proc mode to instrument the server)")
+    if racecheck.enabled() and not args.http:
+        rc = racecheck.report()
+        report["racecheck"] = rc
+        if rc["inversions"]:
+            failures.append(
+                f"{len(rc['inversions'])} lock-order inversion(s): "
+                f"{rc['inversions'][:3]}"
+            )
+        if rc["violations"]:
+            failures.append(
+                f"{len(rc['violations'])} unguarded shared-field "
+                f"access(es): {rc['violations'][:3]}"
+            )
+        if rc["deadlock_dumps"]:
+            failures.append(
+                f"deadlock watchdog fired {rc['deadlock_dumps']} time(s) "
+                f"(stalled: {rc['stalled_threads']})"
+            )
+        print(
+            f"racecheck: {len(rc['inversions'])} inversions, "
+            f"{len(rc['violations'])} violations, "
+            f"{rc['deadlock_dumps']} watchdog dumps across "
+            f"{len(rc['heartbeats_seen'])} heartbeating thread(s)"
+        )
     report["failures"] = failures
     with open(args.report, "w") as f:
-        json.dump(report, f, indent=1)
+        json.dump(jsonfinite(report), f, indent=1)
     lat = report["latency_ms"]
     dev = report.get("devices", {})
     print(

@@ -8,7 +8,10 @@
 #     from BOTH param versions (exit non-zero otherwise);
 #     1b forces the compact+pipelined ingest (ISSUE 4); 1c forces the
 #     device-parallel dispatch layer across 8 virtual host devices
-#     (ISSUE 5: distribution + per-replica swap consistency);
+#     (ISSUE 5: distribution + per-replica swap consistency); 1d reruns
+#     the 64-client load under CGNN_TPU_RACECHECK=1 (ISSUE 7) and
+#     asserts ZERO lock-order inversions, ZERO unguarded shared-field
+#     accesses, and ZERO deadlock-watchdog dumps;
 #  2. HTTP front-end: start serve.py, wait for /healthz, fire concurrent
 #     HTTP requests, then SIGTERM -> the server must drain gracefully
 #     (queued requests answered) and exit 0. ISSUE 6 adds the
@@ -127,6 +130,38 @@ assert len(r["param_versions"]) >= 2, r["param_versions"]
 print("leg 1c ok:", r["answered"], "answered across", dev["count"],
       "devices", dev["responses_by_device"], "- swap versions",
       list(r["param_versions"]))
+EOF
+
+echo "== leg 1d: racecheck under the 64-client load (ISSUE 7) =="
+# CGNN_TPU_RACECHECK=1 swaps every serve/pipeline/telemetry lock for the
+# instrumented layer (cgnn_tpu/analysis/racecheck.py): lock-order
+# recording, the shared-field tripwire on the server's counters, and the
+# deadlock watchdog over the heartbeating dispatch/pack/watcher threads.
+# The loadgen folds racecheck.report() into the SLO report and already
+# exits non-zero on any inversion/violation/dump; the reader below pins
+# the report SHAPE too (enabled, clean, heartbeats actually registered).
+CGNN_TPU_RACECHECK=1 python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --clients 64 --duration 6 --hot-swap \
+  --report "$WORK/slo_racecheck.json"
+python - "$WORK/slo_racecheck.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["dropped"] == 0, r
+assert not r["failures"], r["failures"]
+rc = r["racecheck"]
+assert rc["enabled"], "racecheck gate did not engage"
+assert rc["inversions"] == [], rc["inversions"]
+assert rc["violations"] == [], rc["violations"]
+assert rc["deadlock_dumps"] == 0 and not rc["stalled_threads"], rc
+assert rc["clean"], rc
+assert rc["heartbeats_seen"], (
+    "no thread ever heartbeated — the watchdog is watching nothing, "
+    "which would make 'zero deadlocks' vacuous (heartbeats_seen, not "
+    "heartbeating_threads: live beats race clean post-drain exits)")
+print("leg 1d ok:", r["answered"], "answered under racecheck — 0",
+      "inversions / 0 violations / 0 dumps across",
+      len(rc["heartbeats_seen"]), "heartbeating threads:",
+      rc["heartbeats_seen"])
 EOF
 
 echo "== leg 2: HTTP front-end + graceful SIGTERM drain =="

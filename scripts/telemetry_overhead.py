@@ -29,6 +29,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
 
 def build(args, telemetry):
     import numpy as np
@@ -148,7 +150,7 @@ def main() -> int:
         "layout": args.layout,
         "epochs_per_round": args.epochs,
     }
-    line = json.dumps(out)
+    line = json.dumps(jsonfinite(out))
     print(line)
     if args.out:
         with open(args.out, "w") as f:

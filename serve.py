@@ -161,7 +161,8 @@ def main(argv=None) -> int:
     # (resilience.preempt signal plumbing; second signal kills)
     handler = server.install_signal_handlers()
     handler.add_callback(lambda: threading.Thread(
-        target=httpd.shutdown, daemon=True).start())
+        target=httpd.shutdown, daemon=True,
+        name="http-shutdown").start())
 
     shapes = ", ".join(
         f"({s.graph_cap}g/{s.node_cap}n/{s.edge_cap}e)"

@@ -1,0 +1,21 @@
+"""MUST-PASS GC-ALIAS: copy barriers, bare fences, copy-then-place."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def save_state(state, path):
+    host = jax.tree_util.tree_map(np.array, jax.device_get(state))
+    write(path, host)
+
+
+def fetch_scalar(x):
+    return float(jax.device_get(x))
+
+
+def fence(x):
+    jax.device_get(x)
+
+
+def warm(x):
+    return jax.device_put(jnp.array(x), x.sharding)

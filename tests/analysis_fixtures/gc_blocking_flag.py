@@ -1,0 +1,15 @@
+"""MUST-FLAG GC-BLOCKING: a zero-timeout queue.get under the lock."""
+import threading
+
+
+class Fetcher:
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self._q = q
+        self.last = None
+
+    def fetch(self):
+        with self._lock:
+            item = self._q.get()
+            self.last = item
+        return item

@@ -1,0 +1,15 @@
+"""MUST-PASS GC-BLOCKING: block outside, publish under the lock."""
+import threading
+
+
+class Fetcher:
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self._q = q
+        self.last = None
+
+    def fetch(self):
+        item = self._q.get(timeout=1.0)
+        with self._lock:
+            self.last = item
+        return item

@@ -1,0 +1,12 @@
+"""MUST-FLAG GC-DISABLE: escape hatches without the required why."""
+import jax
+import numpy as np
+
+
+def snapshot(state):
+    return jax.device_get(state)  # graftcheck: disable=GC-ALIAS
+
+
+def other(state):
+    # graftcheck: disable=GC-BOGUS -- names a rule that does not exist
+    return np.array(jax.device_get(state))

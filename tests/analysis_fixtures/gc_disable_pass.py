@@ -1,0 +1,8 @@
+"""MUST-PASS GC-DISABLE: a justified disable silences its rule."""
+import jax
+
+
+def snapshot(state):
+    # graftcheck: disable=GC-ALIAS -- audited: consumed read-only and
+    # fully drained before the next donated dispatch can touch buffers
+    return jax.device_get(state)

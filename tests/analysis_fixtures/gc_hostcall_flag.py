@@ -1,0 +1,13 @@
+"""MUST-FLAG GC-HOSTCALL: callback outside the tap + print in a jit."""
+import jax
+
+
+def step(state, batch):
+    jax.debug.callback(emit, batch)
+    return state
+
+
+@jax.jit
+def train_step(x):
+    print("tracing", x)
+    return x * 2

@@ -1,0 +1,12 @@
+"""MUST-PASS GC-HOSTCALL: host prints outside traced code are fine."""
+import jax
+
+
+@jax.jit
+def train_step(x):
+    return x * 2
+
+
+def host_loop(xs):
+    for x in xs:
+        print(train_step(x))

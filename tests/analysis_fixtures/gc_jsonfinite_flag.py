@@ -1,0 +1,7 @@
+"""MUST-FLAG GC-JSONFINITE: float payload with no non-finite guard."""
+import json
+
+
+def write_metrics(path, payload):
+    with open(path, "w") as f:
+        json.dump(payload, f)

@@ -1,0 +1,14 @@
+"""MUST-PASS GC-JSONFINITE: jsonfinite() wrap or allow_nan=False."""
+import json
+
+from cgnn_tpu.observe.metrics_io import jsonfinite
+
+
+def write_metrics(path, payload):
+    with open(path, "w") as f:
+        json.dump(jsonfinite(payload), f)
+
+
+def write_strict(path, payload):
+    with open(path, "w") as f:
+        json.dump(payload, f, allow_nan=False)

@@ -1,0 +1,15 @@
+"""MUST-FLAG GC-LOCKSHARE: the PR-6 scrape-bug shape."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self, n):
+        with self._lock:
+            self.count += n
+
+    def snapshot(self):
+        return {"count": self.count}

@@ -1,0 +1,19 @@
+"""MUST-PASS GC-LOCKSHARE: every access under the lock (or *_locked)."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self, n):
+        with self._lock:
+            self.count += n
+
+    def snapshot(self):
+        with self._lock:
+            return {"count": self.count}
+
+    def merge_locked(self, other):
+        self.count += other

@@ -1,0 +1,4 @@
+# MUST-FLAG: GC-PARSE — an unparseable file is a finding, never a
+# silent skip (graftcheck cannot vouch for invariants it cannot see).
+def broken(:
+    pass

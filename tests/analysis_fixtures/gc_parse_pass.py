@@ -1,0 +1,3 @@
+# MUST-PASS: GC-PARSE — a file that parses produces no parse finding.
+def fine():
+    return 1

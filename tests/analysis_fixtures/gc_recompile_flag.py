@@ -1,0 +1,17 @@
+"""MUST-FLAG GC-RECOMPILE: data-dependent shape + scalar traced arg."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def gather_active(mask):
+    return jnp.nonzero(mask)
+
+
+@jax.jit
+def scale(x, k):
+    return x * k
+
+
+def caller(x):
+    return scale(x, 0.5)

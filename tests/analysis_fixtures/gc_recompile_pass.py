@@ -1,0 +1,19 @@
+"""MUST-PASS GC-RECOMPILE: fixed shapes; scalars declared static."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def masked_sum(x, mask):
+    return jnp.where(mask, x, 0.0).sum()
+
+
+@partial(jax.jit, static_argnums=(1,))
+def scale(x, k):
+    return x * k
+
+
+def caller(x):
+    return scale(x, 2)

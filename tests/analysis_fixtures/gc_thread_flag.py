@@ -1,0 +1,15 @@
+"""MUST-FLAG GC-THREAD: worker loop with no stop-event/sentinel exit."""
+import threading
+
+
+def worker(q):
+    while True:
+        item = q.get(timeout=0.1)
+        handle(item)
+
+
+def start(q):
+    t = threading.Thread(target=worker, args=(q,), daemon=True,
+                         name="pool-worker-0")
+    t.start()
+    return t

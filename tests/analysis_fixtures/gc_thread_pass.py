@@ -1,0 +1,21 @@
+"""MUST-PASS GC-THREAD: the loader contract — stop-event bounded loop."""
+import queue
+import threading
+
+
+def worker(q, stop):
+    while True:
+        if stop.is_set():
+            return
+        try:
+            item = q.get(timeout=0.1)
+        except queue.Empty:
+            continue
+        handle(item)
+
+
+def start(q, stop):
+    t = threading.Thread(target=worker, args=(q, stop), daemon=True,
+                         name="pool-worker-0")
+    t.start()
+    return t

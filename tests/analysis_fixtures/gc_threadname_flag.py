@@ -1,0 +1,8 @@
+"""MUST-FLAG GC-THREADNAME: anonymous Thread-5 is undebuggable."""
+import threading
+
+
+def start(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t

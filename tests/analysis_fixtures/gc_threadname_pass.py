@@ -1,0 +1,9 @@
+"""MUST-PASS GC-THREADNAME: stable attributable thread names."""
+import threading
+
+
+def start(fn, i):
+    t = threading.Thread(target=fn, daemon=True,
+                         name=f"serve-dispatch-{i}")
+    t.start()
+    return t

@@ -1,0 +1,419 @@
+"""graftcheck + racecheck tests (ISSUE 7).
+
+Three layers:
+
+- the fixture corpus (tests/analysis_fixtures): one minimal must-flag
+  and one must-pass snippet per rule — the rule catalog's unit tests;
+- the live-repo pin: ``graftcheck`` runs CLEAN over the real tree, so
+  every invariant the rules encode is enforced forever (a new finding
+  is a CI failure, not a note);
+- racecheck: lock-order inversion detection, the shared-field tripwire,
+  the deadlock watchdog (with the attributable thread names the
+  GC-THREADNAME rule exists for), and the zero-overhead-off contract.
+"""
+
+import io
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from cgnn_tpu.analysis import (
+    RULES,
+    check_file,
+    check_paths,
+    default_targets,
+)
+from cgnn_tpu.analysis import racecheck
+from cgnn_tpu.analysis.engine import check_file as engine_check_file
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+
+
+def _slug(rule: str) -> str:
+    return rule.lower().replace("-", "_")
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("rule", sorted(RULES))
+    def test_flag_fixture_is_caught(self, rule):
+        path = os.path.join(FIXTURES, f"{_slug(rule)}_flag.py")
+        findings = check_file(path)
+        hits = [f for f in findings if f.rule == rule]
+        assert hits, (
+            f"{rule}: must-flag fixture produced no {rule} finding "
+            f"(got {[f.rule for f in findings]})"
+        )
+
+    @pytest.mark.parametrize("rule", sorted(RULES))
+    def test_pass_fixture_is_clean(self, rule):
+        path = os.path.join(FIXTURES, f"{_slug(rule)}_pass.py")
+        findings = check_file(path)
+        assert not findings, (
+            f"{rule}: must-pass fixture flagged: "
+            + "; ".join(f.format() for f in findings)
+        )
+
+    def test_corpus_covers_every_rule(self):
+        """The seeded corpus trips every rule at least once — the CI
+        static-analysis job's 'linter still has teeth' check."""
+        findings = check_paths([FIXTURES], rel_to=REPO)
+        seen = {f.rule for f in findings}
+        missing = set(RULES) - seen
+        assert not missing, f"no corpus violation for rule(s) {missing}"
+
+    def test_messages_cite_the_motivating_incident(self):
+        """Findings explain WHY via the CHANGES.md incident — the fix-it
+        message is the point of the tool."""
+        findings = check_paths([FIXTURES], rel_to=REPO)
+        for f in findings:
+            if f.rule in ("GC-DISABLE", "GC-PARSE"):
+                continue  # policy/parse findings have no PR incident
+            assert "CHANGES.md" in f.message or "PR" in f.message, (
+                f"{f.rule} message cites no incident: {f.message}"
+            )
+
+
+class TestRepoClean:
+    def test_graftcheck_clean_on_live_repo(self):
+        """THE pin: the tree obeys its own invariant catalog. A finding
+        here means either fix the code or add an audited disable —
+        never weaken the rule."""
+        findings = check_paths(default_targets(REPO), rel_to=REPO)
+        assert not findings, (
+            "graftcheck findings on the live repo:\n"
+            + "\n".join(f.format() for f in findings)
+        )
+
+    def test_scan_set_covers_the_package(self):
+        targets = default_targets(REPO)
+        rel = {os.path.relpath(t, REPO) for t in targets}
+        for expected in (
+            "cgnn_tpu/serve/server.py",
+            "cgnn_tpu/train/checkpoint.py",
+            "cgnn_tpu/data/pipeline.py",
+            "scripts/serve_loadgen.py",
+            "train.py",
+            "serve.py",
+        ):
+            assert expected in rel, f"{expected} not in the scan set"
+        assert "__graft_entry__.py" not in rel
+        assert not any(p.startswith("tests") for p in rel)
+
+
+class TestCLI:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "graftcheck.py"), *args],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+
+    def test_ci_exit_zero_on_repo(self):
+        res = self._run("--ci")
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "clean" in res.stdout
+
+    def test_ci_exit_nonzero_on_corpus_with_every_rule(self):
+        res = self._run("--ci", os.path.join("tests", "analysis_fixtures"))
+        assert res.returncode == 1, res.stdout + res.stderr
+        for rule in RULES:
+            assert rule in res.stdout, f"{rule} missing from corpus output"
+        # --ci emits GitHub error annotations for the blocking job
+        assert "::error file=" in res.stdout
+
+    def test_list_rules(self):
+        res = self._run("--list-rules")
+        assert res.returncode == 0
+        for rule in RULES:
+            assert rule in res.stdout
+
+
+class TestDisableComments:
+    def _check(self, source, tmp_path, name="snippet.py"):
+        path = tmp_path / name
+        path.write_text(source)
+        return engine_check_file(str(path))
+
+    def test_justified_trailing_disable_silences(self, tmp_path):
+        findings = self._check(
+            "import jax\n"
+            "def f(s):\n"
+            "    return jax.device_get(s)"
+            "  # graftcheck: disable=GC-ALIAS -- audited: read-only\n",
+            tmp_path,
+        )
+        assert not findings
+
+    def test_standalone_disable_covers_next_code_line(self, tmp_path):
+        findings = self._check(
+            "import jax\n"
+            "def f(s):\n"
+            "    # graftcheck: disable=GC-ALIAS -- audited: read-only\n"
+            "    return jax.device_get(s)\n",
+            tmp_path,
+        )
+        assert not findings
+
+    def test_unjustified_disable_is_a_finding_and_does_not_cover(
+            self, tmp_path):
+        findings = self._check(
+            "import jax\n"
+            "def f(s):\n"
+            "    return jax.device_get(s)  # graftcheck: disable=GC-ALIAS\n",
+            tmp_path,
+        )
+        rules = sorted(f.rule for f in findings)
+        assert rules == ["GC-ALIAS", "GC-DISABLE"], rules
+
+    def test_unknown_rule_is_a_finding(self, tmp_path):
+        findings = self._check(
+            "x = 1  # graftcheck: disable=GC-NOPE -- because\n", tmp_path)
+        assert [f.rule for f in findings] == ["GC-DISABLE"]
+        assert "unknown rule" in findings[0].message
+
+    def test_disable_covers_only_named_rule(self, tmp_path):
+        findings = self._check(
+            "import jax\n"
+            "def f(s):\n"
+            "    return jax.device_get(s)"
+            "  # graftcheck: disable=GC-THREAD -- wrong rule named\n",
+            tmp_path,
+        )
+        assert [f.rule for f in findings] == ["GC-ALIAS"]
+
+
+@pytest.fixture
+def rc_enabled():
+    """Racecheck on, state isolated; always restored to off (the suite
+    runs with the env gate off)."""
+    was = racecheck.enabled()
+    racecheck.enable(True)
+    racecheck.reset()
+    yield racecheck
+    racecheck.reset()
+    racecheck.enable(was)
+
+
+class TestRacecheckLocks:
+    def test_lock_order_inversion_detected(self, rc_enabled):
+        a = racecheck.make_lock("lock-a")
+        b = racecheck.make_lock("lock-b")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        # sequential threads: the ORDER is the hazard, not a live race
+        for name, fn in (("serve-dispatch-0", ab), ("pack-worker-0", ba)):
+            t = threading.Thread(target=fn, name=name)
+            t.start()
+            t.join()
+        rep = racecheck.report()
+        assert len(rep["inversions"]) == 1, rep
+        inv = rep["inversions"][0]
+        assert inv["locks"] == ["lock-a", "lock-b"]
+        # attributable: the report names the threads, not Thread-5
+        joined = inv["order_a"] + inv["order_b"]
+        assert "serve-dispatch-0" in joined and "pack-worker-0" in joined
+        assert not rep["clean"]
+
+    def test_consistent_order_is_clean(self, rc_enabled):
+        a = racecheck.make_lock("lock-a")
+        b = racecheck.make_lock("lock-b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        rep = racecheck.report()
+        assert rep["inversions"] == [] and rep["clean"]
+
+    def test_reentrant_acquire_not_an_inversion(self, rc_enabled):
+        c = racecheck.make_condition("cond-x")
+        with c:
+            with c:
+                pass
+        assert racecheck.report()["clean"]
+
+    def test_condition_wait_notify_roundtrip(self, rc_enabled):
+        """The Condition protocol shims (_is_owned/_release_save/
+        _acquire_restore) must survive a real wait/notify cycle."""
+        c = racecheck.make_condition("cond-y")
+        ready = []
+
+        def consumer():
+            with c:
+                while not ready:
+                    c.wait(timeout=2.0)
+
+        t = threading.Thread(target=consumer, name="cond-consumer")
+        t.start()
+        time.sleep(0.05)
+        with c:
+            ready.append(1)
+            c.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert racecheck.report()["clean"]
+
+
+class TestRacecheckWatchFields:
+    def test_cross_thread_unlocked_touch_is_a_violation(self, rc_enabled):
+        class Counters:
+            def __init__(self):
+                self.responses = 0
+
+        lock = racecheck.make_lock("serve.server")
+        obj = Counters()
+        racecheck.watch_fields(obj, lock, ("responses",))
+
+        def locked_touch():
+            with lock:
+                obj.responses += 1
+
+        def unlocked_touch():
+            obj.responses += 1
+
+        t = threading.Thread(target=locked_touch, name="serve-dispatch-1")
+        t.start(); t.join()
+        assert racecheck.report()["violations"] == []
+        t = threading.Thread(target=unlocked_touch, name="rogue-scraper")
+        t.start(); t.join()
+        rep = racecheck.report()
+        assert rep["violations"], "unlocked cross-thread touch not caught"
+        v = rep["violations"][0]
+        assert v["field"] == "responses" and v["thread"] == "rogue-scraper"
+        assert v["lock"] == "serve.server"
+
+    def test_registering_thread_exempt(self, rc_enabled):
+        class Counters:
+            def __init__(self):
+                self.responses = 0
+
+        lock = racecheck.make_lock("serve.server")
+        obj = Counters()
+        racecheck.watch_fields(obj, lock, ("responses",))
+        obj.responses += 1  # same thread that registered: allowed
+        assert racecheck.report()["violations"] == []
+
+
+class TestRacecheckWatchdog:
+    def test_watchdog_names_the_stalled_thread(self, rc_enabled):
+        """The satellite pin: dumps are attributable BY NAME — the
+        stable serve-dispatch-{i}/pack-worker-{i} names graftcheck's
+        GC-THREADNAME rule mandates show up in the stall report and the
+        ident map."""
+        release = threading.Event()
+
+        def wedge():
+            racecheck.heartbeat()
+            release.wait(10)
+
+        names = ["serve-dispatch-0", "pack-worker-1"]
+        threads = [threading.Thread(target=wedge, name=n, daemon=True)
+                   for n in names]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        sink = io.StringIO()
+        dog = racecheck.Watchdog(bound_s=0.2, interval_s=0.05, sink=sink,
+                                 log_fn=lambda m: None)
+        assert dog.check_once() == []  # beats fresh: not stalled yet
+        time.sleep(0.35)
+        stalled = dog.check_once()
+        assert sorted(stalled) == sorted(names), stalled
+        dog.dump(stalled)
+        out = sink.getvalue()
+        for n in names:
+            assert n in out, f"dump not attributable: {n} missing\n{out}"
+        assert "racecheck deadlock watchdog" in out
+        release.set()
+        for t in threads:
+            t.join(timeout=5)
+
+    def test_cleanly_exited_thread_is_pruned_not_reported(self, rc_enabled):
+        def beat_and_exit():
+            racecheck.heartbeat()
+
+        t = threading.Thread(target=beat_and_exit, name="pack-worker-9")
+        t.start(); t.join()
+        dog = racecheck.Watchdog(bound_s=0.0, interval_s=10,
+                                 log_fn=lambda m: None)
+        assert dog.check_once(now=time.monotonic() + 60) == []
+        rep = racecheck.report()
+        assert "pack-worker-9" not in rep["heartbeating_threads"]
+        # ...but heartbeats_seen survives the prune: the smoke leg's
+        # "the watchdog watched SOMETHING" assertion must not race a
+        # clean post-drain exit
+        assert "pack-worker-9" in rep["heartbeats_seen"]
+
+    def test_ident_reuse_does_not_fake_a_deadlock(self, rc_enabled):
+        """A dead thread's beat must be pruned even when an unrelated
+        live thread holds the (reused) ident — keying liveness on the
+        bare ident would dump a spurious deadlock for a clean exit."""
+        def beat_and_exit():
+            racecheck.heartbeat()
+
+        t = threading.Thread(target=beat_and_exit, name="pack-worker-8")
+        t.start(); t.join()
+        # simulate CPython ident reuse: point the stale beat at a LIVE
+        # thread (this one) that has a different name
+        with racecheck._state_lock:
+            last, _ = racecheck._beats["pack-worker-8"]
+            racecheck._beats["pack-worker-8"] = (
+                last, threading.get_ident())
+        dog = racecheck.Watchdog(bound_s=0.0, interval_s=10,
+                                 log_fn=lambda m: None)
+        assert dog.check_once(now=time.monotonic() + 60) == []
+
+    def test_start_watchdog_rearms_the_singleton(self, rc_enabled):
+        """A second server in the same process must re-point the
+        watchdog's bound and logger, not be silently ignored (stall
+        logs wired to a drained predecessor)."""
+        logs_a, logs_b = [], []
+        dog = racecheck.start_watchdog(bound_s=40.0, log_fn=logs_a.append)
+        try:
+            again = racecheck.start_watchdog(bound_s=5.0,
+                                             log_fn=logs_b.append)
+            assert again is dog  # still the singleton
+            assert dog.bound_s == 5.0
+            dog._log("stall")
+            assert logs_b == ["stall"] and logs_a == []
+        finally:
+            dog.stop()
+
+
+class TestRacecheckOff:
+    def test_zero_overhead_when_gated_off(self):
+        racecheck.enable(False)
+        racecheck.reset()
+        lk = racecheck.make_lock("anything")
+        assert isinstance(lk, type(threading.Lock())), (
+            "make_lock must return a PLAIN threading.Lock when off "
+            "(the PERF.md zero-overhead contract)"
+        )
+        cond = racecheck.make_condition("anything")
+        assert isinstance(cond, threading.Condition)
+        assert not isinstance(getattr(cond, "_lock", None),
+                              racecheck.InstrumentedLock)
+        racecheck.heartbeat()  # no-op: registers nothing
+        assert racecheck.start_watchdog() is None
+
+        class Obj:
+            pass
+
+        obj = Obj()
+        racecheck.watch_fields(obj, lk, ("x",))
+        assert type(obj) is Obj  # class NOT swapped when off
+        rep = racecheck.report()
+        assert rep["clean"] and not rep["enabled"]
+        assert rep["heartbeating_threads"] == []

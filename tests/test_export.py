@@ -379,3 +379,31 @@ class TestBenchRegress:
         json.dump({"parsed": {"value": 101.0}},
                   open(tmp_path / "BENCH_r02.json", "w"))
         assert bench_regress.main(["--dir", str(tmp_path)]) == 1
+
+    def test_single_round_is_an_explicit_baseline(self, tmp_path, capsys):
+        """One BENCH file is NOT a silent pass: the step must say
+        'baseline recorded' (ISSUE 7 — an empty-looking success is how
+        a broken glob or wiped artifact dir hides)."""
+        import sys
+
+        sys.path.insert(0, "scripts")
+        import bench_regress
+
+        json.dump({"parsed": {"mfu": 0.03,
+                              "train_structs_per_sec": 100.0}},
+                  open(tmp_path / "BENCH_r01.json", "w"))
+        rc = bench_regress.main(["--dir", str(tmp_path), "--github"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "baseline recorded" in out
+        assert "::notice" in out  # annotated, not invisible, in CI
+        assert "r01" in out
+
+    def test_no_rounds_says_nothing_to_do(self, tmp_path, capsys):
+        import sys
+
+        sys.path.insert(0, "scripts")
+        import bench_regress
+
+        assert bench_regress.main(["--dir", str(tmp_path)]) == 0
+        assert "nothing to do" in capsys.readouterr().out

@@ -76,6 +76,10 @@ class TestPrefetch:
 
 
 def _pack_threads():
+    # the stable pool thread names (graftcheck GC-THREADNAME satellite):
+    # workers are '<prefix>-worker-{i}', the feeder '<prefix>-feeder',
+    # both keyed by the pool prefix so concurrent pools stay distinct
+    # in the racecheck beats registry (default prefix: 'cgnn-pack')
     return [t for t in threading.enumerate()
             if t.name.startswith("cgnn-pack") and t.is_alive()]
 

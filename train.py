@@ -779,7 +779,7 @@ def main(argv=None) -> int:
         for b in _biter(test_g, args.batch_size, node_cap, edge_cap,
                         dense_m=layout_m, in_cap=0, snug=snug,
                         edge_dtype=edge_dtype):
-            out = np.asarray(jax.device_get(pstep(state, b)))
+            out = np.array(jax.device_get(pstep(state, b)))  # copy: GC-ALIAS
             n_real = int(np.asarray(b.graph_mask).sum())
             scores.append(out[:n_real])
             labels.extend(
