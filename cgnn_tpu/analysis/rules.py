@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 
 # rule id -> one-line description (the --list-rules output; INVARIANTS.md
 # carries the full incident write-ups)
@@ -71,6 +72,15 @@ RULES = {
         "metrics_live.jsonl fix) — route payloads through jsonfinite() "
         "or pass allow_nan=False to fail loudly."
     ),
+    "GC-DTYPE": (
+        "float64 creep into jitted code: np.float64 / 'float64' dtype "
+        "literals, or dtype-less np.array/np.zeros/np.ones/np.empty/"
+        "np.full/np.arange (numpy defaults to float64) inside a jitted "
+        "body — under x64 these double the HBM bytes of the exact "
+        "memory-bound paths the roofline ledger budgets; the graftaudit "
+        "GA-F64 gate proves compiled programs stay f64-free "
+        "(CHANGES.md PR 8)."
+    ),
     "GC-DISABLE": (
         "a graftcheck disable comment without a justification string "
         "(or naming an unknown rule): escape hatches must say WHY "
@@ -93,6 +103,13 @@ _CALLBACK_NAMES = ("debug.print", "debug.callback", "io_callback",
 _HOSTCALLS_IN_JIT = ("print", "open", "input")
 _HOSTCALL_DOTTED = ("time.time", "time.perf_counter", "time.monotonic")
 _DATA_DEP_SHAPE = ("nonzero", "unique", "argwhere", "flatnonzero")
+# numpy constructors that default to float64 when dtype is omitted
+_NP_F64_DEFAULT = ("array", "zeros", "ones", "empty", "full", "arange",
+                   "linspace", "eye")
+# a dtype passed positionally (np.zeros(4, np.float32)) still counts as
+# supplied — match expressions that read as dtype names
+_DTYPE_NAME_RE = re.compile(
+    r"^(float|int|uint|complex)\d+$|^(bfloat16|bool_|float_|int_)$")
 _LOCK_FACTORIES = ("Lock", "RLock", "Condition", "make_lock",
                    "make_condition")
 _COPY_WRAPPERS = ("array", "float", "int", "bool", "copy", "deepcopy")
@@ -637,6 +654,78 @@ def _check_blocking(tree: ast.Module) -> list[RawFinding]:
     return out
 
 
+def _check_dtype(tree: ast.Module) -> list[RawFinding]:
+    """GC-DTYPE: f64 creep inside jitted bodies.
+
+    Two shapes, both scoped to code _jitted_functions can see traced:
+    explicit float64 (``np.float64`` / ``jnp.float64`` attributes,
+    ``'float64'``/``'f64'`` dtype strings), and dtype-less numpy
+    constructors (``np.array``/``zeros``/``ones``/... default to
+    float64, silently doubling HBM bytes under x64). jnp constructors
+    without dtype are fine — they default to the f32 weak type. The
+    graftaudit GA-F64 gate proves the same policy on the COMPILED
+    programs; this rule points at the source line that caused it.
+    """
+
+    def supplies_dtype(call: ast.Call) -> bool:
+        def looks_like_dtype(node: ast.AST) -> bool:
+            if isinstance(node, ast.Attribute):
+                return bool(_DTYPE_NAME_RE.match(node.attr))
+            if isinstance(node, ast.Name):
+                return bool(_DTYPE_NAME_RE.match(node.id))
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                return bool(_DTYPE_NAME_RE.match(node.value))
+            return False
+
+        return (any(kw.arg == "dtype" for kw in call.keywords)
+                or any(looks_like_dtype(a) for a in call.args))
+
+    out = []
+    jitted, _, _ = _jitted_functions(tree)
+    seen: set[int] = set()
+    for fn in jitted.values():
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                out.append(_raw(
+                    "GC-DTYPE", node,
+                    f"{_dotted(node) or 'float64'} inside the jitted body "
+                    f"{fn.name!r}: the dtype policy is f32/bf16 — under "
+                    "x64 an f64 leaf doubles HBM bytes on the exact "
+                    "memory-bound paths the roofline ledger budgets "
+                    "(AUDIT_LEDGER.json); the graftaudit GA-F64 gate "
+                    "fails on the compiled program (CHANGES.md PR 8).",
+                ))
+            elif (isinstance(node, ast.keyword) and node.arg == "dtype"
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value in ("float64", "f64")):
+                out.append(_raw(
+                    "GC-DTYPE", node.value,
+                    f"dtype={node.value.value!r} inside the jitted body "
+                    f"{fn.name!r}: the dtype policy is f32/bf16 "
+                    "(graftaudit GA-F64 proves it on the compiled "
+                    "program; CHANGES.md PR 8).",
+                ))
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if (d.split(".")[0] in ("np", "numpy")
+                        and _tail(d) in _NP_F64_DEFAULT
+                        and not supplies_dtype(node)):
+                    out.append(_raw(
+                        "GC-DTYPE", node,
+                        f"dtype-less {d}(...) inside the jitted body "
+                        f"{fn.name!r}: numpy constructors default to "
+                        "float64, which traces as an f64 constant under "
+                        "x64 — pass dtype=np.float32 (or build with jnp, "
+                        "whose weak-typed default stays f32); the "
+                        "graftaudit GA-F64 gate fails on the compiled "
+                        "program (CHANGES.md PR 8).",
+                    ))
+    return out
+
+
 def _check_jsonfinite(tree: ast.Module) -> list[RawFinding]:
     out = []
     for node in ast.walk(tree):
@@ -681,6 +770,7 @@ def check_module(tree: ast.Module, path: str) -> list[RawFinding]:
     out += _check_thread(tree)
     out += _check_lockshare(tree)
     out += _check_blocking(tree)
+    out += _check_dtype(tree)
     out += _check_jsonfinite(tree)
     out.sort(key=lambda f: (f.line, f.rule))
     return out

@@ -30,7 +30,11 @@ from cgnn_tpu.data.graph import (
 )
 from cgnn_tpu.resilience import faultinject
 from cgnn_tpu.train.state import TrainState
-from cgnn_tpu.train.step import make_eval_step, make_train_step
+from cgnn_tpu.train.step import (
+    TRAIN_STEP_DONATE,
+    make_eval_step,
+    make_train_step,
+)
 
 
 def stack_batches(batches: Sequence[GraphBatch]) -> GraphBatch:
@@ -241,7 +245,7 @@ def make_parallel_train_step(
         out_specs=(P(), P()),
         check_vma=False,  # grads/stats are pmean-ed -> replicated outputs
     )
-    jitted = jax.jit(smapped, donate_argnums=0)
+    jitted = jax.jit(smapped, donate_argnums=TRAIN_STEP_DONATE)
 
     def guarded(state: TrainState, stacked: GraphBatch):
         # --check-invariants last line of defense for direct callers that
@@ -260,6 +264,9 @@ def make_parallel_train_step(
                 )
         return jitted(state, stacked)
 
+    # the underlying jit, exposed for .lower() callers (the graftaudit
+    # donation/roofline checks lower the REAL DP program, not a rebuild)
+    guarded.jitted = jitted
     return guarded
 
 
@@ -580,7 +587,7 @@ def fit_data_parallel(
         # purpose: it stages its own in-scan tap (wrapping here too would
         # double-record every step).
         train_step = jax.jit(telemetry.wrap_train_body(train_step),
-                             donate_argnums=0)
+                             donate_argnums=TRAIN_STEP_DONATE)
         eval_step = jax.jit(telemetry.wrap_eval_body(eval_step))
     if monitor is not None and monitor.post_restore is None:
         # a rollback restores onto the default device; re-place it

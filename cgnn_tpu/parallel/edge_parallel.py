@@ -35,7 +35,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cgnn_tpu.data.graph import GraphBatch
 from cgnn_tpu.train.state import TrainState
-from cgnn_tpu.train.step import make_eval_step, make_train_step
+from cgnn_tpu.train.step import (
+    TRAIN_STEP_DONATE,
+    make_eval_step,
+    make_train_step,
+)
 
 # GraphBatch leaves whose leading axis is the edge axis
 EDGE_FIELDS = ("edges", "centers", "neighbors", "edge_mask", "edge_offsets")
@@ -281,7 +285,7 @@ def make_edge_parallel_train_step(
         in_specs=(P(), _specs(graph_axis, dense=dense)),
         out_specs=(P(), P()),
     )
-    return jax.jit(smapped, donate_argnums=0)
+    return jax.jit(smapped, donate_argnums=TRAIN_STEP_DONATE)
 
 
 def make_edge_parallel_eval_step(
@@ -348,7 +352,7 @@ def make_dp_edge_parallel_train_step(
         in_specs=(P(), _specs(graph_axis, data_axis, dense=dense)),
         out_specs=(P(), P()),
     )
-    return jax.jit(smapped, donate_argnums=0)
+    return jax.jit(smapped, donate_argnums=TRAIN_STEP_DONATE)
 
 
 def make_dp_edge_parallel_eval_step(

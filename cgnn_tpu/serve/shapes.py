@@ -212,6 +212,32 @@ class ShapeSet:
             edge_dtype=self.edge_dtype,
         )
 
+    def abstract_batches(self, template: CrystalGraph) -> dict:
+        """{(rung index, staging form): abstract batch pytree} for every
+        program this set compiles — the graftaudit lowering surface.
+
+        Packs one copy of ``template`` per rung (exactly the batches
+        ``serve.server.warm()`` dispatches) and maps every leaf to a
+        ``jax.ShapeDtypeStruct``, so ``jax.jit(...).lower(state_aval,
+        batch_aval)`` sees the same traced programs serving warms —
+        without touching a device. Forms: ``"compact"`` and ``"full"``
+        for a compact set (warm() compiles both per rung), ``"full"``
+        only otherwise."""
+        import jax
+
+        def aval(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        out = {}
+        for i, shape in enumerate(self.shapes):
+            forms = {}
+            if self.compact is not None:
+                forms["compact"] = self.pack([template], shape=shape)
+            forms["full"] = self.pack_full([template], shape=shape)
+            for form, batch in forms.items():
+                out[(i, form)] = jax.tree_util.tree_map(aval, batch)
+        return out
+
     def buffer_key(self, shape: BatchShape) -> tuple:
         """Staging-buffer pool key for one rung (compact sets only)."""
         if self.compact is None:

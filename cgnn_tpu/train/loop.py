@@ -60,7 +60,12 @@ from cgnn_tpu.observe import Telemetry
 from cgnn_tpu.observe.gauges import device_hbm_table_bytes
 from cgnn_tpu.resilience import faultinject
 from cgnn_tpu.train.state import TrainState
-from cgnn_tpu.train.step import make_eval_step, make_train_step
+from cgnn_tpu.train.step import (
+    TRAIN_STEP_DONATE,
+    jit_train_step,
+    make_eval_step,
+    make_train_step,
+)
 
 # fraction of HBM the staged dataset may claim — the rest is params, opt
 # state, activations, XLA workspace, and the scan driver's staged perms
@@ -510,7 +515,8 @@ class ScanEpochDriver:
                 )
 
             cache[key] = jax.jit(
-                scan_fn, donate_argnums=(0,) if train else ()
+                scan_fn,
+                donate_argnums=TRAIN_STEP_DONATE if train else (),
             )
         return cache[key]
 
@@ -1005,9 +1011,7 @@ def fit(
 
         base_train = guard_step(base_train)
     base_eval = eval_step_fn or make_eval_step(classification)
-    train_step = jax.jit(
-        telemetry.wrap_train_body(base_train), donate_argnums=0
-    )
+    train_step = jit_train_step(telemetry.wrap_train_body(base_train))
     eval_step = jax.jit(telemetry.wrap_eval_body(base_eval))
     best_key = best_metric or ("correct" if classification else "mae")
     best = -np.inf if classification else np.inf
@@ -1076,11 +1080,10 @@ def fit(
             if expand is not None:
                 # the per-step loop sees CompactBatches: expansion moves
                 # into the jitted step bodies
-                train_step = jax.jit(
+                train_step = jit_train_step(
                     telemetry.wrap_train_body(
                         lambda s, b: base_train(s, expand(b))
-                    ),
-                    donate_argnums=0,
+                    )
                 )
                 eval_step = jax.jit(
                     telemetry.wrap_eval_body(

@@ -148,6 +148,29 @@ def make_eval_step(
     return eval_step
 
 
+# the train step donates its state carry (argument 0): XLA reuses the
+# parameter/optimizer buffers in place instead of allocating a second
+# copy per step. TRAIN_STEP_DONATE is the ONE declaration of WHICH
+# argument is donated — consumed by jit_train_step (single-device
+# bodies), the scan driver, and the DP/edge-sharded wrappers in
+# parallel/ — and the graftaudit GA-DONATION check verifies XLA
+# actually applied the aliasing (analysis/program_audit).
+TRAIN_STEP_DONATE = (0,)
+
+
+def jit_train_step(body: Callable):
+    """The canonical jit wrapper for single-device (state, batch) ->
+    (state, metrics) train-step bodies.
+
+    ``body`` may be the raw step, guard-wrapped (resilience.guard), or
+    telemetry-wrapped (observe) — anything with the train-step carry
+    signature. Used by train/loop.py, scripts/hlo_dump.py, and the
+    program auditor, so a single-device train step reaches XLA exactly
+    one way; the shard_map wrappers in parallel/ jit themselves but
+    share the TRAIN_STEP_DONATE contract."""
+    return jax.jit(body, donate_argnums=TRAIN_STEP_DONATE)
+
+
 def make_predict_step(expander: Callable | None = None) -> Callable:
     """(state, batch) -> denormalized predictions [G, T].
 

@@ -15,6 +15,15 @@ script is the automated reader:
   regressed key, and exits 1 when any named key regressed beyond the
   threshold.
 
+``--ledger BASELINE NEW`` additionally diffs two ``AUDIT_LEDGER.json``
+payloads (ISSUE 8) through the same budget semantics with the sign
+flipped: the gated keys (bytes, peak temp memory, bytes/FLOP) are
+LOWER-is-better, and a program or key that disappears from the new
+ledger is a regression — a budget that stopped being measured is how a
+regression hides. The diff logic lives in
+``cgnn_tpu.analysis.program_audit.diff_ledgers`` (stdlib-only), shared
+with ``graftaudit.py --ci``.
+
 CI wires it as a NON-BLOCKING annotation step (continue-on-error: the
 bench numbers come from whatever machine ran the round, so a regression
 is a flag for the next bench run on real hardware, not a merge gate).
@@ -33,6 +42,8 @@ import json
 import os
 import re
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # higher-is-better keys checked against the threshold; everything else
 # in the flattened payload is printed for context only
@@ -112,6 +123,42 @@ def diff_rounds(old: dict, new: dict, keys, threshold: float) -> dict:
     return {"rows": rows, "regressions": regressions}
 
 
+def diff_ledger_files(baseline_path: str, new_path: str,
+                      threshold: float, github: bool) -> int:
+    """AUDIT_LEDGER budget diff (lower-is-better, dropped key =
+    regression) -> number of hard regressions. Shares
+    program_audit.diff_ledgers with graftaudit --ci."""
+    from cgnn_tpu.analysis.program_audit import diff_ledgers, load_ledger
+
+    diff = diff_ledgers(load_ledger(baseline_path), load_ledger(new_path),
+                        threshold=threshold)
+    print(f"bench_regress: audit ledger {os.path.basename(baseline_path)} "
+          f"-> {os.path.basename(new_path)} (threshold {threshold:.0%}, "
+          f"lower-is-better)")
+    for row in diff["rows"]:
+        o = "-" if row["old"] is None else f"{row['old']}"
+        n = "-" if row["new"] is None else f"{row['new']}"
+        ratio = f"{row['ratio']:.3f}x" if "ratio" in row else ""
+        print(f"  {row['key']:<45} {o:>14} -> {n:>14}  {ratio:>8}  "
+              f"{row.get('note', '')}")
+    for row in diff["regressions"]:
+        msg = (f"audit budget {row['key']}: {row.get('note', '')} "
+               f"(baseline {row['old']}, new {row['new']})")
+        if github:
+            print(f"::error title=audit budget::{msg}")
+        print(f"bench_regress: {msg}", file=sys.stderr)
+    for row in diff["warnings"]:
+        msg = (f"audit budget {row['key']} drifted under a different jax "
+               f"than the baseline's: {row.get('note', '')}")
+        if github:
+            print(f"::warning title=audit budget skew::{msg}")
+        print(f"bench_regress: {msg}")
+    if not diff["regressions"]:
+        print(f"bench_regress: audit budgets ok ({len(diff['rows'])} keys"
+              f"{', version skew' if diff['version_skew'] else ''})")
+    return len(diff["regressions"])
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--dir", default=os.path.dirname(
@@ -123,13 +170,21 @@ def main(argv=None) -> int:
                    help="comma-separated override of the named keys")
     p.add_argument("--github", action="store_true",
                    help="emit GitHub workflow annotation lines")
+    p.add_argument("--ledger", nargs=2, metavar=("BASELINE", "NEW"),
+                   help="also budget-diff two AUDIT_LEDGER.json files "
+                        "(lower-is-better keys; dropped key = regression)")
     args = p.parse_args(argv)
+
+    ledger_regressions = 0
+    if args.ledger:
+        ledger_regressions = diff_ledger_files(
+            args.ledger[0], args.ledger[1], args.threshold, args.github)
 
     rounds = find_rounds(args.dir)
     if not rounds:
         print(f"bench_regress: no BENCH_r*.json under {args.dir} — "
               f"nothing to do")
-        return 0
+        return 1 if ledger_regressions else 0
     if len(rounds) == 1:
         # exactly one round is NOT a silent pass: it is the baseline
         # every later round will be judged against — say so explicitly
@@ -144,7 +199,7 @@ def main(argv=None) -> int:
         if args.github:
             print(f"::notice title=bench baseline recorded::{msg}")
         print(f"bench_regress: {msg}")
-        return 0
+        return 1 if ledger_regressions else 0
     (old_n, old_path), (new_n, new_path) = rounds[-2], rounds[-1]
     keys = ([k.strip() for k in args.keys.split(",") if k.strip()]
             or list(DEFAULT_KEYS))
@@ -174,7 +229,7 @@ def main(argv=None) -> int:
     if args.github:
         print(f"::notice title=bench regression check::{msg}")
     print(f"bench_regress: {msg}")
-    return 0
+    return 1 if ledger_regressions else 0
 
 
 if __name__ == "__main__":

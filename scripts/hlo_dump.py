@@ -63,11 +63,11 @@ def main(argv=None) -> int:
     import jax
     import numpy as np
 
+    from cgnn_tpu.analysis.program_audit import lower_train_program
     from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic_mp
     from cgnn_tpu.data.graph import bucketed_batch_iterator
     from cgnn_tpu.models import CrystalGraphConvNet
     from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
-    from cgnn_tpu.train.step import make_train_step
 
     cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
     graphs = load_synthetic_mp(args.n, cfg, seed=0)
@@ -88,8 +88,10 @@ def main(argv=None) -> int:
     state = create_train_state(
         model, batch, tx, Normalizer.fit(np.stack([g.target for g in graphs]))
     )
-    step = jax.jit(make_train_step(), donate_argnums=0)
-    compiled = step.lower(state, jax.device_put(batch)).compile()
+    # ONE lowering path for train programs (ISSUE 8): the same
+    # jit_train_step/abstract-aval plumbing graftaudit audits, so the
+    # HLO this dumps is byte-for-byte the program the auditor gates
+    compiled = lower_train_program(state, batch).compile()
     txt = compiled.as_text()
     with open(args.out, "w") as f:
         f.write(txt)

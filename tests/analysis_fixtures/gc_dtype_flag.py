@@ -1,0 +1,19 @@
+"""MUST-FLAG GC-DTYPE: f64 creep inside jitted bodies, three shapes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step_explicit(x):
+    return x.astype(np.float64)
+
+
+@jax.jit
+def step_string(x):
+    return jnp.zeros(x.shape, dtype="float64") + x
+
+
+@jax.jit
+def step_default(x):
+    return x + np.ones(4)

@@ -1,0 +1,16 @@
+"""MUST-PASS GC-DTYPE: explicit f32 in jit; dtype-less numpy on host."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    bias = np.zeros(4, dtype=np.float32)
+    scale = np.ones(4, np.float32)  # positional dtype counts too
+    return jnp.ones(x.shape) + x + bias * scale
+
+
+def host_setup():
+    # host-side staging: dtype-less numpy never reaches traced code here
+    return np.zeros(8)
