@@ -25,6 +25,7 @@ where vs_baseline is value / 10_000 (BASELINE.json:5 north star).
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -219,7 +220,268 @@ def _bench_force_workload(graphs, batch_size, *, dense_m=None, n_timed=16,
             f"{label}rounds_s": rounds_s}
 
 
-def main() -> None:
+# ---------------------------------------------------------------------------
+# --ab: first-class interleaved A/B (the §6b/§8 protocol in ONE flag)
+# ---------------------------------------------------------------------------
+
+# flag -> how to build the train-step variants. Cross-session BENCH
+# levels drift with the link (PERF.md §8), so the ONLY trustworthy
+# comparison is alternating rounds in one process: one unrecorded
+# burn-in round, then recorded rounds with the variant order rotated so
+# monotonic drift within a round biases each variant equally; the
+# artifact reports PAIRED per-round ratios, which is what kills the
+# bench-link noise that muddied the r3->r5 trajectory.
+AB_FLAGS = ("cgconv", "fused-epilogue", "transpose", "compact", "precision")
+
+
+def _ab_train_variants(flag: str, graphs, batch_size, buckets):
+    """{name: dict(step, state, dev, structs)} for a train-step A/B."""
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.data.compact import (
+        CompactSpec,
+        compact_pack_fn,
+        make_expander,
+    )
+    from cgnn_tpu.data.dataset import FeaturizeConfig
+    from cgnn_tpu.data.graph import bucketed_batch_iterator
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.ops.pallas_cgconv import window_width
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.step import make_train_step
+
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    edge_dtype = jax.numpy.bfloat16
+    on_tpu = jax.default_backend() == "tpu"
+
+    def batches(pack_fn=None):
+        return list(bucketed_batch_iterator(
+            graphs, batch_size, buckets, rng=np.random.default_rng(0),
+            dense_m=12, snug=True, edge_dtype=edge_dtype, pack_fn=pack_fn,
+        ))
+
+    full = batches()
+    structs = [float(np.asarray(b.graph_mask).sum()) for b in full]
+    tx = make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10**9])
+    targets = np.stack([np.array(g.target) for g in graphs])
+
+    def model_for(**kw):
+        return CrystalGraphConvNet(
+            atom_fea_len=64, n_conv=3, h_fea_len=128,
+            dtype=jax.numpy.bfloat16, dense_m=12, **kw,
+        )
+
+    def variant(model, dev, step_body=None, transpose=None):
+        state = create_train_state(
+            model, full[0], tx,
+            Normalizer.fit(np.copy(targets)), rng=jax.random.key(0),
+        )
+        body = step_body or make_train_step()
+        return {
+            "dev": dev,
+            "state": state,
+            "step": jax.jit(body, donate_argnums=0),
+            "transpose": transpose,
+            "structs": structs,
+        }
+
+    dev_full = [jax.device_put(b) for b in full]
+    base = model_for()
+    if flag == "cgconv":
+        # the whole-conv fused kernel (ops/pallas_cgconv.py): 'pallas'
+        # on a TPU backend, the structured 'xla' twin elsewhere (the
+        # kernels lower only on TPU — config.py backend rule)
+        impl = "pallas" if on_tpu else "xla"
+        fused = model_for(cgconv_impl=impl,
+                          cgconv_window=window_width(
+                              max(g.num_nodes for g in graphs)))
+        return {
+            "unfused": variant(base, dev_full),
+            f"cgconv-{impl}": variant(fused, dev_full),
+        }
+    if flag == "fused-epilogue":
+        impl = "pallas" if on_tpu else "xla"
+        fused = model_for(fused_epilogue=impl)
+        return {
+            "unfused": variant(base, dev_full),
+            f"epilogue-{impl}": variant(fused, dev_full),
+        }
+    if flag == "transpose":
+        return {
+            "linear_call": variant(base, dev_full,
+                                   transpose="linear_call"),
+            "custom_vjp": variant(base, dev_full,
+                                  transpose="custom_vjp"),
+        }
+    if flag == "compact":
+        spec = CompactSpec.build(graphs, cfg.gdf(), dense_m=12,
+                                 edge_dtype=edge_dtype)
+        compact = batches(compact_pack_fn(spec))
+        expander = make_expander(spec)
+        base_step = make_train_step()
+        return {
+            "full": variant(base, dev_full),
+            "compact": variant(
+                base, [jax.device_put(b) for b in compact],
+                step_body=lambda s, b: base_step(s, expander(b)),
+            ),
+        }
+    raise ValueError(f"--ab {flag}: unknown (valid: {AB_FLAGS})")
+
+
+def _run_ab(flag: str, *, n: int, batch_size: int, buckets: int,
+            rounds: int, steps: int) -> dict:
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic_mp
+    from cgnn_tpu.ops import segment
+
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    graphs = load_synthetic_mp(n, cfg, seed=0)
+    if flag == "precision":
+        return _run_ab_precision(graphs, batch_size, rounds)
+    variants = _ab_train_variants(flag, graphs, batch_size, buckets)
+
+    def set_transpose(v):
+        segment.set_transpose_impl(v.get("transpose") or "linear_call")
+
+    # compile every variant first (per-shape warmup, value-fetch fenced)
+    for name, v in variants.items():
+        set_transpose(v)
+        seen = set()
+        metrics = None
+        for b in v["dev"]:
+            k = (b.node_capacity, b.edge_capacity)
+            if k not in seen:
+                seen.add(k)
+                v["state"], metrics = v["step"](v["state"], b)
+        v["state"], metrics = v["step"](v["state"], v["dev"][0])
+        float(metrics["loss_sum"])
+
+    names = list(variants)
+    rows: list[dict] = []
+    for r in range(-1, rounds):  # round -1 = discarded burn-in
+        order = names[r % len(names):] + names[: r % len(names)]
+        for name in order:
+            v = variants[name]
+            set_transpose(v)
+            t0 = time.perf_counter()
+            done = 0.0
+            metrics = None
+            for i in range(steps):
+                k = i % len(v["dev"])
+                v["state"], metrics = v["step"](v["state"], v["dev"][k])
+                done += v["structs"][k]
+            float(metrics["loss_sum"])  # value-fetch fence
+            dt = time.perf_counter() - t0
+            if r >= 0:
+                rows.append({"round": r, "variant": name,
+                             "structs_per_sec": round(done / dt, 1)})
+    segment.set_transpose_impl("linear_call")
+    return _ab_report(flag, names, rows, extra={
+        "workload": f"MP-like n={n} batch={batch_size} buckets={buckets} "
+                    f"dense two-tier bf16 train step",
+        "device": str(jax.devices()[0].device_kind),
+    })
+
+
+def _run_ab_precision(graphs, batch_size, rounds) -> dict:
+    """Inference-side A/B: the serving precision tiers' e2e forward rate
+    (run_fast_inference over the ladder), interleaved per round."""
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.serve.quantize import TIERS, build_tier_specs
+    from cgnn_tpu.serve.shapes import plan_shape_set
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.infer import run_fast_inference
+    from cgnn_tpu.train.step import make_predict_step
+
+    model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
+                                dense_m=12)
+    ladder = plan_shape_set(graphs, batch_size, rungs=3, dense_m=12)
+    state = create_train_state(
+        model, ladder.pack_full([graphs[0]]),
+        make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10**9]),
+        Normalizer.fit(np.stack([np.array(g.target) for g in graphs])),
+    )
+    specs = build_tier_specs(model, TIERS)
+    pstep = jax.jit(make_predict_step())
+    states = {t: specs[t].state_for(state) for t in TIERS}
+    kw = dict(shape_set=ladder, predict_step=pstep, pack_workers=0)
+    for st in states.values():  # compile pass per tier
+        run_fast_inference(st, graphs, batch_size, **kw)
+    names = list(TIERS)
+    rows = []
+    for r in range(-1, rounds):
+        order = names[r % len(names):] + names[: r % len(names)]
+        for name in order:
+            _, rate = run_fast_inference(states[name], graphs, batch_size,
+                                         **kw)
+            if r >= 0:
+                rows.append({"round": r, "variant": name,
+                             "structs_per_sec": round(rate, 1)})
+    return _ab_report("precision", names, rows, extra={
+        "workload": f"MP-like n={len(graphs)} ladder inference e2e "
+                    f"(serve/quantize.py tiers)",
+        "device": str(jax.devices()[0].device_kind),
+    })
+
+
+def _ab_report(flag, names, rows, extra) -> dict:
+    import numpy as np
+
+    def rates(name):
+        return [e["structs_per_sec"] for e in rows if e["variant"] == name]
+
+    base = names[0]
+    med = {n: float(np.median(rates(n))) for n in names}
+    # PAIRED per-round deltas vs the first variant: each round's tunnel
+    # conditions hit all variants, so the ratio is noise-robust where
+    # the absolute levels are not (§8)
+    paired = {
+        n: [round(b / a, 4) for a, b in zip(rates(base), rates(n))]
+        for n in names[1:]
+    }
+    return {
+        "metric": f"bench_ab_{flag.replace('-', '_')}",
+        "variants": names,
+        "rounds": rows,
+        "median_structs_per_sec": med,
+        "paired_round_ratios_vs_" + base: paired,
+        "median_ratio_vs_" + base: {
+            n: round(float(np.median(p)), 4) for n, p in paired.items()
+        },
+        "fencing": "value-fetch per round; burn-in discarded; order "
+                   "rotated per round",
+        **extra,
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--ab", choices=AB_FLAGS, default=None,
+                   help="interleaved same-process A/B of one flag's "
+                        "variants (alternating rounds, burn-in "
+                        "discarded, paired per-round deltas — the "
+                        "PERF.md §6b/§8 protocol as one command); "
+                        "prints the A/B JSON line INSTEAD of the bench")
+    p.add_argument("--ab-rounds", type=int, default=4)
+    p.add_argument("--ab-steps", type=int, default=40)
+    p.add_argument("--ab-n", type=int, default=8192)
+    p.add_argument("--ab-batch-size", type=int, default=512)
+    p.add_argument("--ab-buckets", type=int, default=3)
+    args = p.parse_args(argv)
+    if args.ab is not None:
+        out = _run_ab(args.ab, n=args.ab_n, batch_size=args.ab_batch_size,
+                      buckets=args.ab_buckets, rounds=args.ab_rounds,
+                      steps=args.ab_steps)
+        print(json.dumps(jsonfinite(out)))
+        return
+
     from cgnn_tpu.data.dataset import (
         FeaturizeConfig,
         load_synthetic,
@@ -357,6 +619,24 @@ def main() -> None:
     _, infer_e2e_serial = run_fast_inference(istate, mp_graphs, 512,
                                              **serial_kw)
 
+    # quantized serving tiers (ISSUE 9, serve/quantize.py): the SAME
+    # params through the bf16-activation and int8-weight programs, e2e
+    # over the same ladder in the same session (§8's in-session-ratio
+    # rule). The flagship bench model already computes bf16, so the
+    # bf16 tier isolates the activation dtype and the int8 tier adds
+    # the 4x weight-byte cut; on a CPU backend the low-precision tiers
+    # run EMULATED (slower — honest numbers, the HBM/MXU win needs the
+    # accelerator; MAE parity is gated by scripts/quant_parity.py).
+    from cgnn_tpu.serve.quantize import build_tier_specs
+
+    tier_specs = build_tier_specs(emodel, ("bf16", "int8"))
+    infer_tier = {}
+    for tier in ("bf16", "int8"):
+        tstate = tier_specs[tier].state_for(istate)
+        run_fast_inference(tstate, mp_graphs, 512, **infer_kw)  # compile
+        _, rate = run_fast_inference(tstate, mp_graphs, 512, **infer_kw)
+        infer_tier[tier] = rate
+
     ib = list(bucketed_batch_iterator(
         mp_graphs, 512, 3, rng=np.random.default_rng(0), dense_m=12,
         in_cap=0, snug=True, edge_dtype=jax.numpy.bfloat16,
@@ -417,6 +697,16 @@ def main() -> None:
                 # session (the honest before/after; PERF.md §11)
                 "inference_e2e_serial_structs_per_sec": round(
                     infer_e2e_serial, 1),
+                # quantized serving tiers (ISSUE 9): same-session e2e
+                # rates next to the native leg + the paired ratios
+                "inference_e2e_bf16_structs_per_sec": round(
+                    infer_tier["bf16"], 1),
+                "inference_e2e_int8_structs_per_sec": round(
+                    infer_tier["int8"], 1),
+                "inference_bf16_vs_native": round(
+                    infer_tier["bf16"] / max(infer_e2e, 1.0), 3),
+                "inference_int8_vs_native": round(
+                    infer_tier["int8"] / max(infer_e2e, 1.0), 3),
                 "inference_ingest": ("ladder+compact+4workers" if on_accel
                                      else "ladder serial full (cpu "
                                           "backend: compact auto-off)"),

@@ -82,6 +82,16 @@ CHECKS = {
         "reason (known backend gaps — e.g. this container's jax "
         "missing shard_map — are recorded as skips, not findings)."
     ),
+    "GA-ROOFLINE": (
+        "a byte-budgeted program's cost-analysis bytes exceed its "
+        "analytic HBM model: the whole-conv fused kernel "
+        "(ops/pallas_cgconv.py) is built on reading its inputs and "
+        "writing ONLY the [N, F] aggregate — a later change that "
+        "silently rematerializes v_j/z (an [N, M, *] intermediate) in "
+        "HBM reintroduces exactly the staging round-trips the kernel "
+        "exists to remove (PERF.md §6b's failure mode), and this check "
+        "blocks CI on it."
+    ),
 }
 
 # lower-is-better ledger keys gated by diff_ledgers (the budget)
@@ -93,6 +103,11 @@ _ALLOWED_CUSTOM_CALLS = {
     "SPMDFullToShardShape",
     "SPMDShardToFullShape",
     "annotate_device_placement",
+    # Mosaic-compiled Pallas kernels (ops/pallas_cgconv.py and friends)
+    # lower to this target on TPU: a DEVICE kernel, not a host call —
+    # GA-HOSTCALL polices host-callback surfaces, and GA-ROOFLINE is
+    # the check that owns what these kernels do to HBM
+    "tpu_custom_call",
 }
 
 _CUSTOM_CALL_RE = re.compile(r"custom_call\s+@([\w.$]+)")
@@ -147,6 +162,9 @@ class Program:
     skip: str | None = None  # reason this backend cannot lower it
     lowered: Any = None
     text: str = ""
+    # analytic HBM byte budget (0 = ungated): compiled cost-analysis
+    # bytes above budget * GA-ROOFLINE's slack is a finding
+    byte_budget: int = 0
 
 
 def abstract_avals(tree):
@@ -298,12 +316,19 @@ def build_entry_programs(config: AuditConfig | None = None,
 
     # -- train step: DP / edge-sharded (where the backend allows) --
     shard_gap = None
-    if not hasattr(jax, "shard_map"):
-        shard_gap = ("jax.shard_map unavailable in this jax (the known "
-                     "in-container 0.4.37 gap; CI lowers these)")
-    elif len(jax.devices()) < 2:
+    if len(jax.devices()) < 2:
         shard_gap = (f"needs >= 2 devices, have {len(jax.devices())} "
                      f"(CI sets --xla_force_host_platform_device_count)")
+    elif not hasattr(jax, "shard_map"):
+        # the parallel/compat.py shim RUNS these bodies on legacy
+        # experimental shard_map, but legacy lowering drops the
+        # donation aliasing from the module text (jax.buffer_donor
+        # without tf.aliasing_output) — auditing it here would flag a
+        # version artifact, not a repo bug; CI's jax audits the real
+        # thing
+        shard_gap = ("legacy experimental shard_map (pre-jax.shard_map) "
+                     "does not propagate donation aliasing into the "
+                     "lowered module; CI audits these")
     if shard_gap is None:
         from cgnn_tpu.parallel.data_parallel import (
             make_parallel_train_step,
@@ -338,6 +363,66 @@ def build_entry_programs(config: AuditConfig | None = None,
         add_skip("train/dp", shard_gap)
         add_skip("train/edge", shard_gap)
 
+    # -- the whole-conv fused forward (ops/pallas_cgconv.py; ROADMAP
+    # item 2): byte-budgeted against its analytic one-round-trip model
+    # so a silent [N, M, *] rematerialization blocks CI (GA-ROOFLINE).
+    # The structured 'xla' twin lowers on every backend; the Pallas
+    # kernels lower only on TPU (recorded as a skip elsewhere).
+    from cgnn_tpu.ops.pallas_cgconv import (
+        fused_cgconv_eval,
+        fused_conv_hbm_bytes,
+    )
+
+    fdim = cfg.atom_fea_len
+    gdim = graphs[0].edge_fea.shape[1]
+    byte_model = fused_conv_hbm_bytes(ncd, m, gdim, fdim)
+    # eval mode = ONE apply pass: budget is one read set + the write
+    eval_budget = int(byte_model["reads_per_pass"]
+                      + byte_model["write_bytes"])
+
+    def _fused_fwd_fn(impl):
+        def f(nodes, edges, kernel, bias, scale, bn_bias, mean, var,
+              neighbors, emask):
+            return fused_cgconv_eval(
+                nodes, edges, kernel, bias, scale, bn_bias, neighbors,
+                emask, mean, var, impl=impl, window=0,
+            )
+
+        return jax.jit(f)
+
+    c2 = 2 * fdim
+    fused_avals = (
+        jax.ShapeDtypeStruct((ncd, fdim), np.float32),       # nodes
+        jax.ShapeDtypeStruct((ncd, m, gdim), np.float32),    # edges
+        jax.ShapeDtypeStruct((c2 + gdim, c2), np.float32),   # kernel
+        jax.ShapeDtypeStruct((c2,), np.float32),             # bias
+        jax.ShapeDtypeStruct((c2,), np.float32),             # scale
+        jax.ShapeDtypeStruct((c2,), np.float32),             # bn_bias
+        jax.ShapeDtypeStruct((c2,), np.float32),             # mean
+        jax.ShapeDtypeStruct((c2,), np.float32),             # var
+        jax.ShapeDtypeStruct((ncd * m,), np.int32),          # neighbors
+        jax.ShapeDtypeStruct((ncd, m), np.float32),          # edge mask
+    )
+    # the structured twin is NOT absolute-budgeted (its jnp ops carry
+    # logical [N, M, *] intermediates whose cost-analysis bytes XLA may
+    # or may not fuse away, backend-dependent) — its ledger row is
+    # budget-gated RELATIVELY by diff_ledgers (>20% bytes regression
+    # fails CI), which is what catches a rematerialization creeping
+    # into the structured path on the CPU CI leg.
+    programs.append(Program(
+        name="conv/fused_xla_fwd", jitted=_fused_fwd_fn("xla"),
+        args=fused_avals,
+    ))
+    if jax.default_backend() == "tpu":
+        programs.append(Program(
+            name="conv/fused_pallas_fwd", jitted=_fused_fwd_fn("pallas"),
+            args=fused_avals, byte_budget=eval_budget,
+        ))
+    else:
+        add_skip("conv/fused_pallas_fwd",
+                 "Pallas TPU kernels lower only on a tpu backend "
+                 "(config.py backend rule); CI's TPU leg audits it")
+
     # -- predict: every (rung, staging form) in the warm ladder --
     pstep = jax.jit(make_predict_step(ladder.expander()))
     batch_avals = ladder.abstract_batches(graphs[0])
@@ -354,6 +439,14 @@ def build_entry_programs(config: AuditConfig | None = None,
         "ladder": ladder.to_meta(),
         "predict_programs_expected": len(batch_avals),
         "state_leaves": n_leaves,
+        # the fused conv's analytic HBM model (ops/pallas_cgconv.py
+        # fused_conv_hbm_bytes): the GA-ROOFLINE budget for the Pallas
+        # program and the documented target for the structured twin's
+        # relative gate
+        "fused_conv_byte_model": {
+            **byte_model, "eval_budget_bytes": eval_budget,
+            "shape": {"n": ncd, "m": m, "g": gdim, "f": fdim},
+        },
     }
     return programs, meta
 
@@ -588,6 +681,44 @@ def roofline_entry(compiled) -> dict:
     return entry
 
 
+# GA-ROOFLINE slack over the analytic model: cost analysis counts the
+# custom-call surface plus glue ops (index prep, the stats reduction's
+# scalar outputs), and padding rounds block shapes up — 2x headroom
+# stays far below the ~M-fold blowup a rematerialized [N, M, *]
+# intermediate causes (M = 8-12), so the check cannot false-positive on
+# glue yet cannot miss the failure mode it exists for.
+_ROOFLINE_SLACK = 2.0
+
+
+def check_roofline_budget(p: Program, entry: dict) -> list[AuditFinding]:
+    if p.byte_budget <= 0:
+        return []
+    measured = float(entry.get("bytes", 0.0))
+    if measured <= 0:
+        # a missing/zero cost-analysis byte count would make this check
+        # VACUOUSLY green — the one failure mode a guard must not have.
+        # Report it so a backend that stops exposing 'bytes accessed'
+        # re-arms the budget instead of silently disarming it.
+        return [AuditFinding(
+            "GA-ROOFLINE", p.name,
+            f"cost analysis reported {measured} accessed bytes for a "
+            f"byte-budgeted program — the budget cannot be checked on "
+            f"this backend/jax; the roofline gate would be vacuous, "
+            f"which is itself a finding (fix the measurement or drop "
+            f"the budget explicitly).",
+        )]
+    if measured > p.byte_budget * _ROOFLINE_SLACK:
+        return [AuditFinding(
+            "GA-ROOFLINE", p.name,
+            f"cost-analysis bytes {measured:.3e} exceed the analytic "
+            f"one-round-trip model ({p.byte_budget:.3e} x "
+            f"{_ROOFLINE_SLACK} slack) — an [N, M, *] intermediate is "
+            f"round-tripping HBM again (the staging cost the fused "
+            f"conv exists to remove; ops/pallas_cgconv.py).",
+        )]
+    return []
+
+
 def run_audit(config: AuditConfig | None = None, *, compile: bool = True,
               programs: list[Program] | None = None, meta: dict | None = None):
     """Lower + audit the entry-program registry.
@@ -632,7 +763,11 @@ def run_audit(config: AuditConfig | None = None, *, compile: bool = True,
             except Exception:  # noqa: BLE001
                 mem = None
             findings += check_donation_compiled(p, mem)
-            ledger["programs"][p.name] = roofline_entry(compiled)
+            entry = roofline_entry(compiled)
+            if p.byte_budget > 0:
+                entry["byte_budget"] = p.byte_budget
+            findings += check_roofline_budget(p, entry)
+            ledger["programs"][p.name] = entry
     findings.sort(key=lambda f: (f.program, f.check))
     return findings, ledger, programs
 

@@ -36,6 +36,15 @@ class ModelConfig:
     # checkpoints restore across settings — but serialized so predict
     # rebuilds what was trained.
     fused_epilogue: str = ""
+    # WHOLE-conv fused kernel: '' (off) | 'xla' | 'pallas'
+    # (ops/pallas_cgconv.py — gather+fc_full+BN1+gate+sum as one op).
+    # Same parameter tree as the unfused path (checkpoints restore
+    # across settings); cgconv_window is the caller-guaranteed neighbor
+    # window bound (0 = whole node range, always correct), derived from
+    # the dataset via pallas_cgconv.window_width — serialized together
+    # so predict rebuilds what was trained.
+    cgconv_impl: str = ""
+    cgconv_window: int = 0
 
     def to_meta(self) -> dict:
         return dataclasses.asdict(self) | {
@@ -50,9 +59,25 @@ class ModelConfig:
         kw["multi_task_head"] = bool(kw.get("multi_task_head", 0))
         kw["dense_m"] = int(kw.get("dense_m", 0))
         kw["fused_epilogue"] = str(kw.get("fused_epilogue", "") or "")
+        kw["cgconv_impl"] = str(kw.get("cgconv_impl", "") or "")
+        kw["cgconv_window"] = int(kw.get("cgconv_window", 0))
         if kw.get("aggregation") in ("__none__", None):
             kw["aggregation"] = None
         return cls(**kw)
+
+    def for_arbitrary_inputs(self) -> "ModelConfig":
+        """This config with data-derived bounds widened to always-correct
+        settings — the ONE place the invariant lives for inference entry
+        points (predict.py, serve load_server, any future export path).
+
+        The serialized ``cgconv_window`` covers the TRAINING set only;
+        arbitrary inference inputs can exceed it, and an undersized
+        bound silently zeroes out-of-window neighbors in the fused
+        conv's in-kernel gather (ops/pallas_cgconv.py contract).
+        ``cgconv_window=0`` = full-range gather, always correct."""
+        if not self.cgconv_impl or self.cgconv_window == 0:
+            return self
+        return dataclasses.replace(self, cgconv_window=0)
 
     def build(self, head=None, edge_axis_name: str | None = None):
         """``edge_axis_name`` activates edge-sharded graph parallelism
@@ -79,6 +104,9 @@ class ModelConfig:
             # identical, so a TPU-trained checkpoint stays loadable for
             # CPU prediction/fine-tuning
             fused = "xla"
+        cgconv = self.cgconv_impl or None
+        if cgconv == "pallas" and jax.default_backend() != "tpu":
+            cgconv = "xla"  # same backend rule as fused_epilogue
         return CrystalGraphConvNet(
             atom_fea_len=self.atom_fea_len,
             n_conv=self.n_conv,
@@ -94,6 +122,8 @@ class ModelConfig:
             edge_axis_name=edge_axis_name,
             dense_m=self.dense_m or None,
             fused_epilogue=fused,
+            cgconv_impl=cgconv,
+            cgconv_window=self.cgconv_window,
         )
 
 

@@ -107,6 +107,20 @@ class CGConv(nn.Module):
     # the custom-VJP boundary blocks producer/consumer fusion; PERF.md
     # 6b); default stays None.
     fused_epilogue: str | None = None
+    # WHOLE-conv fused kernel (ops/pallas_cgconv.py, ROADMAP item 2):
+    # the entire dense branch — gather, fc_full, BN1, gate, mask,
+    # sum-over-M — as one custom-VJP op whose 'pallas' impl runs per
+    # 128-node block entirely in VMEM (v_j and z never exist in HBM;
+    # backward rematerializes). 'xla' is the structured jnp twin (the
+    # §6b methodology: isolates structure from hand scheduling). Dense
+    # layout + BatchNorm, no graph sharding, mutually exclusive with
+    # fused_epilogue. cgconv_window=0 gathers over the whole node range
+    # (always correct; tests); a positive value is the CALLER-guaranteed
+    # neighbor-window bound from pallas_cgconv.window_width(max graph
+    # nodes) — an undersized bound silently zeroes out-of-window
+    # neighbors, so only pass one derived from the real dataset.
+    cgconv_impl: str | None = None
+    cgconv_window: int = 0
 
     @nn.compact
     def __call__(
@@ -136,6 +150,18 @@ class CGConv(nn.Module):
                 "(it fuses the BN1->gate->mask->sum chain) and no graph "
                 "sharding"
             )
+        if self.cgconv_impl is not None:
+            if (self.dense_m is None or not self.use_batchnorm
+                    or self.edge_axis_name is not None):
+                raise NotImplementedError(
+                    "cgconv_impl (the whole-conv fused kernel) requires "
+                    "the dense layout with BatchNorm and no graph sharding"
+                )
+            if self.fused_epilogue is not None:
+                raise NotImplementedError(
+                    "cgconv_impl subsumes fused_epilogue (the whole conv "
+                    "is one op); pick one"
+                )
         if self.dense_m is not None and self.edge_axis_name is not None:
             # Node-strip sharded dense layout (graph parallelism composed
             # with the fast path; parallel/edge_parallel.py). Shard s owns
@@ -160,8 +186,12 @@ class CGConv(nn.Module):
             # linear_call (gather_transpose) does not insert the implicit
             # replicated->varying cast standard ops get, so cast explicitly:
             # the cast's transpose is the psum that completes each shard's
-            # partial [N, F] node cotangent
-            nodes_v = jax.lax.pcast(nodes, axis, to="varying")
+            # partial [N, F] node cotangent (compat: identity on jax
+            # without pcast, where check_rep is off and the psum comes
+            # from the P() in-spec transpose — parallel/compat.py)
+            from cgnn_tpu.parallel.compat import pcast
+
+            nodes_v = pcast(nodes, axis, to="varying")
             if in_slots is not None:
                 # per-shard two-tier mappings arrive with a leading
                 # singleton from the shard-stack axis (graph.py
@@ -208,6 +238,47 @@ class CGConv(nn.Module):
                 ),
                 axis,
             )
+        elif self.dense_m is not None and self.cgconv_impl is not None:
+            # WHOLE-conv fused kernel (ops/pallas_cgconv.py): gather +
+            # fc_full + BN1 + gate + mask + sum as ONE custom-VJP op —
+            # v_j and z never exist in HBM ('pallas') or as named
+            # intermediates ('xla' structured twin). Parameter tree
+            # identical to the unfused branch (fc_full + bn1 shells);
+            # BN2 + the residual below are unchanged.
+            from cgnn_tpu.ops.pallas_cgconv import (
+                BN1Params,
+                FcFullParams,
+                fused_cgconv,
+                fused_cgconv_eval,
+            )
+
+            m = self.dense_m
+            n = nodes.shape[0]
+            e = edges
+            if e.ndim == 2:
+                e = e.reshape(n, m, -1)
+            emask2 = edge_mask.reshape(n, m)
+            tr = (None if in_slots is None else
+                  (in_slots, in_mask, over_slots, over_nodes, over_mask))
+            kernel, kbias = FcFullParams(2 * f, name="fc_full")(
+                2 * f + e.shape[-1]
+            )
+            bn1 = BN1Params(name="bn1")
+            scale, bn_bias, r_mean, r_var = bn1(2 * f)
+            if train:
+                agg, mean, var, n_real = fused_cgconv(
+                    nodes, e, kernel, kbias, scale, bn_bias, neighbors,
+                    emask2, tr, impl=self.cgconv_impl,
+                    window=self.cgconv_window, dtype=self.dtype,
+                )
+                bn1(2 * f, update=(mean, var, n_real))
+            else:
+                agg = fused_cgconv_eval(
+                    nodes, e, kernel, kbias, scale, bn_bias, neighbors,
+                    emask2, r_mean, r_var, tr, impl=self.cgconv_impl,
+                    window=self.cgconv_window, dtype=self.dtype,
+                )
+            agg = agg.astype(nodes.dtype)
         elif self.dense_m is not None:
             m = self.dense_m
             n = nodes.shape[0]
@@ -322,6 +393,8 @@ class CrystalGraphConvNet(nn.Module):
     edge_axis_name: str | None = None  # edge-sharded graph parallelism
     dense_m: int | None = None  # dense slot layout (see CGConv.dense_m)
     fused_epilogue: str | None = None  # see CGConv.fused_epilogue
+    cgconv_impl: str | None = None  # whole-conv fused kernel (CGConv)
+    cgconv_window: int = 0  # neighbor-window bound (CGConv.cgconv_window)
 
     @nn.compact
     def __call__(
@@ -340,6 +413,8 @@ class CrystalGraphConvNet(nn.Module):
                 edge_axis_name=self.edge_axis_name,
                 dense_m=self.dense_m,
                 fused_epilogue=self.fused_epilogue,
+                cgconv_impl=self.cgconv_impl,
+                cgconv_window=self.cgconv_window,
                 name=f"conv_{i}",
             )(
                 nodes,

@@ -31,6 +31,114 @@ _VALID_IMPLS = ("xla", "pallas", "sort")
 # math but rejects second-order AD.
 _TRANSPOSE_IMPL = "linear_call"
 
+# jax 0.4.37 (this container) ships linear_call WITHOUT a
+# differentiation rule ("Differentiation rule for 'linear_call' not
+# implemented") — the second half of the 43 pre-existing seed failures
+# (the first was shard_map resolution, parallel/compat.py). Probed once,
+# lazily; when the rule is missing, gather_transpose binds an equivalent
+# custom primitive with the SAME transpose body registered directly
+# (impl/abstract/jvp/transpose/lowering) — which, like linear_call,
+# composes with repeated differentiation (grad-over-grad pins this in
+# tests). CI's newer jax never takes this path.
+_LINEAR_CALL_GRAD: bool | None = None
+
+
+def _linear_call_differentiable() -> bool:
+    global _LINEAR_CALL_GRAD
+    if _LINEAR_CALL_GRAD is None:
+        import numpy as np
+
+        idx = jnp.asarray(np.zeros(1, np.int32))
+        try:
+            jax.grad(lambda n: jax.custom_derivatives.linear_call(
+                lambda r, x: jnp.take(x, r[0], axis=0),
+                lambda r, ct: jax.ops.segment_sum(ct, r[0], num_segments=1),
+                (idx,), n).sum())(jnp.zeros((1, 1), jnp.float32))
+            _LINEAR_CALL_GRAD = True
+        except NotImplementedError:
+            _LINEAR_CALL_GRAD = False
+    return _LINEAR_CALL_GRAD
+
+
+def _transpose_cotangent(ct, slots, msk, o_slots, o_nodes, o_mask,
+                         num_nodes: int):
+    """The shared cotangent transpose ([E, F] -> [N, F]) — ONE body for
+    every AD mechanism (linear_call / custom_vjp / the compat primitive)
+    so an A/B isolates the mechanism, never the math.
+
+    in_slots arrives pre-flattened (pack_graphs): a device-side
+    [N, In] -> [N*In] flatten is a tiled->linear relayout that measured
+    0.75 ms/step under the epoch scan. Accumulation stays in the
+    cotangent dtype: matches the scatter-add's accumulation precision,
+    and an f32 upcast doubles the [N, In, F] intermediate's bytes for no
+    measured accuracy gain (full-step bf16: 16.0 ms vs f32-acc 17.5 ms
+    vs scatter 18.8 ms).
+    """
+    contrib = jnp.take(ct, slots, axis=0).reshape(*msk.shape, ct.shape[-1])
+    grad = (contrib * msk[..., None].astype(ct.dtype)).sum(axis=1)
+    if o_slots is not None:
+        rows = jnp.take(ct, o_slots, axis=0)
+        rows = rows * o_mask[:, None].astype(ct.dtype)
+        grad = grad + jax.ops.segment_sum(
+            rows, o_nodes, num_segments=num_nodes, indices_are_sorted=True,
+        )
+    return grad
+
+
+_GATHER_TR_P = None
+
+
+def _gather_transpose_primitive():
+    """Build (once) the compat primitive for jax without the linear_call
+    differentiation rule. Operands: (nodes, neighbors, in_slots, in_mask
+    [, over_slots, over_nodes, over_mask]) with static ``has_over``;
+    only ``nodes`` is linear."""
+    global _GATHER_TR_P
+    if _GATHER_TR_P is not None:
+        return _GATHER_TR_P
+    from jax import core
+    from jax.interpreters import ad, mlir
+
+    p = core.Primitive("cgnn_gather_transpose")
+
+    def _impl(nodes, neighbors, *rest, has_over):
+        return jnp.take(nodes, neighbors, axis=0)
+
+    p.def_impl(_impl)
+
+    def _abstract(nodes, neighbors, *rest, has_over):
+        return core.ShapedArray(
+            (neighbors.shape[0],) + tuple(nodes.shape[1:]), nodes.dtype
+        )
+
+    p.def_abstract_eval(_abstract)
+    mlir.register_lowering(p, mlir.lower_fun(_impl, multiple_results=False))
+
+    def _jvp(primals, tangents, *, has_over):
+        out = p.bind(*primals, has_over=has_over)
+        dn = tangents[0]
+        if type(dn) is ad.Zero:
+            return out, ad.Zero.from_value(out)
+        return out, p.bind(dn, *primals[1:], has_over=has_over)
+
+    ad.primitive_jvps[p] = _jvp
+
+    def _transpose(ct, nodes, neighbors, in_slots, in_mask, *over,
+                   has_over):
+        assert ad.is_undefined_primal(nodes), (
+            "gather_transpose is linear in nodes only"
+        )
+        o_slots, o_nodes, o_mask = over if has_over else (None, None, None)
+        grad = _transpose_cotangent(
+            ct, in_slots, in_mask, o_slots, o_nodes, o_mask,
+            nodes.aval.shape[0],
+        )
+        return (grad,) + (None,) * (3 + len(over))
+
+    ad.primitive_transposes[p] = _transpose
+    _GATHER_TR_P = p
+    return p
+
 
 def set_transpose_impl(impl: str) -> None:
     global _TRANSPOSE_IMPL
@@ -97,32 +205,6 @@ def gather_transpose(
     """
     num_nodes = nodes.shape[0]
 
-    def _transpose_ct(ct, slots, msk, o_slots, o_nodes, o_mask):
-        """The shared cotangent transpose ([E, F] -> [N, F]) — ONE body
-        for both AD mechanisms so the A/B harness isolates the mechanism,
-        never the math.
-
-        in_slots arrives pre-flattened (pack_graphs): a device-side
-        [N, In] -> [N*In] flatten is a tiled->linear relayout that
-        measured 0.75 ms/step under the epoch scan. Accumulation stays in
-        the cotangent dtype: matches the scatter-add's accumulation
-        precision, and an f32 upcast doubles the [N, In, F]
-        intermediate's bytes for no measured accuracy gain (full-step
-        bf16: 16.0 ms vs f32-acc 17.5 ms vs scatter 18.8 ms).
-        """
-        contrib = jnp.take(ct, slots, axis=0).reshape(
-            *msk.shape, ct.shape[-1]
-        )
-        grad = (contrib * msk[..., None].astype(ct.dtype)).sum(axis=1)
-        if o_slots is not None:
-            rows = jnp.take(ct, o_slots, axis=0)
-            rows = rows * o_mask[:, None].astype(ct.dtype)
-            grad = grad + jax.ops.segment_sum(
-                rows, o_nodes, num_segments=num_nodes,
-                indices_are_sorted=True,
-            )
-        return grad
-
     if _TRANSPOSE_IMPL == "custom_vjp":  # round-3 mechanism (A/B only)
 
         @jax.custom_vjp
@@ -133,11 +215,20 @@ def gather_transpose(
             return g(n), None
 
         def g_bwd(_, ct):
-            return (_transpose_ct(ct, in_slots, in_mask, over_slots,
-                                  over_nodes, over_mask),)
+            return (_transpose_cotangent(ct, in_slots, in_mask, over_slots,
+                                         over_nodes, over_mask, num_nodes),)
 
         g.defvjp(g_fwd, g_bwd)
         return g(nodes)
+
+    if not _linear_call_differentiable():
+        # jax without the linear_call diff rule (in-container 0.4.37):
+        # same math, bound through the compat primitive above
+        p = _gather_transpose_primitive()
+        if over_slots is not None:
+            return p.bind(nodes, neighbors, in_slots, in_mask,
+                          over_slots, over_nodes, over_mask, has_over=True)
+        return p.bind(nodes, neighbors, in_slots, in_mask, has_over=False)
 
     def fwd(res, n):
         nbrs = res[0]
@@ -145,7 +236,8 @@ def gather_transpose(
 
     def trans(res, ct):  # ct: [E, F] -> [N, F]
         _, slots, msk, o_slots, o_nodes, o_mask = res
-        return _transpose_ct(ct, slots, msk, o_slots, o_nodes, o_mask)
+        return _transpose_cotangent(ct, slots, msk, o_slots, o_nodes,
+                                    o_mask, num_nodes)
 
     res = (neighbors, in_slots, in_mask, over_slots, over_nodes, over_mask)
     return jax.custom_derivatives.linear_call(fwd, trans, res, nodes)

@@ -20,6 +20,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cgnn_tpu.parallel import compat
 from cgnn_tpu.data.graph import (
     CrystalGraph,
     GraphBatch,
@@ -238,7 +239,7 @@ def make_parallel_train_step(
     def body(state: TrainState, stacked: GraphBatch):
         return inner(state, _squeeze0(stacked))
 
-    smapped = jax.shard_map(
+    smapped = compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(axes)),
@@ -288,7 +289,7 @@ def make_parallel_eval_step(
     def body(state: TrainState, stacked: GraphBatch):
         return inner(state, _squeeze0(stacked))
 
-    smapped = jax.shard_map(
+    smapped = compat.shard_map(
         body, mesh=mesh, in_specs=(P(), P(axes)), out_specs=P(),
         check_vma=False,
     )

@@ -33,6 +33,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cgnn_tpu.parallel import compat
 from cgnn_tpu.data.graph import GraphBatch
 from cgnn_tpu.train.state import TrainState
 from cgnn_tpu.train.step import (
@@ -279,7 +280,7 @@ def make_edge_parallel_train_step(
         make_train_step(classification, grad_health=grad_health), guard
     )
 
-    smapped = jax.shard_map(
+    smapped = compat.shard_map(
         inner,
         mesh=mesh,
         in_specs=(P(), _specs(graph_axis, dense=dense)),
@@ -295,7 +296,7 @@ def make_edge_parallel_eval_step(
     dense: bool = False,
 ) -> Callable:
     inner = make_eval_step(classification)
-    smapped = jax.shard_map(
+    smapped = compat.shard_map(
         inner,
         mesh=mesh,
         in_specs=(P(), _specs(graph_axis, dense=dense, with_transpose=False)),
@@ -346,7 +347,7 @@ def make_dp_edge_parallel_train_step(
     def body(state: TrainState, stacked: GraphBatch):
         return inner(state, _squeeze0(stacked))
 
-    smapped = jax.shard_map(
+    smapped = compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), _specs(graph_axis, data_axis, dense=dense)),
@@ -372,7 +373,7 @@ def make_dp_edge_parallel_eval_step(
     def body(state: TrainState, stacked: GraphBatch):
         return inner(state, _squeeze0(stacked))
 
-    smapped = jax.shard_map(
+    smapped = compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), _specs(graph_axis, data_axis, dense=dense,

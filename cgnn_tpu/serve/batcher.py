@@ -111,6 +111,11 @@ class Request:
     # replied — the live-observability request journey
     trace_id: str = ""
     stamps: dict = dataclasses.field(default_factory=dict)
+    # precision tier (serve/quantize.py TIERS), validated at admission
+    # against the server's warmed set: a flush runs ONE program, so
+    # co-batched requests must share a tier — the batcher cuts a flush
+    # at every tier boundary in the FIFO (see _take_locked)
+    precision: str = "f32"
 
 
 @dataclasses.dataclass
@@ -121,7 +126,7 @@ class Flush:
     requests: list
     shape: BatchShape | None
     expired: list
-    reason: str = ""  # 'shape_full' | 'deadline' | 'drain' | ''
+    reason: str = ""  # 'shape_full' | 'tier_boundary' | 'deadline' | 'drain' | ''
     # batch identity: co-batched requests carry DISTINCT trace ids but
     # share this flush id — the join key between a request's trace and
     # the flush-level pack/dispatch/fetch spans
@@ -129,6 +134,9 @@ class Flush:
     # per-flush stage stamps (packed/dispatched/fetched), merged into
     # every member request's journey at reply time
     stamps: dict = dataclasses.field(default_factory=dict)
+    # the tier every member shares (dispatch picks this tier's program
+    # + param variant; serve/quantize.py)
+    precision: str = "f32"
 
     def __bool__(self) -> bool:
         return bool(self.requests or self.expired)
@@ -191,18 +199,31 @@ class MicroBatcher:
     # ---- flush policy ----
 
     def _take_locked(self, now: float) -> tuple[list, list, bool]:
-        """(batchable FIFO prefix, expired, hit-shape-full). The _locked
+        """(batchable FIFO prefix, expired, hit-boundary). The _locked
         suffix is the graftcheck GC-LOCKSHARE contract: callers hold
-        self._cond."""
+        self._cond.
+
+        A precision-tier change in the FIFO is a batch boundary exactly
+        like shape-full: the head tier's prefix fires NOW (one program
+        per flush), the next tier starts the next batch — strict FIFO is
+        preserved (no reordering around the boundary) and a mixed queue
+        degrades to smaller flushes, never to head-of-line blocking."""
         big = self.shape_set.largest
         take: list[Request] = []
         expired: list[Request] = []
         n_nodes = n_edges = 0
         full = False
+        boundary = False
+        tier: str | None = None
         for req in self._queue:
             if req.deadline is not None and now >= req.deadline:
                 expired.append(req)
                 continue
+            if tier is None:
+                tier = req.precision
+            elif req.precision != tier:
+                boundary = True  # tier cut: fire the head prefix now
+                break
             if not big.fits(len(take) + 1, n_nodes + req.nodes,
                             n_edges + req.edges):
                 full = True
@@ -212,7 +233,8 @@ class MicroBatcher:
             n_edges += req.edges
         # graph slots saturated = full even with nothing else queued (a
         # later arrival could never join this batch anyway)
-        return take, expired, full or len(take) >= big.graph_cap
+        return (take, expired, full or len(take) >= big.graph_cap,
+                boundary)
 
     def poll(self, now: float | None = None) -> Flush | None:
         """Non-blocking flush decision at time ``now``.
@@ -223,12 +245,16 @@ class MicroBatcher:
         core of the batcher."""
         now = self._clock() if now is None else now
         with self._cond:
-            take, expired, full = self._take_locked(now)
+            take, expired, full, boundary = self._take_locked(now)
             waited = (
                 take and now - min(r.enqueued for r in take) >= self.max_wait
             )
-            if full or waited or (self._closed and take):
+            if full or boundary or waited or (self._closed and take):
+                # tier_boundary gets its own reason: conflating it with
+                # shape_full would inflate the ladder-tuning signal with
+                # tier-fragmentation flushes (they can be nearly empty)
                 reason = ("shape_full" if full
+                          else "tier_boundary" if boundary
                           else "deadline" if waited else "drain")
                 fired = take
             elif expired:
@@ -248,7 +274,9 @@ class MicroBatcher:
                 )
             self._flush_seq += 1
             return Flush(fired, shape, expired, reason,
-                         flush_id=f"flush-{self._flush_seq:06d}")
+                         flush_id=f"flush-{self._flush_seq:06d}",
+                         precision=(fired[0].precision if fired
+                                    else "f32"))
 
     def next_flush(self) -> Flush | None:
         """Block until the policy fires (worker-thread API).

@@ -8,6 +8,14 @@ the FEATURIZED arrays (atom features, edge features, connectivity), not
 object identity, so equal structures hit regardless of which client
 sent them.
 
+Precision tiers (serve/quantize.py) are part of the key, not the value:
+the server prefixes non-f32 fingerprints with the tier
+(``"int8:<sha>"``), because a cached row is determined by (params,
+structure, PROGRAM) — an f32 answer served to an int8 request would
+silently undo the precision the client asked for (and vice versa), and
+the tier-isolation test pins exactly that (tests/test_serve.py
+TestPrecisionServing).
+
 Staleness across hot param swaps is handled in TWO layers, both load-
 bearing (server.py): entries are stored version-tagged, ``(row,
 param_version)``, and REVALIDATED against the live version at hit time

@@ -15,9 +15,11 @@ first-class part of the dispatch layer:
   regardless of N (the jit trace cache keys on abstract values, not
   devices); XLA then builds one executable per device at WARMUP, because
   a compiled artifact is bound to its device assignment. After warmup
-  nothing ever compiles — the same pin as ISSUE 3, now × N devices: the
-  jit cache size is ``programs * len(devices)`` and must not grow under
-  load (checked per flush by the server, by the loadgen, and by tests).
+  nothing ever compiles — the same pin as ISSUE 3, now × N devices (and,
+  with precision tiers, × tiers — serve/quantize.py: a tier is its own
+  traced program, warmed on every device like any other): the jit cache
+  size is ``programs * len(devices)`` and must not grow under load
+  (checked per flush by the server, by the loadgen, and by tests).
 
 - **Replicated params** live in :class:`serve.reload.ParamStore` (one
   replica per device, swapped atomically under a single version — see

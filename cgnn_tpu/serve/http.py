@@ -215,8 +215,10 @@ def make_handler(server: InferenceServer,
             trace_id = (self.headers.get("X-Request-Id")
                         or payload.get("trace_id"))
             try:
-                result = server.predict(graph, timeout_ms=timeout_ms,
-                                        trace_id=trace_id)
+                result = server.predict(
+                    graph, timeout_ms=timeout_ms, trace_id=trace_id,
+                    precision=payload.get("precision"),
+                )
             except ServeRejection as e:
                 self._reply(_REJECT_STATUS.get(e.reason, 500), {
                     "error": str(e), "reason": e.reason,
@@ -233,6 +235,7 @@ def make_handler(server: InferenceServer,
                 "cached": result.cached,
                 "batch_occupancy": result.batch_occupancy,
                 "device_id": result.device_id,
+                "precision": result.precision,
                 "trace_id": result.trace_id,
                 "flush_id": result.flush_id,
                 "stamps": result.stamps,

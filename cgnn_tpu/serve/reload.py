@@ -45,11 +45,27 @@ class ParamStore:
     and finish on it — the ISSUE-3 per-batch atomicity, now per-device.
     """
 
-    def __init__(self, state, version: str = "init", devices=None):
+    def __init__(self, state, version: str = "init", devices=None,
+                 tier_specs=None):
+        # precision tiers (serve/quantize.py): {tier: TierSpec} built
+        # ONCE by the server. Each swap re-derives every tier's state
+        # through the SAME spec (stable apply_fn identity), so a hot
+        # reload can never retrace a warmed program. None = f32 only.
         self._lock = racecheck.make_lock("serve.paramstore")
         self._devices = tuple(devices) if devices else None
-        self._states = self._replicate(state)
+        self._specs = dict(tier_specs) if tier_specs else None
+        self._states = self._build(state)
         self._version = version
+
+    def _build(self, state) -> dict:
+        """{tier: (replica per device, ...)} — the native state IS the
+        f32 tier; derived tiers transform it before replication."""
+        tiers = {"f32": state}
+        if self._specs is not None:
+            for name, spec in self._specs.items():
+                if name != "f32":
+                    tiers[name] = spec.state_for(state)
+        return {t: self._replicate(s) for t, s in tiers.items()}
 
     def _replicate(self, state) -> tuple:
         if self._devices is None:
@@ -58,10 +74,15 @@ class ParamStore:
 
         return replicate_state(state, self._devices)
 
-    def get(self, device_index: int = 0):
-        """-> (state replica for ``device_index``, version) — consistent."""
+    @property
+    def tiers(self) -> tuple:
         with self._lock:
-            return self._states[device_index], self._version
+            return tuple(self._states)
+
+    def get(self, device_index: int = 0, tier: str = "f32"):
+        """-> (state replica for ``device_index``/``tier``, version)."""
+        with self._lock:
+            return self._states[tier][device_index], self._version
 
     @property
     def version(self) -> str:
@@ -69,9 +90,9 @@ class ParamStore:
             return self._version
 
     def swap(self, state, version: str) -> None:
-        # replicate OUTSIDE the lock: N device transfers must not stall
-        # every dispatch worker's get() for their duration
-        states = self._replicate(state)
+        # derive tiers + replicate OUTSIDE the lock: quantization and N
+        # device transfers must not stall every dispatch worker's get()
+        states = self._build(state)
         with self._lock:
             self._states = states
             self._version = version

@@ -97,6 +97,9 @@ class ServeResult:
     param_version: str
     latency_ms: float
     cached: bool = False
+    # precision tier that computed it (serve/quantize.py; 'f32' =
+    # checkpoint-native program)
+    precision: str = "f32"
     batch_occupancy: float = 0.0  # real graphs / graph slots of its batch
     # which device of the set answered (ISSUE 5); -1 for cache hits — no
     # device computed them, and attributing them to device 0 would skew
@@ -138,6 +141,8 @@ class InferenceServer:
         cache_size: int = 1024,
         pack_workers: int = 1,
         devices=None,
+        precisions: Sequence[str] = ("f32",),
+        model=None,
         clock: Callable[[], float] = time.monotonic,
         log_fn: Callable = print,
     ):
@@ -152,8 +157,27 @@ class InferenceServer:
         # the set; None = the backend-aware 'auto' resolution (all
         # accelerator devices; single device on CPU backends)
         self.device_set = DeviceSet(devices)
+        # precision tiers (serve/quantize.py): the warmed set a request
+        # picks from. 'f32' (the native program) is always present —
+        # it is the default tier and the parity baseline. Tier states
+        # are derived ONCE here (stable apply_fn identities) and
+        # re-derived through the same specs on every hot swap.
+        tiers = tuple(dict.fromkeys(("f32", *precisions)))
+        tier_specs = None
+        if tiers != ("f32",):
+            from cgnn_tpu.serve.quantize import build_tier_specs
+
+            if model is None:
+                raise ValueError(
+                    "precision tiers beyond 'f32' need the model module "
+                    "(InferenceServer(model=...)) to derive bf16/int8 "
+                    "programs"
+                )
+            tier_specs = build_tier_specs(model, tiers)
+        self.precisions = tiers
         self.param_store = ParamStore(state, version,
-                                      devices=self.device_set.devices)
+                                      devices=self.device_set.devices,
+                                      tier_specs=tier_specs)
         # a compact shape set rebuilds GraphBatches INSIDE the compiled
         # program (expander); the same jitted callable still accepts
         # full-fidelity batches — the fallback for non-compactable
@@ -238,13 +262,14 @@ class InferenceServer:
         dimensionality); each rung is packed with one copy and executed
         once per device. A compact set warms BOTH staging forms per rung
         — the compact fast path and the full-fidelity fallback a flush
-        holding a non-compactable request takes — so the post-warmup
-        compile count is pinned no matter how traffic mixes OR which
-        device a flush lands on: ``len(shape_set) * forms`` traced
-        programs, each built into one executable per device here and
-        NEVER again (devices.py module docstring). Dispatches run under
-        ``telemetry.warmup()`` so compile executions never pollute
-        serving counters."""
+        holding a non-compactable request takes — and every PRECISION
+        TIER warms per (rung, form): the post-warmup compile count is
+        pinned no matter how traffic mixes, which tier a request picks,
+        OR which device a flush lands on: ``len(shape_set) * forms *
+        len(precisions)`` traced programs, each built into one
+        executable per device here and NEVER again (devices.py module
+        docstring). Dispatches run under ``telemetry.warmup()`` so
+        compile executions never pollute serving counters."""
         self._feature_dims = (template.atom_fea.shape[1],
                               template.edge_fea.shape[1])
         n0 = self._jit_cache_size()
@@ -256,17 +281,19 @@ class InferenceServer:
                 batch = self.shape_set.pack([template], shape=shape)
                 full = (self.shape_set.pack_full([template], shape=shape)
                         if self.shape_set.compact is not None else None)
-                for i in range(len(self.device_set)):
-                    state, _ = self.param_store.get(i)
-                    np.asarray(self.predict_step(state, batch))
-                    if full is not None:
-                        np.asarray(self.predict_step(state, full))
-                programs += 1 if full is None else 2
+                for tier in self.precisions:
+                    for i in range(len(self.device_set)):
+                        state, _ = self.param_store.get(i, tier)
+                        np.asarray(self.predict_step(state, batch))
+                        if full is not None:
+                            np.asarray(self.predict_step(state, full))
+                    programs += 1 if full is None else 2
         self.warmed = True
         compiled = (self._jit_cache_size() or 0) - (n0 or 0)
         self._log(
             f"serve: warmed {len(self.shape_set)} shapes / {programs} "
-            f"programs on {len(self.device_set)} device(s) "
+            f"programs on {len(self.device_set)} device(s) / "
+            f"{len(self.precisions)} precision tier(s) "
             f"({compiled} fresh compiles"
             f"{', compact-staged' if self.shape_set.compact else ''})"
         )
@@ -487,21 +514,37 @@ class InferenceServer:
 
     def submit(self, graph: CrystalGraph,
                timeout_ms: float | None = None,
-               trace_id: str | None = None) -> RequestFuture:
+               trace_id: str | None = None,
+               precision: str | None = None) -> RequestFuture:
         """Admit one structure; returns its future (raises ServeRejection
         on malformed / queue-full / oversize / draining). ``trace_id``
         carries an inbound X-Request-Id; absent, one is minted here —
-        admission is where a request's journey starts."""
+        admission is where a request's journey starts. ``precision``
+        picks the serving tier (None = 'f32'); a tier the server did
+        not warm is rejected AT ADMISSION — flushing it would trace a
+        fresh program (a recompile after warmup)."""
         now = self._clock()
         queued = self._stamp()
         tid = self._mint_trace(trace_id)
+        tier = precision or "f32"
         self._count("requests")
         try:
+            if tier not in self.precisions:
+                raise ServeRejection(
+                    MALFORMED,
+                    f"precision {tier!r} not in this server's warmed "
+                    f"tiers {list(self.precisions)}",
+                )
             self._check_wellformed(graph)
         except ServeRejection as e:
             self._count(f"reject_{e.reason}")
             raise
         fp = structure_fingerprint(graph) if self.cache is not None else None
+        if fp is not None and tier != "f32":
+            # cached rows are (params, structure, TIER)-determined:
+            # tier-qualify the key so an f32 answer can never serve an
+            # int8 request (or vice versa). f32 keeps the bare legacy key.
+            fp = f"{tier}:{fp}"
         if fp is not None:
             hit = self.cache.get(fp)
             if hit is not None:
@@ -519,7 +562,7 @@ class InferenceServer:
                     fut.set_result(ServeResult(
                         prediction=row, param_version=version,
                         latency_ms=latency_ms, cached=True,
-                        device_id=-1, trace_id=tid,
+                        device_id=-1, trace_id=tid, precision=tier,
                         stamps={"queued": queued, "replied": replied},
                     ))
                     # cache hits ARE served responses: they must feed the
@@ -546,6 +589,7 @@ class InferenceServer:
             compactable=self.shape_set.compactable(graph),
             trace_id=tid,
             stamps={"queued": queued},
+            precision=tier,
         )
         try:
             self.batcher.offer(req)
@@ -556,9 +600,11 @@ class InferenceServer:
 
     def predict(self, graph: CrystalGraph,
                 timeout_ms: float | None = None,
-                trace_id: str | None = None) -> ServeResult:
+                trace_id: str | None = None,
+                precision: str | None = None) -> ServeResult:
         """Blocking convenience: submit + wait."""
-        fut = self.submit(graph, timeout_ms=timeout_ms, trace_id=trace_id)
+        fut = self.submit(graph, timeout_ms=timeout_ms, trace_id=trace_id,
+                          precision=precision)
         # wait slightly past the serving deadline: expiry is delivered by
         # the worker, not by this caller racing it
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
@@ -809,10 +855,12 @@ class InferenceServer:
 
         reqs = flush.requests
         # the hot-swap boundary: one consistent (params, version) REPLICA
-        # pair per batch, read from the dispatch device's slot — a reload
-        # landing after this line affects the NEXT batch; this one keeps
-        # its dispatch-time replica alive by reference and finishes on it
-        state, version = self.param_store.get(device)
+        # pair per batch, read from the dispatch device's slot FOR THE
+        # FLUSH'S PRECISION TIER — a reload landing after this line
+        # affects the NEXT batch; this one keeps its dispatch-time
+        # replica alive by reference and finishes on it
+        tier = flush.precision
+        state, version = self.param_store.get(device, tier)
         pre = self._jit_cache_size()
         dispatched = self._stamp()
         flush.stamps["dispatched"] = dispatched
@@ -855,7 +903,7 @@ class InferenceServer:
             r.future.set_result(ServeResult(
                 prediction=row, param_version=version,
                 latency_ms=latency_ms, batch_occupancy=occupancy,
-                device_id=device, trace_id=r.trace_id,
+                device_id=device, trace_id=r.trace_id, precision=tier,
                 flush_id=flush.flush_id, stamps=stamps,
             ))
             # the whole journey, one span per request: admission ->
@@ -875,6 +923,8 @@ class InferenceServer:
             # describe the same distribution stats() does (PERF.md §10)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
             self._count("responses")
+            if tier != "f32":
+                self._count(f"responses_{tier}")
         self._count("batches")
         with self._lock:
             self._occupancies.append(occupancy)
@@ -934,6 +984,7 @@ class InferenceServer:
             },
             "batch_occupancy_mean": float(np.mean(occ)) if occ else 0.0,
             "shapes": [s.to_meta() for s in self.shape_set],
+            "precisions": list(self.precisions),
             "recompiles_after_warm": compiles_after_warm,
             "ingest": {
                 "compact": self.shape_set.compact is not None,
@@ -977,6 +1028,7 @@ def load_server(
     compact: str = "auto",
     pack_workers: int | None = None,
     devices: str | int = "auto",
+    precision: str = "f32",
     watch: bool = True,
     poll_interval_s: float = 2.0,
     profile_dir: str = "",
@@ -1004,6 +1056,11 @@ def load_server(
     ``None`` follows the same device rule — 1 on accelerators (pack
     overlaps remote dispatch), 0 on CPU (an overlap thread only steals
     cores from the compute it would overlap with).
+
+    ``precision`` names the tiers to WARM, comma-separated (e.g.
+    ``'f32,bf16,int8'`` — serve/quantize.py); requests then pick a tier
+    per call (default f32). Every warmed tier multiplies the warmup
+    compile count and never compiles after.
 
     ``devices`` (ISSUE 5) selects the dispatch set: ``'auto'`` = every
     local device on accelerator backends, one device on CPU (host
@@ -1038,6 +1095,10 @@ def load_server(
             "per-atom output extraction is offline-only (predict.py)"
         )
     model_cfg, data_cfg = cfg["model_cfg"], cfg["data_cfg"]
+    # serving admits any structure that fits the ladder: widen
+    # training-set-derived bounds (ModelConfig.for_arbitrary_inputs —
+    # the cgconv window contract)
+    model_cfg = model_cfg.for_arbitrary_inputs()
     model = build_model(model_cfg, data_cfg, cfg["task"])
     if calibration is None:
         calibration = load_synthetic(
@@ -1085,11 +1146,15 @@ def load_server(
     # back past a corrupt newest save, and a wrong label here would both
     # mis-tag every response and pin the watcher (newest == "current")
     version = mgr.last_restored or tag
+    precisions = tuple(
+        t.strip() for t in str(precision).split(",") if t.strip()
+    ) or ("f32",)
     server = InferenceServer(
         state, shape_set, version=version, telemetry=telemetry,
         max_queue=max_queue, max_wait_ms=max_wait_ms,
         default_timeout_ms=default_timeout_ms, cache_size=cache_size,
-        pack_workers=pack_workers, devices=device_list, log_fn=log_fn,
+        pack_workers=pack_workers, devices=device_list,
+        precisions=precisions, model=model, log_fn=log_fn,
     )
     server.warm(template)
     if profile_dir:

@@ -9,6 +9,7 @@ reference's console output.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import threading
@@ -547,6 +548,7 @@ class ScanEpochDriver:
         queues = []
         tails = []
         steps = 0
+        pick_order: list[int] = []
         multi = train and len(groups) > 1
         for key, stacked in groups.items():
             n = int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
@@ -592,7 +594,31 @@ class ScanEpochDriver:
                 [np.ascontiguousarray(ch, dtype=np.int32)
                  for ch in entry[2]]
             )
-        return queues, tails, steps
+        # weighted group-pick sequence, PRECOMPUTED here (ISSUE 9
+        # satellite): the per-chunk np.array + rng.choice(p=...) that
+        # used to run on the DISPATCH path in run_queues (a measurable
+        # host-side fixed cost per chunk — scan_cost.py, PERF.md §6c)
+        # moves into the schedule build, which _drive prebuilds one
+        # epoch AHEAD so it overlaps the in-flight epoch. Same sampler,
+        # same weights (remaining steps per group), same rng stream
+        # shape — the step-sequence distribution is unchanged, and the
+        # sync-vs-async-fetch bit-identity pin still holds because both
+        # paths build schedules in the same order.
+        if multi and not first:
+            rem = [[len(ch) for ch in entry[2]] for entry in queues]
+            alive = list(range(len(queues)))
+            while alive:
+                if len(alive) > 1:
+                    w = np.array([float(sum(rem[i])) for i in alive])
+                    gi = alive[int(self._rng.choice(len(alive),
+                                                    p=w / w.sum()))]
+                else:
+                    gi = alive[0]
+                pick_order.append(gi)
+                rem[gi].pop(0)
+                if not rem[gi]:
+                    alive.remove(gi)
+        return queues, tails, steps, pick_order
 
     def warm(self, state: TrainState) -> TrainState:
         """Compile every (shape, chunk-length) scan program the driver can
@@ -673,15 +699,20 @@ class ScanEpochDriver:
             if sched is None:
                 sched = self._build_sched(groups, train, first)
                 self._sched_cache[sched_key] = sched
-        queues, tails, _planned_steps = sched
-        # run_queues consumes the chunk lists (pop/remove): work on
-        # shallow copies so the cached eval schedule survives reuse
-        queues = [(k, st, list(ch)) for k, st, ch in queues]
-        tails = [(k, st, list(ch)) for k, st, ch in tails]
+        queues, tails, _planned_steps, pick_order = sched
+        # run_queues consumes the chunk lists: work on shallow DEQUE
+        # copies (O(1) popleft — pop(0) shifted the whole list per
+        # chunk) so the cached eval schedule survives reuse
+        queues = [(k, st, collections.deque(ch)) for k, st, ch in queues]
+        tails = [(k, st, collections.deque(ch)) for k, st, ch in tails]
         multi = train and len(groups) > 1
-        # chunks across shape groups: weighted-random pick (multi-bucket
-        # training) or sequential. Chunk metric sums accumulate ON DEVICE
-        # (async adds) and are fetched ONCE, packed into a single array —
+        # chunk dispatch is the host-side hot loop (ISSUE 9 satellite —
+        # PERF.md §6c): the weighted group picks were PREDRAWN into
+        # pick_order by _build_sched (one epoch ahead, overlapping the
+        # in-flight epoch), so per chunk this loop does a deque pop, a
+        # dict lookup, the dispatch, and one device-side accumulate.
+        # Chunk metric sums accumulate ON DEVICE (one fused async add
+        # per chunk) and are fetched ONCE, packed into a single array —
         # a list-of-dicts device_get at epoch end moved every scalar as
         # its own link round trip, which at bench scale (17 chunks x 4
         # keys) was ~250 ms/epoch: the whole driver-vs-steady gap
@@ -689,10 +720,14 @@ class ScanEpochDriver:
         dev_sums: dict | None = None
         n_chunks = 0
         executed = 0
+        spans = (self._telemetry.spans
+                 if self._telemetry is not None else None)
 
         def run_queues(qs, weighted):
             nonlocal state, dev_sums, n_chunks, executed
             rr = 0
+            picks = iter(pick_order)
+            by_index = list(qs)  # pick_order indexes the BUILD order
             while qs:
                 if self._preempt is not None and self._preempt.requested:
                     # chunk-boundary preemption: stop dispatching; the
@@ -701,12 +736,8 @@ class ScanEpochDriver:
                     # executed step count, not the planned one.
                     self.aborted = True
                     return
-                if weighted and len(qs) > 1:
-                    w = np.array([
-                        float(sum(len(ch) for ch in entry[2]))
-                        for entry in qs
-                    ])
-                    entry = qs[int(self._rng.choice(len(qs), p=w / w.sum()))]
+                if weighted and pick_order:
+                    entry = by_index[next(picks)]
                 else:
                     # round-robin across groups (never drain one bucket
                     # before starting the next: BN's momentum-0.1 EMA and
@@ -714,13 +745,20 @@ class ScanEpochDriver:
                     entry = qs[rr % len(qs)]
                     rr += 1
                 key, stacked, chunks = entry
-                chunk = chunks.pop(0)  # device-staged perm (see above)
+                chunk = chunks.popleft()  # device-staged perm (see above)
                 # compile key includes the chunk length (bounded per
                 # group: <= 2c distinct lengths, one remainder, length 1)
                 fn = self._scan_fn(
                     scans, (key, len(chunk)), body, train
                 )
+                t0 = time.perf_counter() if spans is not None else 0.0
                 state, chunk_sums = fn(state, stacked, chunk)
+                if spans is not None:
+                    # host-side dispatch cost per chunk, visible in the
+                    # Chrome trace next to the device timeline (§6c)
+                    spans.complete("scan.chunk", t0, time.perf_counter(),
+                                   steps=int(chunk.shape[0]),
+                                   train=train)
                 dev_sums = accumulate_on_device(dev_sums, chunk_sums)
                 n_chunks += 1
                 executed += int(chunk.shape[0])

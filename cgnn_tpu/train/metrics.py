@@ -10,15 +10,41 @@ from __future__ import annotations
 import numpy as np
 
 
+# jitted whole-dict add, cached per key-structure: K per-key `a + b`
+# dispatches per chunk become ONE fused dispatch (the scan driver's
+# per-chunk host fixed cost — PERF.md §6c; on a tunneled link every
+# dispatch is host work on the critical path)
+_ACCUM_FNS: dict = {}
+
+
+def _accum_fn(keys: tuple):
+    fn = _ACCUM_FNS.get(keys)
+    if fn is None:
+        import jax
+
+        fn = _ACCUM_FNS[keys] = jax.jit(
+            lambda a, b: {k: a[k] + b[k] for k in keys}
+        )
+    return fn
+
+
 def accumulate_on_device(dev_sums: dict | None, metrics: dict) -> dict:
     """Add a step's metric dict into device-side running sums.
 
     The adds are dispatched asynchronously — no host<->device round trip
     per step (which would dominate epoch time on remote/tunneled
-    accelerators and throttle dispatch pipelining everywhere). Tolerates
-    keys appearing mid-epoch (mixed step bodies)."""
+    accelerators and throttle dispatch pipelining everywhere). The
+    steady-state case (same key set chunk after chunk) goes through one
+    jitted dict-add — one dispatch instead of one per key. Tolerates
+    keys appearing mid-epoch (mixed step bodies) via the per-key
+    fallback."""
     if dev_sums is None:
         return dict(metrics)
+    if dev_sums.keys() == metrics.keys():
+        try:
+            return _accum_fn(tuple(sorted(metrics)))(dev_sums, metrics)
+        except TypeError:
+            pass  # non-jittable values (python floats mid-migration)
     for k, v in metrics.items():
         dev_sums[k] = dev_sums[k] + v if k in dev_sums else v
     return dev_sums

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Quantized-program MAE drift gate (ISSUE 9): int8/bf16 vs f32.
+
+The serving precision tiers (serve/quantize.py) are only shippable if
+they are a precision DIAL, not an accuracy cliff: this harness trains
+the standard model on the cached synthetic MP-like set (or restores
+``--ckpt-dir``), builds the f32 / bf16 / int8 programs for the serving
+shape ladder, runs the held-out split through ALL tiers in one process,
+and gates the prediction-MAE ratio vs f32 at ``--tolerance`` (default
+0.005 — the MAE_PARITY posture applied to serving precision).
+
+Prints one JSON line; exit 1 if any tier exceeds the gate. Commit as
+QUANT_PARITY.json next to the other parity artifacts.
+
+Usage: python scripts/quant_parity.py [--n 4096] [--epochs 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cgnn_tpu.observe.metrics_io import jsonfinite  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--rungs", type=int, default=3)
+    p.add_argument("--tolerance", type=float, default=0.005,
+                   help="max allowed (tier_mae / f32_mae - 1)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", type=str, default="QUANT_PARITY.json")
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.data.dataset import (
+        FeaturizeConfig,
+        load_synthetic_mp,
+        train_val_test_split,
+    )
+    from cgnn_tpu.data.graph import batch_iterator, capacities_for
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.serve.quantize import TIERS, build_tier_specs
+    from cgnn_tpu.serve.shapes import plan_shape_set
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.loop import fit
+    from cgnn_tpu.train.step import make_predict_step
+
+    cfg = FeaturizeConfig(radius=6.0, max_num_nbr=12)
+    graphs = load_synthetic_mp(args.n, cfg, seed=11)
+    train_g, val_g, test_g = train_val_test_split(graphs, 0.8, 0.1,
+                                                 seed=args.seed)
+    model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
+                                dense_m=12)
+    nc, ec = capacities_for(train_g, args.batch_size, dense_m=12)
+    example = next(batch_iterator(train_g, args.batch_size, nc, ec,
+                                  dense_m=12))
+    state = create_train_state(
+        model, example, make_optimizer(optim="adam", lr=0.01),
+        Normalizer.fit(np.stack([g.target for g in train_g])),
+        rng=jax.random.key(args.seed),
+    )
+    state, _ = fit(
+        state, train_g, val_g, epochs=args.epochs,
+        batch_size=args.batch_size, seed=args.seed, print_freq=0,
+        dense_m=12, log_fn=lambda *a, **k: None,
+    )
+
+    # every rung of the serving ladder, every tier, one process
+    ladder = plan_shape_set(graphs, args.batch_size, rungs=args.rungs,
+                            dense_m=12)
+    specs = build_tier_specs(model, TIERS)
+    pstep = jax.jit(make_predict_step())
+    maes: dict[str, float] = {}
+    per_rung: dict[str, list] = {}
+    for tier in TIERS:
+        st = specs[tier].state_for(state)
+        abs_sum = count = 0.0
+        rung_maes = []
+        for shape in ladder:
+            r_abs = r_cnt = 0.0
+            group: list = []
+            g_nodes = g_edges = 0
+
+            def flush(group):
+                nonlocal r_abs, r_cnt
+                batch = ladder.pack(group, shape=shape)
+                out = np.array(jax.device_get(pstep(st, batch)))
+                tgt = np.stack([np.atleast_1d(g.target) for g in group])
+                r_abs += float(np.abs(out[: len(group)] - tgt).sum())
+                r_cnt += tgt.size
+
+            for g in test_g:
+                n, e = ladder.graph_counts(g)
+                if group and not shape.fits(len(group) + 1, g_nodes + n,
+                                            g_edges + e):
+                    flush(group)
+                    group, g_nodes, g_edges = [], 0, 0
+                group.append(g)
+                g_nodes += n
+                g_edges += e
+            if group:
+                flush(group)
+            rung_maes.append(round(r_abs / max(r_cnt, 1), 6))
+            abs_sum += r_abs
+            count += r_cnt
+        maes[tier] = abs_sum / max(count, 1)
+        per_rung[tier] = rung_maes
+
+    ratios = {t: maes[t] / maes["f32"] for t in TIERS if t != "f32"}
+    worst = max(ratios.values())
+    out = {
+        "metric": "quantized_program_mae_parity",
+        "n_structures": args.n,
+        "test_structures": len(test_g),
+        "epochs": args.epochs,
+        "rungs": args.rungs,
+        "mae": {t: round(v, 6) for t, v in maes.items()},
+        "mae_per_rung": per_rung,
+        "ratio_vs_f32": {t: round(r, 5) for t, r in ratios.items()},
+        "tolerance": args.tolerance,
+        "pass": bool(worst <= 1.0 + args.tolerance),
+        "device": str(jax.devices()[0].device_kind),
+    }
+    print(json.dumps(jsonfinite(out)))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(jsonfinite(out), fh, indent=1)
+    return 0 if worst <= 1.0 + args.tolerance else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "CPU; an integer forces that many anywhere. "
                         "With a forced N > 1 the loadgen HARD-ASSERTS "
                         "that every device answered responses")
+    p.add_argument("--precision", default="f32", metavar="TIERS",
+                   help="comma-separated precision tiers (f32,bf16,int8): "
+                        "the server warms ALL of them, each request "
+                        "draws one uniformly — mixed-tier traffic "
+                        "exercises the batcher's tier-boundary cut; the "
+                        "report breaks responses down per tier")
     p.add_argument("--max-wait-ms", type=float, default=5.0)
     p.add_argument("--max-queue", type=int, default=4096)
     p.add_argument("--report", default="slo_report.json")
@@ -174,6 +180,8 @@ class _ClientStats:
         self.dropped = 0
         self.errors: list[str] = []
         self.device_responses: dict[int, int] = {}
+        # precision tier -> responses (the quantized-serving A/B record)
+        self.precision_responses: dict[str, int] = {}
         # device_id -> param versions it answered with (the per-device
         # hot-swap consistency record)
         self.device_versions: dict[int, set] = {}
@@ -244,6 +252,7 @@ def _run_inproc(args) -> dict:
         compact=args.compact,
         pack_workers=args.pack_workers,
         devices=args.devices,
+        precision=args.precision,
         default_timeout_ms=args.timeout_ms,
         cache_size=0,  # the loadgen reuses structures; caching would
                        # let most requests skip the batcher under test
@@ -267,13 +276,20 @@ def _run_inproc(args) -> dict:
     def client(ci: int):
         rng = np.random.default_rng(args.seed + ci)
         interval = 1.0 / args.rate if args.rate > 0 else 0.0
+        tiers = [t.strip() for t in args.precision.split(",") if t.strip()]
         while not stop.is_set():
             g = pool[int(rng.integers(len(pool)))]
+            # uniform random tier per request: with more than one tier
+            # this exercises the batcher's tier-boundary flush cut under
+            # real concurrency (a random draw can starve a tier on very
+            # short runs — the smoke leg's duration covers it)
+            tier = tiers[int(rng.integers(len(tiers)))] if tiers else None
             t0 = time.monotonic()
             try:
                 with stats.lock:
                     stats.submitted += 1
-                fut = server.submit(g, timeout_ms=args.timeout_ms)
+                fut = server.submit(g, timeout_ms=args.timeout_ms,
+                                    precision=tier)
                 res = fut.result(timeout=args.timeout_ms / 1000.0 + 60.0)
             except ServeRejection as e:
                 with stats.lock:
@@ -294,6 +310,10 @@ def _run_inproc(args) -> dict:
                 stats.latencies.append(res.latency_ms)
                 stats.versions[res.param_version] = (
                     stats.versions.get(res.param_version, 0) + 1
+                )
+                tier_got = getattr(res, "precision", "f32")
+                stats.precision_responses[tier_got] = (
+                    stats.precision_responses.get(tier_got, 0) + 1
                 )
                 di = getattr(res, "device_id", 0)
                 stats.device_responses[di] = (
@@ -425,6 +445,11 @@ def _run_inproc(args) -> dict:
             float(np.mean(stats.occupancies)) if stats.occupancies else 0.0
         ),
         "param_versions": stats.versions,
+        "precision": {
+            "requested": args.precision,
+            "responses_by_tier": dict(sorted(
+                stats.precision_responses.items())),
+        },
         "devices": {
             "requested": str(args.devices),
             "count": len(server.device_set),

@@ -164,6 +164,28 @@ print("leg 1d ok:", r["answered"], "answered under racecheck — 0",
       rc["heartbeats_seen"])
 EOF
 
+echo "== leg 1e: mixed precision tiers under load (ISSUE 9) =="
+# the server warms f32 + bf16 + int8 programs for every rung; each
+# request draws a tier uniformly, so the batcher's tier-boundary flush
+# cut runs constantly. Invariants: zero drops, ZERO recompiles after
+# warmup (a tier that slipped past warm() would trace mid-load), and
+# every requested tier actually answered.
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --clients 32 --duration 6 --precision f32,bf16,int8 \
+  --report "$WORK/slo_precision.json"
+python - "$WORK/slo_precision.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["dropped"] == 0, r
+assert r["compiles"]["after_warm"] == 0, r["compiles"]
+assert not r["failures"], r["failures"]
+by_tier = r["precision"]["responses_by_tier"]
+assert set(by_tier) == {"f32", "bf16", "int8"}, by_tier
+assert all(v > 0 for v in by_tier.values()), by_tier
+print("leg 1e ok:", r["answered"], "answered across tiers", by_tier,
+      "- 0 drops / 0 recompiles")
+EOF
+
 echo "== leg 2: HTTP front-end + graceful SIGTERM drain =="
 python serve.py "$WORK/ckpt" --port "$PORT" --calibrate 64 \
   >"$WORK/serve.log" 2>&1 &

@@ -44,6 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pack pipeline threads between batcher and "
                         "dispatch (0 = in-line; default follows the "
                         "backend like --compact auto)")
+    p.add_argument("--precision", default="f32", metavar="TIERS",
+                   help="comma-separated precision tiers to warm "
+                        "(f32,bf16,int8 — serve/quantize.py); requests "
+                        "pick a tier per call via the 'precision' field "
+                        "(default f32). Every tier is compiled at warmup "
+                        "for every rung — zero recompiles after")
     p.add_argument("--devices", default="auto", metavar="{auto,N}",
                    help="device-parallel dispatch set (serve/devices.py): "
                         "'auto' = all local devices on accelerator "
@@ -125,6 +131,7 @@ def main(argv=None) -> int:
             compact=args.compact,
             pack_workers=args.pack_workers,
             devices=args.devices,
+            precision=args.precision,
             watch=args.poll_interval > 0,
             poll_interval_s=args.poll_interval or 2.0,
             profile_dir=profile_dir,

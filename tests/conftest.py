@@ -32,3 +32,33 @@ jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 from cgnn_tpu.data import invariants  # noqa: E402
 
 invariants.enable()
+
+# jax 0.4.37 (this container) predates pltpu.force_tpu_interpret_mode —
+# the reason every pallas interpret-mode test was among the pre-existing
+# seed failures. Emulate it FOR THE TEST SUITE ONLY by forcing
+# interpret=True through pallas_call while the context is active; newer
+# jax (CI) keeps the real context manager. Library code never depends on
+# this shim (ops/pallas_cgconv.py threads its own interpret flag).
+from jax.experimental import pallas as _pl  # noqa: E402
+from jax.experimental.pallas import tpu as _pltpu  # noqa: E402
+
+if not hasattr(_pltpu, "force_tpu_interpret_mode"):
+    import contextlib as _contextlib
+    import functools as _functools
+
+    @_contextlib.contextmanager
+    def _force_tpu_interpret_mode():
+        orig = _pl.pallas_call
+
+        @_functools.wraps(orig)
+        def interpreted(*args, **kwargs):
+            kwargs["interpret"] = True
+            return orig(*args, **kwargs)
+
+        _pl.pallas_call = interpreted
+        try:
+            yield
+        finally:
+            _pl.pallas_call = orig
+
+    _pltpu.force_tpu_interpret_mode = _force_tpu_interpret_mode

@@ -1,0 +1,196 @@
+"""Quantized serving programs (serve/quantize.py, ISSUE 9 tentpole B).
+
+The contract under test: int8-weight / bf16-activation serving programs
+are a PRECISION dial, not an accuracy cliff — prediction MAE on the
+cached synthetic set may drift at most 0.5% relative vs the f32 program
+(the MAE_PARITY posture, applied to serving tiers), tier states share
+the native checkpoint (no retraining, hot-swap safe), and every tier is
+a warm program (zero post-warmup recompiles — pinned on the serving side
+in tests/test_serve.py TestPrecisionServing).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cgnn_tpu.config import DataConfig, ModelConfig, build_model
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+from cgnn_tpu.serve.quantize import (
+    TIERS,
+    QuantizedKernel,
+    build_tier_specs,
+    dequantize_params,
+    quantize_kernel,
+    quantize_params,
+)
+from cgnn_tpu.serve.shapes import plan_shape_set
+from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+from cgnn_tpu.train.step import make_predict_step
+
+CFG = FeaturizeConfig(radius=5.0, max_num_nbr=8)
+
+
+@pytest.fixture(scope="module")
+def graphs():
+    return load_synthetic(96, CFG, seed=3, max_atoms=8)
+
+
+@pytest.fixture(scope="module")
+def trained(graphs):
+    """A briefly-TRAINED model (not a random init: quantization error on
+    random weights says nothing about the served operating point)."""
+    from cgnn_tpu.data.graph import capacities_for
+    from cgnn_tpu.train.loop import fit
+
+    model_cfg = ModelConfig(atom_fea_len=16, n_conv=2, h_fea_len=24)
+    model = build_model(model_cfg, DataConfig(radius=5.0, max_num_nbr=8))
+    train_g = graphs[:64]
+    nc, ec = capacities_for(train_g, 16)
+    from cgnn_tpu.data.graph import batch_iterator
+
+    example = next(batch_iterator(train_g, 16, nc, ec))
+    state = create_train_state(
+        model, example, make_optimizer(optim="adam", lr=0.01),
+        Normalizer.fit(np.stack([g.target for g in train_g])),
+        rng=jax.random.key(0),
+    )
+    state, _ = fit(state, train_g, graphs[64:80], epochs=4, batch_size=16,
+                   node_cap=nc, edge_cap=ec, seed=0, print_freq=0,
+                   log_fn=lambda *a, **k: None)
+    return model, state
+
+
+class TestQuantizeCore:
+    @staticmethod
+    def _deq(qk):
+        return np.asarray(dequantize_params({"x": {"kernel": qk}})["x"]
+                          ["kernel"])
+
+    def test_kernel_roundtrip_error_bounded(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(0, 0.2, size=(70, 48)).astype(np.float32)  # ragged
+        qk = quantize_kernel(w)
+        assert np.asarray(qk.q).dtype == np.int8
+        deq = self._deq(qk)
+        assert deq.shape == w.shape  # block padding undone
+        # blocked symmetric: per-element error bounded by its block's
+        # scale/2
+        scale = np.asarray(qk.scale)
+        blocks = np.repeat(scale, 32, axis=0)[: w.shape[0]]
+        assert (np.abs(deq - w) <= blocks / 2 + 1e-7).all()
+
+    def test_zero_column_kernel_safe(self):
+        w = np.zeros((8, 4), np.float32)
+        qk = quantize_kernel(w)
+        np.testing.assert_array_equal(self._deq(qk), w)
+
+    def test_quantize_params_targets_kernels_only(self, trained):
+        _, state = trained
+        q = quantize_params(state.params)
+        leaves = jax.tree_util.tree_leaves_with_path(
+            q, is_leaf=lambda x: isinstance(x, QuantizedKernel)
+        )
+        n_q = sum(isinstance(v, QuantizedKernel) for _, v in leaves)
+        # the conv fc_full kernels (the HBM payload) quantize; the
+        # embedding and output head stay full precision by policy
+        n_expected = sum(
+            1 for p, v in jax.tree_util.tree_leaves_with_path(state.params)
+            if getattr(p[-1], "key", None) == "kernel"
+            and np.ndim(v) == 2 and np.shape(v)[1] > 8
+            and not any(getattr(k, "key", None) in ("embedding", "fc_out")
+                        for k in p)
+        )
+        assert n_q == n_expected and n_q > 0
+        q_names = {jax.tree_util.keystr(p) for p, v in leaves
+                   if isinstance(v, QuantizedKernel)}
+        assert not any("embedding" in n or "fc_out" in n for n in q_names)
+        assert any("fc_full" in n for n in q_names)
+        # every non-kernel leaf is untouched (bit-identical)
+        for path, v in leaves:
+            if not isinstance(v, QuantizedKernel):
+                ref = state.params
+                for k in path:
+                    ref = ref[k.key]
+                np.testing.assert_array_equal(np.asarray(v),
+                                              np.asarray(ref))
+
+    def test_dequantize_restores_structure(self, trained):
+        _, state = trained
+        deq = dequantize_params(quantize_params(state.params), jnp.bfloat16)
+        ref_paths = [jax.tree_util.keystr(p) for p, _ in
+                     jax.tree_util.tree_leaves_with_path(state.params)]
+        got_paths = [jax.tree_util.keystr(p) for p, _ in
+                     jax.tree_util.tree_leaves_with_path(deq)]
+        assert sorted(ref_paths) == sorted(got_paths)
+
+    def test_unknown_tier_rejected(self, trained):
+        model, _ = trained
+        with pytest.raises(ValueError, match="unknown precision"):
+            build_tier_specs(model, ("f32", "fp4"))
+
+
+class TestTierPrograms:
+    """One ladder rung, all three programs: the satellite-4 tier-1 gate
+    — prediction MAE ratio vs f32 <= 1.005 on the cached synthetic set."""
+
+    @pytest.fixture(scope="class")
+    def tier_maes(self, graphs, trained):
+        model, state = trained
+        eval_g = graphs[80:]
+        ladder = plan_shape_set(graphs, 16, rungs=1)
+        specs = build_tier_specs(model, TIERS)
+        pstep = jax.jit(make_predict_step())
+        batch = ladder.pack(eval_g[:16])
+        targets = np.stack([np.atleast_1d(g.target) for g in eval_g[:16]])
+        maes = {}
+        preds = {}
+        for tier in TIERS:
+            st = specs[tier].state_for(state)
+            out = np.array(jax.device_get(pstep(st, batch)))[:16]
+            preds[tier] = out
+            maes[tier] = float(np.abs(out - targets).mean())
+        return maes, preds
+
+    def test_mae_ratio_within_half_percent(self, tier_maes):
+        maes, _ = tier_maes
+        assert maes["f32"] > 0
+        for tier in ("bf16", "int8"):
+            ratio = maes[tier] / maes["f32"]
+            assert ratio <= 1.005, (
+                f"{tier} prediction MAE ratio {ratio:.4f} exceeds the "
+                f"0.5% drift gate (maes={maes})"
+            )
+
+    def test_tiers_actually_differ_from_f32(self, tier_maes):
+        """Guard against a silently-ignored tier (a transform that
+        returns the native program would pass the ratio gate vacuously)."""
+        _, preds = tier_maes
+        assert np.abs(preds["bf16"] - preds["f32"]).max() > 0
+        assert np.abs(preds["int8"] - preds["bf16"]).max() > 0
+
+    def test_specs_stable_identity(self, trained):
+        """The apply_fn handed to the jit cache must be the SAME object
+        for repeated state derivations (hot reload must not retrace)."""
+        model, state = trained
+        specs = build_tier_specs(model, TIERS)
+        for tier in TIERS:
+            a = specs[tier].state_for(state)
+            b = specs[tier].state_for(state)
+            assert a.apply_fn is b.apply_fn
+
+    def test_int8_state_drops_opt_state(self, trained):
+        model, state = trained
+        specs = build_tier_specs(model, ("f32", "int8"))
+        st = specs["int8"].state_for(state)
+        assert st.opt_state == ()
+        # int8 kernels really are int8 on the wire
+        n_int8 = sum(
+            np.asarray(v.q).dtype == np.int8
+            for _, v in jax.tree_util.tree_leaves_with_path(
+                st.params,
+                is_leaf=lambda x: isinstance(x, QuantizedKernel))
+            if isinstance(v, QuantizedKernel)
+        )
+        assert n_int8 > 0

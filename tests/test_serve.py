@@ -973,3 +973,139 @@ class TestRequestTracing:
         assert server._jit_cache_size() == n0
         assert server.drain(timeout_s=30.0)
         assert server.stats()["recompiles_after_warm"] == 0
+
+
+# ------------------------------------------------- precision tiers (ISSUE 9)
+
+
+class TestPrecisionServing:
+    """serve/quantize.py through the serving path: every tier is a warm
+    program (compile pin), requests pick tiers per call, the batcher
+    cuts flushes at tier boundaries, the cache is tier-keyed, and hot
+    reload rebuilds every tier under one version without retracing."""
+
+    def _tier_server(self, model_state, shape_set, **kw):
+        model_cfg, state = model_state
+        model = build_model(model_cfg, DataConfig(radius=5.0, max_num_nbr=8))
+        kw.setdefault("log_fn", lambda *a, **k: None)
+        kw.setdefault("max_wait_ms", 5.0)
+        return InferenceServer(
+            state, shape_set, precisions=("f32", "bf16", "int8"),
+            model=model, **kw,
+        )
+
+    def test_mixed_tier_traffic_compile_pin(self, graphs, shape_set,
+                                            model_state):
+        server = self._tier_server(model_state, shape_set, cache_size=0)
+        compiled = server.warm(graphs[0])
+        # rungs x tiers (one staging form: no compact spec), one device
+        assert compiled == len(shape_set) * 3
+        server.start()
+        n0 = server._jit_cache_size()
+        futs = [
+            (tier, server.submit(graphs[i % len(graphs)], timeout_ms=30000,
+                                 precision=tier))
+            for i, tier in enumerate(
+                ["f32", "bf16", "int8", "int8", "f32", "bf16"] * 4)
+        ]
+        for tier, fut in futs:
+            res = fut.result(timeout=60.0)
+            assert res.precision == tier
+        assert server._jit_cache_size() == n0
+        assert server.drain(timeout_s=30.0)
+        assert server.stats()["recompiles_after_warm"] == 0
+        assert server.stats()["counts"]["responses"] == len(futs)
+
+    def test_tier_predictions_differ_but_agree(self, graphs, shape_set,
+                                               model_state):
+        server = self._tier_server(model_state, shape_set, cache_size=0)
+        server.warm(graphs[0])
+        server.start()
+        res = {t: server.predict(graphs[1], timeout_ms=30000, precision=t)
+               for t in ("f32", "bf16", "int8")}
+        f32 = res["f32"].prediction
+        for tier in ("bf16", "int8"):
+            got = res[tier].prediction
+            assert not np.array_equal(got, f32)  # a REAL low-precision run
+            np.testing.assert_allclose(got, f32, rtol=0.05, atol=0.05)
+        assert server.drain(timeout_s=30.0)
+
+    def test_batcher_cuts_flush_at_tier_boundary(self, graphs, shape_set):
+        clk = [0.0]
+        b = MicroBatcher(shape_set, max_wait_ms=5.0, clock=lambda: clk[0])
+        for tier in ("f32", "f32", "bf16", "bf16", "int8"):
+            r = _request(graphs[0], now=clk[0])
+            r.precision = tier
+            b.offer(r)
+        clk[0] += 1.0  # all past the batching deadline
+        flushes = []
+        while True:
+            f = b.poll()
+            if f is None or not f.requests:
+                break
+            flushes.append((f.precision, len(f.requests)))
+        assert flushes == [("f32", 2), ("bf16", 2), ("int8", 1)]
+
+    def test_unknown_tier_rejected_at_admission(self, graphs, shape_set,
+                                                model_state):
+        server = self._tier_server(model_state, shape_set, cache_size=0)
+        server.warm(graphs[0])
+        server.start()
+        with pytest.raises(ServeRejection, match="precision"):
+            server.submit(graphs[0], precision="fp4")
+        # a plain-f32 server rejects non-f32 tiers too (never warmed)
+        assert server.drain(timeout_s=30.0)
+
+    def test_tier_keyed_cache_isolation(self, graphs, shape_set,
+                                        model_state):
+        server = self._tier_server(model_state, shape_set, cache_size=64)
+        server.warm(graphs[0])
+        server.start()
+        r_f32 = server.predict(graphs[2], timeout_ms=30000)
+        r_int8 = server.predict(graphs[2], timeout_ms=30000,
+                                precision="int8")
+        # the int8 request must NOT be answered from the f32 cache row
+        assert not r_int8.cached
+        assert not np.array_equal(r_int8.prediction, r_f32.prediction)
+        # same-tier repeats DO hit, each tier its own row
+        assert server.predict(graphs[2], timeout_ms=30000).cached
+        r_int8_2 = server.predict(graphs[2], timeout_ms=30000,
+                                  precision="int8")
+        assert r_int8_2.cached
+        np.testing.assert_array_equal(r_int8_2.prediction,
+                                      r_int8.prediction)
+        assert server.drain(timeout_s=30.0)
+
+    def test_hot_swap_rebuilds_every_tier_without_retrace(
+            self, graphs, shape_set, model_state, tmp_path):
+        model_cfg, state = model_state
+        mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                                log_fn=lambda m: None)
+        _save_state(mgr, state, model_cfg)
+        v1 = mgr.newest_committed()
+        server = self._tier_server(model_state, shape_set, cache_size=0,
+                                   version=v1)
+        server.warm(graphs[0])
+        server.start()
+        before = {t: server.predict(graphs[3], timeout_ms=30000,
+                                    precision=t)
+                  for t in ("f32", "int8")}
+        n0 = server._jit_cache_size()
+        watcher = server.attach_watcher(mgr, poll_interval_s=3600)
+        _save_state(mgr, state, model_cfg, nudge=0.25)
+        assert watcher.poll_once()
+        after = {t: server.predict(graphs[3], timeout_ms=30000,
+                                   precision=t)
+                 for t in ("f32", "int8")}
+        for tier in ("f32", "int8"):
+            assert before[tier].param_version == v1
+            assert after[tier].param_version == mgr.newest_committed()
+            # every tier's numbers moved with the swap (quantized
+            # variants really were re-derived from the new params)
+            assert not np.allclose(before[tier].prediction,
+                                   after[tier].prediction)
+        # the swap reused the warmed programs: no retrace, no recompile
+        assert server._jit_cache_size() == n0
+        assert server.stats()["recompiles_after_warm"] == 0
+        assert server.drain(timeout_s=30.0)
+        mgr.close()

@@ -203,6 +203,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "the custom-VJP boundary forfeits XLA's producer/"
                         "consumer fusion (PERF.md 6b); kept for "
                         "reproduction/experiments")
+    p.add_argument("--cgconv-impl", choices=["off", "xla", "pallas"],
+                   default="off",
+                   help="WHOLE-conv fused kernel (ops/pallas_cgconv.py): "
+                        "gather+fc_full+BN+gate+sum as one custom-VJP op, "
+                        "v_j/z never in HBM; 'xla' = structured jnp twin, "
+                        "'pallas' = blocked TPU kernels (dense layout "
+                        "only; A/B via bench.py --ab cgconv, verdict in "
+                        "PERF.md)")
     p.add_argument("--compact-staging", choices=["auto", "on", "off"],
                    default="auto",
                    help="stage batches in raw form (atom vocabulary index "
@@ -461,6 +469,22 @@ def main(argv=None) -> int:
               "and no graph sharding (not --layout coo / --task force / "
               "--graph-shards)", file=sys.stderr)
         return 2
+    if args.cgconv_impl != "off" and (
+        not use_dense or force_task or args.graph_shards > 1
+        or args.fused_epilogue != "off"
+    ):
+        print("--cgconv-impl (the whole-conv fused kernel) requires the "
+              "dense layout with BatchNorm, no graph sharding, and no "
+              "--fused-epilogue (it subsumes it)", file=sys.stderr)
+        return 2
+    cgconv_window = 0
+    if args.cgconv_impl != "off":
+        # the in-kernel gather's neighbor-window bound comes from the
+        # REAL dataset (an undersized bound would silently zero
+        # out-of-window neighbors — ops/pallas_cgconv.py contract)
+        from cgnn_tpu.ops.pallas_cgconv import window_width
+
+        cgconv_window = window_width(max(g.num_nodes for g in graphs))
 
     model_cfg = ModelConfig(
         atom_fea_len=args.atom_fea_len, n_conv=args.n_conv,
@@ -471,6 +495,8 @@ def main(argv=None) -> int:
         dense_m=dense_m,
         fused_epilogue="" if args.fused_epilogue == "off"
         else args.fused_epilogue,
+        cgconv_impl="" if args.cgconv_impl == "off" else args.cgconv_impl,
+        cgconv_window=cgconv_window,
     )
     graph_shards = max(1, args.graph_shards)
     if graph_shards > 1:
