@@ -231,7 +231,8 @@ def _bench_force_workload(graphs, batch_size, *, dense_m=None, n_timed=16,
 # monotonic drift within a round biases each variant equally; the
 # artifact reports PAIRED per-round ratios, which is what kills the
 # bench-link noise that muddied the r3->r5 trajectory.
-AB_FLAGS = ("cgconv", "fused-epilogue", "transpose", "compact", "precision")
+AB_FLAGS = ("cgconv", "fused-epilogue", "transpose", "compact", "precision",
+            "engine")
 
 
 def _ab_train_variants(flag: str, graphs, batch_size, buckets):
@@ -342,6 +343,8 @@ def _run_ab(flag: str, *, n: int, batch_size: int, buckets: int,
     graphs = load_synthetic_mp(n, cfg, seed=0)
     if flag == "precision":
         return _run_ab_precision(graphs, batch_size, rounds)
+    if flag == "engine":
+        return _run_ab_engine(graphs, batch_size, rounds)
     variants = _ab_train_variants(flag, graphs, batch_size, buckets)
 
     def set_transpose(v):
@@ -427,6 +430,62 @@ def _run_ab_precision(graphs, batch_size, rounds) -> dict:
     return _ab_report("precision", names, rows, extra={
         "workload": f"MP-like n={len(graphs)} ladder inference e2e "
                     f"(serve/quantize.py tiers)",
+        "device": str(jax.devices()[0].device_kind),
+    })
+
+
+def _run_ab_engine(graphs, batch_size, rounds) -> dict:
+    """Inference-side A/B of the two multi-device execution layers
+    (ISSUE 10): the mesh single-dispatch engine vs the ISSUE-5
+    thread-per-device DeviceSet round-robin, e2e over the serving
+    ladder across ALL local devices, interleaved per round (the §6b/§8
+    paired-ratio protocol). On a 1-device backend both engines
+    degenerate to the single-device loop and the ratio honestly reads
+    ~1 — run under ``--xla_force_host_platform_device_count=N`` (the
+    dryrun pattern) or on a real multi-chip host for the verdict."""
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.serve.shapes import plan_shape_set
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.infer import run_fast_inference
+    from cgnn_tpu.train.step import make_predict_step
+
+    devices = list(jax.local_devices())
+    model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
+                                dense_m=12)
+    ladder = plan_shape_set(graphs, batch_size, rungs=3, dense_m=12)
+    state = create_train_state(
+        model, ladder.pack_full([graphs[0]]),
+        make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10**9]),
+        Normalizer.fit(np.stack([np.array(g.target) for g in graphs])),
+    )
+    pstep = jax.jit(make_predict_step())
+    variants = {
+        "deviceset": dict(shape_set=ladder, predict_step=pstep,
+                          pack_workers=0, devices=devices,
+                          engine="threads"),
+        "mesh": dict(shape_set=ladder, predict_step=pstep,
+                     pack_workers=0, devices=devices, engine="mesh"),
+    }
+    for kw in variants.values():  # compile pass per engine
+        run_fast_inference(state, graphs, batch_size, **kw)
+    names = list(variants)
+    rows = []
+    for r in range(-1, rounds):  # round -1 = discarded burn-in
+        order = names[r % len(names):] + names[: r % len(names)]
+        for name in order:
+            _, rate = run_fast_inference(state, graphs, batch_size,
+                                         **variants[name])
+            if r >= 0:
+                rows.append({"round": r, "variant": name,
+                             "structs_per_sec": round(rate, 1)})
+    return _ab_report("engine", names, rows, extra={
+        "workload": f"MP-like n={len(graphs)} ladder inference e2e, "
+                    f"{len(devices)} device(s) "
+                    f"(mesh single-dispatch vs DeviceSet threads)",
+        "devices": len(devices),
         "device": str(jax.devices()[0].device_kind),
     })
 
@@ -608,9 +667,19 @@ def main(argv=None) -> None:
     from cgnn_tpu.serve.devices import resolve_devices
 
     inf_devices = resolve_devices("auto")
-    mdev_kw = dict(infer_kw, devices=inf_devices)
+    # engine="threads" pins the ISSUE-5 DeviceSet layer this key has
+    # always measured; the mesh engine (ISSUE 10, the new default for
+    # multi-device sets) gets its own leg + in-session ratio below
+    mdev_kw = dict(infer_kw, devices=inf_devices, engine="threads")
     run_fast_inference(istate, mp_graphs, 512, **mdev_kw)  # per-dev compile
     _, infer_e2e_mdev = run_fast_inference(istate, mp_graphs, 512, **mdev_kw)
+    # mesh single-dispatch engine over the SAME devices/ladder/session:
+    # one batch-sharded jitted dispatch covers the whole set (§8's
+    # in-session-ratio rule; on CPU 'auto' is one device, the engines
+    # coincide, and the ratio honestly reads ~1)
+    mesh_kw = dict(infer_kw, devices=inf_devices, engine="mesh")
+    run_fast_inference(istate, mp_graphs, 512, **mesh_kw)  # compile pass
+    _, infer_e2e_mesh = run_fast_inference(istate, mp_graphs, 512, **mesh_kw)
     # the pre-ISSUE-4 serial full-fidelity path, for the same-session
     # before/after (cross-session BENCH levels drift with the link, §8)
     serial_kw = dict(buckets=3, dense_m=12, snug=True,
@@ -693,6 +762,14 @@ def main(argv=None) -> None:
                     infer_e2e_mdev, 1),
                 "inference_multidev_vs_single": round(
                     infer_e2e_mdev / max(infer_e2e, 1.0), 3),
+                # mesh single-dispatch engine (ISSUE 10): same devices,
+                # same session — the in-session engine ratio is the
+                # result (>= 1.0 expected on accelerator backends;
+                # report-only on CPU where 'auto' is one device)
+                "inference_e2e_mesh_structs_per_sec": round(
+                    infer_e2e_mesh, 1),
+                "inference_mesh_vs_deviceset": round(
+                    infer_e2e_mesh / max(infer_e2e_mdev, 1.0), 3),
                 # the pre-ISSUE-4 serial full-fidelity ingest, same
                 # session (the honest before/after; PERF.md §11)
                 "inference_e2e_serial_structs_per_sec": round(

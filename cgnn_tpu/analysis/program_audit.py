@@ -21,8 +21,14 @@ artifacts:
   the sanctioned observe/stream tap, and only in the telemetry=step
   program; every other custom-call target must be allowlisted;
 - **GA-IDENT** — the ladder produces exactly programs x rungs x forms
-  distinct programs, and no two differ only in burned-in constants
-  (the Python-scalar-leakage recompile shape);
+  distinct programs PER ENGINE (the mesh-sharded predict programs are
+  registered alongside the single-device ladder), and no two differ
+  only in burned-in constants (the Python-scalar-leakage recompile
+  shape);
+- **GA-SHARD** — a mesh-sharded program's per-device argument bytes
+  stay within the replicated-params + batch/N model, so a batch
+  silently replicated to every device (the classic NamedSharding
+  mistake) blocks CI;
 - the **roofline ledger** — per-program FLOPs, memory bytes, and peak
   temp memory from XLA ``cost_analysis``/``memory_analysis``, with
   arithmetic intensity, written to ``AUDIT_LEDGER.json`` and gated in
@@ -81,6 +87,18 @@ CHECKS = {
         "a registered entry program failed to lower for an unexpected "
         "reason (known backend gaps — e.g. this container's jax "
         "missing shard_map — are recorded as skips, not findings)."
+    ),
+    "GA-SHARD": (
+        "a mesh-sharded program's per-device argument bytes exceed the "
+        "replicated-params + batch/N model: the classic NamedSharding "
+        "mistake is staging the batch WITHOUT the batch-axis sharding "
+        "(or with P()), which silently replicates every staged byte to "
+        "every device — N x the H2D traffic and HBM of the sharded "
+        "layout with identical outputs, exactly the cost the mesh "
+        "engine (parallel/executor.py, ISSUE 10) exists to avoid. The "
+        "compiled executable's per-device argument_size_in_bytes is "
+        "budgeted against the analytic sharded model so that mistake "
+        "blocks CI."
     ),
     "GA-ROOFLINE": (
         "a byte-budgeted program's cost-analysis bytes exceed its "
@@ -165,6 +183,11 @@ class Program:
     # analytic HBM byte budget (0 = ungated): compiled cost-analysis
     # bytes above budget * GA-ROOFLINE's slack is a finding
     byte_budget: int = 0
+    # analytic PER-DEVICE argument-byte budget (0 = ungated): the
+    # GA-SHARD gate for mesh-sharded programs — replicated params +
+    # this device's 1/N batch slice; a silently replicated batch blows
+    # straight through it
+    arg_byte_budget: int = 0
 
 
 def abstract_avals(tree):
@@ -429,6 +452,48 @@ def build_entry_programs(config: AuditConfig | None = None,
     for (rung, form), batch_av in sorted(batch_avals.items()):
         add(f"predict/rung{rung}/{form}", pstep,
             (state_dense_av, batch_av))
+
+    # -- predict: the mesh-sharded engine dimension (ISSUE 10) — the
+    # same rungs x forms through the MeshExecutor single-dispatch
+    # program, GA-SHARD-budgeted so a silently replicated batch (the
+    # classic NamedSharding mistake) blocks CI. GA-IDENT's expected
+    # predict count accounts for this engine dimension below.
+    mesh_devices = 0
+    if len(jax.devices()) >= 2:
+        from cgnn_tpu.parallel.executor import MeshExecutor
+
+        executor = MeshExecutor(jax.devices())
+        mesh_devices = len(executor)
+        mesh_pred = executor.shard_predict(
+            make_predict_step(ladder.expander()))
+
+        def _aval_bytes(tree) -> int:
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                try:
+                    item = np.dtype(leaf.dtype).itemsize
+                except TypeError:
+                    item = 8  # PRNG key leaves (uint32[2] key data)
+                total += int(np.prod(leaf.shape, dtype=np.int64)) * item
+            return total
+
+        state_bytes = _aval_bytes(state_dense_av)
+        for (rung, form), batch_av in sorted(batch_avals.items()):
+            stacked_av = executor.abstract_stacked(batch_av)
+            # the sharded model: every device holds the full replicated
+            # state plus exactly its 1/N slice of the stacked batch
+            # (XLA drops unused state args, so this is an upper bound
+            # on the CORRECT layout and far below a replicated batch)
+            budget = state_bytes + _aval_bytes(stacked_av) // mesh_devices
+            programs.append(Program(
+                name=f"predict/mesh/rung{rung}/{form}",
+                jitted=mesh_pred, args=(state_dense_av, stacked_av),
+                arg_byte_budget=budget,
+            ))
+    else:
+        add_skip("predict/mesh",
+                 "the mesh-sharded predict program needs >= 2 devices "
+                 "(CI sets --xla_force_host_platform_device_count)")
     # -- the compact expander as its own program (the fused on-device
     # featurize the serving fast path rides on) --
     add("expander/rung0", jax.jit(make_expander(spec)),
@@ -437,7 +502,12 @@ def build_entry_programs(config: AuditConfig | None = None,
     meta = {
         "config": cfg.to_meta(),
         "ladder": ladder.to_meta(),
-        "predict_programs_expected": len(batch_avals),
+        # the engine dimension counts (GA-IDENT): the single-device
+        # ladder programs plus, where the backend has the devices, the
+        # mesh-sharded twin of every (rung, form)
+        "predict_programs_expected": len(batch_avals) * (
+            2 if mesh_devices else 1),
+        "mesh_devices": mesh_devices,
         "state_leaves": n_leaves,
         # the fused conv's analytic HBM model (ops/pallas_cgconv.py
         # fused_conv_hbm_bytes): the GA-ROOFLINE budget for the Pallas
@@ -719,6 +789,52 @@ def check_roofline_budget(p: Program, entry: dict) -> list[AuditFinding]:
     return []
 
 
+# GA-SHARD slack over the analytic per-device model: the budget already
+# over-counts (it charges the FULL state incl. optimizer leaves XLA
+# drops from a forward program), and a replicated batch lands N x the
+# batch term above it (N >= 2) — 1.5x headroom cannot false-positive on
+# layout padding yet cannot miss the replication it exists to catch.
+_SHARD_SLACK = 1.5
+
+
+def check_shard_budget(p: Program, mem) -> list[AuditFinding]:
+    if p.arg_byte_budget <= 0:
+        return []
+    if mem is None:
+        # memory analysis unavailable on this backend/jax: the gate
+        # would be VACUOUSLY green — report it instead of passing (same
+        # posture as GA-ROOFLINE's zero-bytes branch)
+        return [AuditFinding(
+            "GA-SHARD", p.name,
+            "memory_analysis() unavailable for a shard-budgeted "
+            "program — the replication gate cannot be checked on this "
+            "backend/jax; fix the measurement or drop the budget "
+            "explicitly.",
+        )]
+    args = int(getattr(mem, "argument_size_in_bytes", 0))
+    if args <= 0:
+        # a missing per-device argument size would make this gate
+        # vacuously green — the one failure mode a guard must not have
+        return [AuditFinding(
+            "GA-SHARD", p.name,
+            f"memory analysis reported {args} per-device argument "
+            f"bytes for a shard-budgeted program — the sharding gate "
+            f"cannot be checked on this backend/jax; fix the "
+            f"measurement or drop the budget explicitly.",
+        )]
+    if args > p.arg_byte_budget * _SHARD_SLACK:
+        return [AuditFinding(
+            "GA-SHARD", p.name,
+            f"per-device argument bytes {args:.3e} exceed the "
+            f"replicated-params + batch/N model "
+            f"({p.arg_byte_budget:.3e} x {_SHARD_SLACK} slack) — the "
+            f"batch is being REPLICATED to every device instead of "
+            f"batch-axis sharded (the NamedSharding mistake the mesh "
+            f"engine exists to avoid; parallel/executor.py).",
+        )]
+    return []
+
+
 def run_audit(config: AuditConfig | None = None, *, compile: bool = True,
               programs: list[Program] | None = None, meta: dict | None = None):
     """Lower + audit the entry-program registry.
@@ -763,9 +879,12 @@ def run_audit(config: AuditConfig | None = None, *, compile: bool = True,
             except Exception:  # noqa: BLE001
                 mem = None
             findings += check_donation_compiled(p, mem)
+            findings += check_shard_budget(p, mem)
             entry = roofline_entry(compiled)
             if p.byte_budget > 0:
                 entry["byte_budget"] = p.byte_budget
+            if p.arg_byte_budget > 0:
+                entry["arg_byte_budget"] = p.arg_byte_budget
             findings += check_roofline_budget(p, entry)
             ledger["programs"][p.name] = entry
     findings.sort(key=lambda f: (f.program, f.check))

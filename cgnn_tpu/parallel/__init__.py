@@ -24,6 +24,7 @@ from cgnn_tpu.parallel.data_parallel import (
     replicate_state,
     fit_data_parallel,
 )
+from cgnn_tpu.parallel.executor import MeshExecutor
 from cgnn_tpu.parallel.edge_parallel import (
     pad_edges_divisible,
     shard_batch,
@@ -46,6 +47,7 @@ __all__ = [
     "shard_leading_axis",
     "replicate_state",
     "fit_data_parallel",
+    "MeshExecutor",
     "pad_edges_divisible",
     "shard_batch",
     "make_edge_parallel_train_step",

@@ -162,10 +162,33 @@ def parallel_batches(
                 yield invariants.maybe_check_any(stack_batches(q), dense_m)
 
 
+def is_multiprocess_mesh(mesh: Mesh) -> bool:
+    """True when ``mesh`` spans devices of more than one jax process —
+    the multi-host DP case, where host-local staging must go through
+    the global-array layer (parallel/dist.py) because ``device_put``
+    can only address local devices."""
+    from cgnn_tpu.parallel import dist
+
+    if not dist.active():
+        return False
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
 def shard_leading_axis(tree, mesh: Mesh):
-    """device_put a stacked batch: leading axis split over every replica
-    (non-'graph') mesh axis."""
+    """Stage a stacked batch: leading axis split over every replica
+    (non-'graph') mesh axis.
+
+    Single-process: a plain sharded ``device_put``. Multi-process
+    (``jax.distributed``): ``tree`` is this HOST'S local ``[n_local,
+    ...]`` stack and the global batch is the process-order concatenation
+    of every host's stack (dist.shard_global) — the loader-side per-host
+    slicing of multi-host DP."""
     axes = _replica_axes(mesh)
+    if is_multiprocess_mesh(mesh):
+        from cgnn_tpu.parallel import dist
+
+        return dist.shard_global(tree, mesh, P(axes))
 
     def put(x):
         return jax.device_put(
@@ -297,7 +320,13 @@ def make_parallel_eval_step(
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
-    """Place every state leaf replicated across the mesh."""
+    """Place every state leaf replicated across the mesh (the
+    global-array path when the mesh spans processes — every host must
+    hold the identical state, which resume/restore guarantees)."""
+    if is_multiprocess_mesh(mesh):
+        from cgnn_tpu.parallel import dist
+
+        return dist.replicate_global(state, mesh)
     sharding = NamedSharding(mesh, P())
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), state
@@ -383,6 +412,19 @@ def fit_data_parallel(
     if dense_m is not None:
         edge_cap = node_cap * dense_m
     graph_shards = int(mesh.shape.get("graph", 1))
+    multiproc = is_multiprocess_mesh(mesh)
+    if multiproc:
+        if graph_shards > 1:
+            raise NotImplementedError(
+                "edge-sharded ('graph') meshes are single-host for now "
+                "(per-conv psums belong on ICI, not DCN)"
+            )
+        if scan_epochs or device_resident or pack_once:
+            raise NotImplementedError(
+                "multi-host DP runs the per-step loop (scan/"
+                "device-resident staging is host-local); drop "
+                "--scan-epochs/--device-resident/--pack-once"
+            )
     if graph_shards > 1 and profile_steps:
         raise NotImplementedError(
             "--profile is not supported with edge-sharded ('graph') "
@@ -439,6 +481,14 @@ def fit_data_parallel(
         shard_put = lambda b: shard_stacked_batch(b, mesh)  # noqa: E731
     else:
         n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        if multiproc:
+            # each host packs device groups for its LOCAL share of the
+            # mesh; the global batch is the process-order concatenation
+            # (shard_leading_axis stages it as one global array). The
+            # CALLER host-shards the graphs (dist.host_shard) so hosts
+            # pack disjoint data.
+            n_dev_global = n_dev
+            n_dev = max(1, n_dev // jax.process_count())
         train_step = make_parallel_train_step(
             mesh, classification, inner_step=train_step_fn,
             grad_health=telemetry.step_level, guard=guard,
@@ -485,6 +535,32 @@ def fit_data_parallel(
             snug=snug, edge_dtype=edge_dtype,
             prep_fn=prep_val, node_multiple=node_multiple,
         )
+
+    if multiproc:
+        from cgnn_tpu.parallel import dist
+
+        _base_train_it, _base_val_it = make_train_it, make_val_it
+
+        def _equalized(base):
+            # every host must run the SAME number of collective steps:
+            # a host whose shard packed one more device group than its
+            # peers would enter an allreduce nobody else joins (hang,
+            # not error) — truncate every epoch to the shortest host.
+            # COST, stated honestly: the count requires packing the
+            # epoch, so the stacked batches materialize in host RAM up
+            # front and the prefetch pack/compute overlap is lost for
+            # multi-host runs (a second packing pass can't replace it:
+            # the shuffled pack is rng-drawn, so two passes disagree on
+            # the count itself). Fine at readiness scale; a streaming
+            # upgrade needs a deterministic batch-count plan.
+            batches = list(base())
+            return iter(batches[: dist.min_over_hosts(len(batches))])
+
+        def make_train_it():
+            return _equalized(_base_train_it)
+
+        def make_val_it():
+            return _equalized(_base_val_it)
 
     driver: ScanEpochDriver | None = None
     packed_lists: tuple | None = None
@@ -664,7 +740,8 @@ def fit_data_parallel(
         if is_best:
             best = metric
         history.append({"epoch": epoch, "train_loss": train_loss, "val": val_m})
-        tag = f"dp x{n_dev}" + (
+        tag = (f"dp x{n_dev_global} over {jax.process_count()} hosts"
+               if multiproc else f"dp x{n_dev}") + (
             f" * graph x{graph_shards}" if graph_shards > 1 else ""
         )
         log_fn(

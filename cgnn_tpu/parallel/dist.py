@@ -1,0 +1,340 @@
+"""Multi-host readiness: ``jax.distributed`` lifecycle + cross-host
+coordination helpers (ISSUE 10, ROADMAP item 1).
+
+One process per host, every process running the SAME program over a
+GLOBAL device mesh — that is the jax multi-controller model this module
+wraps. The pieces, each of which the 2-process CPU dryrun
+(``scripts/multihost_smoke.sh``, CI ``multihost-dryrun``) exercises
+in-container:
+
+- **Lifecycle**: :func:`initialize` / :func:`initialize_from_env` wire
+  ``jax.distributed.initialize`` (coordinator address + process id/count
+  from ``CGNN_TPU_COORDINATOR`` / ``CGNN_TPU_NUM_PROCESSES`` /
+  ``CGNN_TPU_PROCESS_ID``). On a CPU backend the gloo cross-process
+  collectives implementation is selected FIRST — the default CPU backend
+  cannot run multiprocess computations at all, and the option only takes
+  effect before the backend initializes.
+- **Data**: :func:`host_shard` gives each host its strided slice of the
+  dataset — disjoint and complete by construction (pinned by test), the
+  per-host slicing the loader-side of multi-host DP rides on;
+  :func:`shard_global` / :func:`replicate_global` stage host-local
+  arrays as global jax Arrays over a multi-process mesh (the
+  ``device_put`` twins in data_parallel.py only address local devices).
+- **Coordination**: :func:`barrier` (named sync over all processes),
+  :func:`broadcast_str` (process 0 -> everyone), and
+  :class:`ReloadCoordinator` — the cross-host hot-reload agreement:
+  process 0 names the save to swap to, non-zero hosts WAIT until they
+  see that save's commit marker on their own filesystem view, and every
+  process swaps after one shared barrier, so a mid-run reload lands
+  version-consistent everywhere.
+- **Checkpointing**: :func:`is_coordinator` gates saves — exactly one
+  committer per run (train.py skips saves on non-zero processes), so
+  two hosts can never race the versioned-save sequence.
+
+Collectives are blocking and must be called by EVERY process in the
+same order: drive :class:`ReloadCoordinator` from lockstep
+``poll_once`` loops (the smoke does), not from free-running watcher
+threads with different lifetimes.
+
+Everything degrades to a no-op in a single-process run: ``active()`` is
+False, ``barrier`` returns immediately, ``host_shard`` returns the
+whole sequence — so the same entrypoints run unchanged on one host.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Sequence
+
+_initialized = False
+
+_ENV_COORD = "CGNN_TPU_COORDINATOR"
+_ENV_NPROC = "CGNN_TPU_NUM_PROCESSES"
+_ENV_PID = "CGNN_TPU_PROCESS_ID"
+
+# fixed wire width for broadcast_str (save names are ckpt-%08d, 13
+# chars; 256 leaves room for tags/paths without a variable-size
+# collective)
+_STR_BYTES = 256
+
+
+def configured_env() -> dict | None:
+    """The multi-host env config, or None when unset/incomplete."""
+    coord = os.environ.get(_ENV_COORD, "")
+    if not coord:
+        return None
+    try:
+        nproc = int(os.environ[_ENV_NPROC])
+        pid = int(os.environ[_ENV_PID])
+    except (KeyError, ValueError):
+        raise ValueError(
+            f"{_ENV_COORD} is set but {_ENV_NPROC}/{_ENV_PID} are not "
+            f"both integers — all three configure a multi-host run"
+        ) from None
+    return {"coordinator": coord, "num_processes": nproc, "process_id": pid}
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int,
+               log_fn: Callable = print) -> None:
+    """``jax.distributed.initialize`` with the CPU-collectives fix.
+
+    Must run before any jax computation touches a backend. Idempotent
+    per process (a second call is a no-op)."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    if num_processes < 2:
+        raise ValueError(f"num_processes must be >= 2, got {num_processes}")
+    # the default CPU backend refuses multiprocess computations; gloo is
+    # the jaxlib-bundled cross-process implementation. Set BEFORE
+    # initialize — after backend init the option is a silent no-op.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or (
+        not os.environ.get("JAX_PLATFORMS")
+    ):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — option absent on some jaxlibs
+            pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log_fn(
+        f"dist: process {jax.process_index()}/{jax.process_count()} up "
+        f"(coordinator {coordinator}; {len(jax.local_devices())} local / "
+        f"{len(jax.devices())} global devices)"
+    )
+
+
+def initialize_from_env(log_fn: Callable = print) -> bool:
+    """Initialize iff the CGNN_TPU_* env triple is set -> did it."""
+    cfg = configured_env()
+    if cfg is None:
+        return False
+    initialize(cfg["coordinator"], cfg["num_processes"],
+               cfg["process_id"], log_fn=log_fn)
+    return True
+
+
+def active() -> bool:
+    """True in a live multi-process run (>= 2 jax processes)."""
+    if not _initialized:
+        return False
+    import jax
+
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index() if _initialized else 0
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count() if _initialized else 1
+
+
+def is_coordinator() -> bool:
+    """Process 0 — the ONE checkpoint committer of a multi-host run."""
+    return process_index() == 0
+
+
+def host_shard(seq: Sequence, index: int | None = None,
+               count: int | None = None) -> list:
+    """This host's strided slice of ``seq`` — the per-host data split.
+
+    Strided (``seq[i::n]``) rather than contiguous: shard sizes differ
+    by at most one, and the union over all hosts is exactly ``seq``
+    (disjoint and complete; pinned by test_executor). A no-op (full
+    copy) in single-process runs."""
+    i = process_index() if index is None else index
+    n = process_count() if count is None else count
+    if i < 0 or i >= n:
+        raise ValueError(f"host_shard index {i} outside [0, {n})")
+    return list(seq[i::n])
+
+
+# ---- collectives ------------------------------------------------------
+
+
+def barrier(name: str) -> None:
+    """Block until every process reaches this named point (no-op when
+    single-process). Names must match across processes."""
+    if not active():
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_str(value: str) -> str:
+    """Process 0's ``value`` on every process (fixed 256-slot wire).
+
+    One int32 slot per byte: the broadcast collective promotes sub-word
+    dtypes to int32 on this backend (measured: a uint8 buffer comes
+    back byte-spread), so encode at word width from the start."""
+    if not active():
+        return value
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    raw = value.encode()[:_STR_BYTES]
+    buf = np.zeros(_STR_BYTES, np.int32)
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8).astype(np.int32)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return bytes(out[out != 0].astype(np.uint8)).decode()
+
+
+def min_over_hosts(value: int) -> int:
+    """min(value) across processes — the step-count equalizer: every
+    host must run the SAME number of collective steps per epoch, so the
+    per-epoch batch list truncates to the shortest host's count."""
+    if not active():
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([value], np.int64))
+    return int(np.min(gathered))
+
+
+# ---- global-array staging --------------------------------------------
+
+
+def _is_key(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array) and hasattr(x, "dtype") and (
+        getattr(x.dtype, "name", "").startswith("key")
+    )
+
+
+def _tree_global(tree, mesh, spec):
+    """host-local leaves -> global Arrays over ``mesh`` under ``spec``.
+
+    PRNG key leaves ride as raw key data (the multihost staging
+    primitive rejects typed key arrays) and are re-wrapped after."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    keys = {}
+
+    def strip(path, x):
+        if _is_key(x):
+            keys[path] = True
+            return jax.random.key_data(x)
+        return x
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    stripped = [strip(p, x) for p, x in flat]
+    # true host copies, not CPU-aliasing views (GC-ALIAS): the staged
+    # global arrays must not share memory with buffers a donated step
+    # may later reuse
+    host = jax.tree_util.tree_map(np.array, jax.device_get(stripped))
+    out = multihost_utils.host_local_array_to_global_array(
+        host, mesh, spec)
+    rewrapped = [
+        jax.random.wrap_key_data(x) if flat[i][0] in keys else x
+        for i, x in enumerate(out)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, rewrapped)
+
+
+def replicate_global(tree, mesh):
+    """Replicated placement over a multi-process mesh (the
+    ``device_put(x, NamedSharding(mesh, P()))`` twin — device_put cannot
+    address another process's devices). Inputs must be identical on
+    every host (the multihost staging layer asserts it)."""
+    from jax.sharding import PartitionSpec as P
+
+    return _tree_global(tree, mesh, P())
+
+
+def shard_global(local_stack, mesh, spec):
+    """This host's ``[n_local, ...]`` stack -> the global batch-sharded
+    array (leading axis = concatenation of every host's stack in
+    process order)."""
+    return _tree_global(local_stack, mesh, spec)
+
+
+def localize(tree):
+    """Global (replicated) arrays -> host-local numpy-backed leaves, so
+    a post-fit state can feed single-device programs (final test eval,
+    checkpoint template restores). PRNG keys survive round-trip."""
+    import jax
+    import numpy as np
+
+    def pull(x):
+        if _is_key(x):
+            # np.array, not asarray: a true copy (CPU device_get
+            # ALIASES device buffers — GC-ALIAS)
+            return jax.random.wrap_key_data(
+                np.array(jax.device_get(jax.random.key_data(x))))
+        if isinstance(x, jax.Array):
+            return np.array(jax.device_get(x))
+        return x
+
+    return jax.tree_util.tree_map(pull, tree)
+
+
+# ---- cross-host hot reload -------------------------------------------
+
+
+class ReloadCoordinator:
+    """Cross-host agreement on which committed save to hot-swap to.
+
+    Plugs into ``serve.reload.CheckpointWatcher(coordinator=...)``:
+    every ``poll_once`` on every process calls this with the newest
+    committed save it sees locally (or None). Process 0's view wins —
+    it broadcasts the candidate name; non-zero hosts then WAIT (bounded)
+    until their own filesystem view shows that save's commit marker
+    (shared-filesystem lag is real), and everyone swaps only after one
+    shared barrier. Returns the agreed name, or None for "no swap this
+    round" — which is itself an agreement: no host swaps early.
+
+    Each call is a COLLECTIVE: every process must poll in lockstep
+    (drive poll_once from a shared-cadence loop, as the multihost smoke
+    does; a free-running watcher thread that dies mid-collective hangs
+    its peers).
+    """
+
+    def __init__(self, manager, *, visibility_timeout_s: float = 30.0,
+                 log_fn: Callable = print):
+        self._mgr = manager
+        self._timeout = visibility_timeout_s
+        self._log = log_fn
+        self._round = 0
+
+    def __call__(self, newest: str | None) -> str | None:
+        self._round += 1
+        if not active():
+            return newest
+        agreed = broadcast_str((newest or "") if is_coordinator() else "")
+        if not agreed:
+            # collective no-op round: everyone agreed there is nothing
+            # to swap to (keeps the per-poll collective count aligned)
+            barrier(f"cgnn-reload-idle-{self._round}")
+            return None
+        deadline = time.monotonic() + self._timeout
+        while not self._mgr.is_committed(agreed):
+            # the non-zero-host wait on the commit marker: process 0
+            # saw the manifest; this host's fs view may lag behind it
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"process {process_index()} never saw the commit "
+                    f"marker of {agreed} within {self._timeout}s — "
+                    f"shared checkpoint directory out of sync"
+                )
+            time.sleep(0.05)
+        barrier(f"cgnn-reload-{agreed}-{self._round}")
+        return agreed

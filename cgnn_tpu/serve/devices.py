@@ -1,11 +1,24 @@
-"""Device-parallel forward dispatch: the DeviceSet behind serving and
-fast inference (ISSUE 5).
+"""Device inventory + thread-per-device dispatch accounting (ISSUE 5).
 
 The CGCNN workload is embarrassingly parallel at inference — independent
 graphs, no cross-request state — yet until this module both forward
 paths dispatched every batch to ``jax.devices()[0]``, idling every other
-chip on a multi-chip host. ``DeviceSet`` makes the device dimension a
-first-class part of the dispatch layer:
+chip on a multi-chip host. ``DeviceSet`` made the device dimension a
+first-class part of the dispatch layer.
+
+ENGINE NOTE (ISSUE 10): thread-per-device dispatch is no longer the
+only — or the default — multi-device engine. The default for a
+multi-device set is the MESH engine (``parallel/executor.py``): one
+``Mesh`` + ``NamedSharding`` jitted program per (rung, form, tier)
+whose single batch-sharded dispatch covers every device — no router,
+no per-device threads, compile count = programs (not programs x N),
+one sharded param tree per tier, and the same layer extends multi-host
+via ``jax.distributed`` (``parallel/dist.py``). The DeviceSet dispatch
+path stays available behind ``--engine threads`` as the A/B baseline,
+and this module's ACCOUNTING (per-device dispatch/occupancy stats)
+serves both engines — under mesh dispatch the "device" rows are the
+mesh shards. The replica-dispatch description below documents the
+threads engine:
 
 - **Replicated programs.** ONE jitted ``predict_step`` is shared across
   the set. Dispatch targets a device by computation-follows-data: the
@@ -35,10 +48,13 @@ Device-awareness default (the PR-4 lesson, third time paying off):
 ``resolve_devices('auto')`` is ALL local devices on an accelerator
 backend but a SINGLE device on CPU — host-platform "devices" are slices
 of the same cores, so fanning out over them just adds dispatch overhead
-and thread contention to the compute they share. An explicit count
-(``--devices N``) forces distribution anywhere, which is how the
-8-host-device dryrun (``--xla_force_host_platform_device_count=8``, the
-MULTICHIP pattern) proves distribution, parity, and swap invariants
+and thread contention to the compute they share. The CPU ``auto`` rule
+applies to WHICH devices are used; the ``--engine`` flag picks how a
+multi-device set is driven (mesh by default, threads for the A/B). An
+explicit count (``--devices N``) forces distribution anywhere, which is
+how the 8-host-device dryruns
+(``--xla_force_host_platform_device_count=8``, the MULTICHIP pattern)
+prove distribution, parity, and swap invariants for both engines
 in-container.
 """
 

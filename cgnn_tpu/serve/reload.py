@@ -46,13 +46,25 @@ class ParamStore:
     """
 
     def __init__(self, state, version: str = "init", devices=None,
-                 tier_specs=None):
+                 tier_specs=None, placer=None):
         # precision tiers (serve/quantize.py): {tier: TierSpec} built
         # ONCE by the server. Each swap re-derives every tier's state
         # through the SAME spec (stable apply_fn identity), so a hot
         # reload can never retrace a warmed program. None = f32 only.
+        #
+        # ``placer`` (the mesh engine, parallel/executor.py): a
+        # state -> placed-state callable replacing per-device
+        # replication with ONE sharded (mesh-replicated) tree per tier —
+        # get(0, tier) returns it, and a hot swap publishes one tree
+        # under one version instead of an N-replica tuple.
+        if placer is not None and devices is not None:
+            raise ValueError(
+                "ParamStore takes devices (per-replica mode) OR placer "
+                "(one sharded tree), not both"
+            )
         self._lock = racecheck.make_lock("serve.paramstore")
         self._devices = tuple(devices) if devices else None
+        self._placer = placer
         self._specs = dict(tier_specs) if tier_specs else None
         self._states = self._build(state)
         self._version = version
@@ -68,6 +80,10 @@ class ParamStore:
         return {t: self._replicate(s) for t, s in tiers.items()}
 
     def _replicate(self, state) -> tuple:
+        if self._placer is not None:
+            # mesh engine: ONE mesh-placed tree; every dispatch reads
+            # slot 0 (the mesh, not the store, owns device placement)
+            return (self._placer(state),)
         if self._devices is None:
             return (state,)
         from cgnn_tpu.serve.devices import replicate_state
@@ -116,6 +132,7 @@ class CheckpointWatcher:
         poll_interval_s: float = 2.0,
         telemetry=None,
         on_swap: Callable | None = None,
+        coordinator: Callable | None = None,
         log_fn: Callable | None = None,
     ):
         self._mgr = manager
@@ -124,6 +141,12 @@ class CheckpointWatcher:
         self.poll_interval = poll_interval_s
         self._telemetry = telemetry
         self._on_swap = on_swap
+        # cross-host agreement hook (parallel/dist.ReloadCoordinator):
+        # called EVERY poll with the locally-newest committed save; what
+        # it returns is what this host swaps to (None = not this round).
+        # Each call is a collective in multi-host runs — drive poll_once
+        # in lockstep across processes when one is set.
+        self._coordinator = coordinator
         self._log = log_fn or (lambda m: print(m, file=sys.stderr))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -140,8 +163,17 @@ class CheckpointWatcher:
         Returns True iff a swap happened. Never raises on a bad
         checkpoint — it logs the skip report, counts it, and keeps
         serving the current params (a corrupt upload must not take the
-        serving path down)."""
+        serving path down). A CROSS-HOST COORDINATION failure (only
+        possible with a ``coordinator``) does raise: a shared checkpoint
+        directory that never shows the agreed commit marker is a fatal
+        desync, and swallowing it would leave the peer hosts blocked at
+        the swap barrier — loud beats silently hung."""
         newest = self._mgr.newest_committed()
+        if self._coordinator is not None:
+            # multi-host: every host polls in lockstep and swaps only to
+            # the save process 0 announced, after the shared barrier —
+            # a reload lands version-consistent on every process
+            newest = self._coordinator(newest)
         if newest is None or newest == self._store.version:
             return False
         if newest in self._skipped:
@@ -150,12 +182,22 @@ class CheckpointWatcher:
             state = self._mgr.restore_for_inference(self._template, newest)
         except Exception as e:  # noqa: BLE001 — skip, keep serving
             self.skips += 1
-            self._skipped.add(newest)
+            if self._coordinator is None:
+                # single-host: a verified-bad save stays bad — never
+                # hot-retried. Under a coordinator the peers already
+                # swapped past the shared barrier, so a transient
+                # restore failure here (fs lag on a blob) must RETRY
+                # next round or this host serves stale params forever
+                # while reporting nothing — the exact divergence the
+                # coordinator exists to prevent.
+                self._skipped.add(newest)
             report = "; ".join(self._mgr.last_restore_report) or repr(e)
             self._log(
                 f"hot reload: SKIPPING {newest} (integrity/restore "
                 f"failure: {report}); still serving "
                 f"{self._store.version}"
+                + ("" if self._coordinator is None
+                   else "; will retry next coordinated round")
             )
             if self._telemetry is not None:
                 self._telemetry.counter_add("serve_reload_skipped", 1)
@@ -173,6 +215,17 @@ class CheckpointWatcher:
     # ---- the background thread ----
 
     def start(self) -> "CheckpointWatcher":
+        if self._coordinator is not None:
+            # coordinated polls are COLLECTIVES: a free-running daemon
+            # thread on its own timer enters a blocking collective while
+            # its peers sleep (or after one died) and hangs every host.
+            # Drive poll_once from a lockstep loop instead — the
+            # multihost smoke's probe is the pattern.
+            raise ValueError(
+                "a coordinated watcher must be driven by lockstep "
+                "poll_once() calls, not the background thread "
+                "(scripts/multihost_reload_probe.py)"
+            )
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._thread = threading.Thread(

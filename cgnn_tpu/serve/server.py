@@ -24,10 +24,14 @@ Request lifecycle::
         -> resolve each future with (row, version, latency, device_id)
       (so the batcher coalesces flush N+2 while N+1 packs and N runs;
        pack_workers=0 runs the same stages in-line on one thread)
-    with devices > 1 (serve/devices.py, ISSUE 5): a router assigns each
-      packed flush to the least-loaded device and one dispatch thread
-      PER device runs the dispatch stage against that device's param
-      replica — N chips serve concurrently from one batcher
+    with devices > 1 the ENGINE decides how the set is driven
+      (ISSUE 10): the default 'mesh' engine splits each flush
+      round-robin across a Mesh + NamedSharding layout and ONE sharded
+      jitted dispatch covers every device (no router, no per-device
+      threads; parallel/executor.py); 'threads' keeps the ISSUE-5
+      DeviceSet layer — a router assigns each packed flush to the
+      least-loaded device and one dispatch thread PER device runs it
+      against that device's param replica
 
 Hot reload safety rides on the ``param_store.get()`` placement: the pair
 is read once per batch, so a watcher swap lands cleanly between batches
@@ -141,6 +145,7 @@ class InferenceServer:
         cache_size: int = 1024,
         pack_workers: int = 1,
         devices=None,
+        engine: str = "auto",
         precisions: Sequence[str] = ("f32",),
         model=None,
         clock: Callable[[], float] = time.monotonic,
@@ -152,11 +157,44 @@ class InferenceServer:
         from cgnn_tpu.train.step import make_predict_step
 
         self.shape_set = shape_set
-        # the device-parallel dispatch layer (serve/devices.py): one
-        # param replica per device, flushes routed least-loaded across
-        # the set; None = the backend-aware 'auto' resolution (all
-        # accelerator devices; single device on CPU backends)
+        # the device inventory + per-device accounting (serve/devices.py);
+        # None = the backend-aware 'auto' resolution (all accelerator
+        # devices; single device on CPU backends). How the devices are
+        # DRIVEN is the engine's choice below.
         self.device_set = DeviceSet(devices)
+        # execution engine over the device set (ISSUE 10):
+        # - 'mesh' (the default with > 1 device): ONE Mesh+NamedSharding
+        #   jitted program per (rung, form, tier) whose single dispatch
+        #   covers every device — flushes split batch-axis across the
+        #   mesh, params live as one replicated tree, no router and no
+        #   per-device dispatch threads (parallel/executor.py);
+        # - 'threads' (the ISSUE-5 layer, kept for the A/B): per-device
+        #   param replicas, least-loaded router, one dispatch thread per
+        #   device, programs x N executables.
+        # With one device both engines degenerate to the single-device
+        # dispatch loop; 'auto' resolves to 'mesh' on a real multi-device
+        # set and leaves single-device servers on the classic path.
+        if engine not in ("auto", "mesh", "threads"):
+            raise ValueError(
+                f"engine must be 'auto', 'mesh', or 'threads', "
+                f"got {engine!r}"
+            )
+        if engine == "auto":
+            engine = "mesh" if len(self.device_set) > 1 else "threads"
+        self.mesh_exec = None
+        if engine == "mesh" and len(self.device_set) > 1:
+            from cgnn_tpu.parallel.executor import MeshExecutor
+
+            self.mesh_exec = MeshExecutor(self.device_set.devices)
+        # report what actually RUNS, not what was requested: a forced
+        # 'mesh' on a 1-device set takes the single-device loop, and
+        # stats claiming otherwise would let a dryrun assert an engine
+        # that never dispatched
+        if len(self.device_set) == 1:
+            engine = "single"
+        elif self.mesh_exec is None:
+            engine = "threads"
+        self.engine = engine
         # precision tiers (serve/quantize.py): the warmed set a request
         # picks from. 'f32' (the native program) is always present —
         # it is the default tier and the parity baseline. Tier states
@@ -175,16 +213,33 @@ class InferenceServer:
                 )
             tier_specs = build_tier_specs(model, tiers)
         self.precisions = tiers
-        self.param_store = ParamStore(state, version,
-                                      devices=self.device_set.devices,
-                                      tier_specs=tier_specs)
+        if self.mesh_exec is not None:
+            # mesh engine: the store holds ONE mesh-replicated tree per
+            # tier (get(0, tier)); a hot swap publishes one sharded
+            # param tree under one version — no replica tuples
+            self.param_store = ParamStore(
+                state, version, tier_specs=tier_specs,
+                placer=self.mesh_exec.place_params,
+            )
+        else:
+            self.param_store = ParamStore(state, version,
+                                          devices=self.device_set.devices,
+                                          tier_specs=tier_specs)
         # a compact shape set rebuilds GraphBatches INSIDE the compiled
         # program (expander); the same jitted callable still accepts
         # full-fidelity batches — the fallback for non-compactable
         # requests (both forms are warmed, so neither ever recompiles)
-        self.predict_step = predict_step or jax.jit(
-            make_predict_step(shape_set.expander())
-        )
+        predict_body = make_predict_step(shape_set.expander())
+        self.predict_step = predict_step or jax.jit(predict_body)
+        # the mesh engine's one-dispatch-covers-all-devices program
+        # (parallel/executor.py): per (rung, form, tier) there is ONE
+        # cache entry and ONE multi-device executable. An injected
+        # predict_step is wrapped so the body stays shared.
+        self.mesh_predict = None
+        if self.mesh_exec is not None:
+            self.mesh_predict = self.mesh_exec.shard_predict(
+                predict_step or predict_body
+            )
         # pack pipeline threads between the batcher and the dispatch
         # loop (data/pipeline.py): packing comes off the flush/dispatch
         # thread so the batcher coalesces the NEXT flush while the
@@ -281,6 +336,22 @@ class InferenceServer:
                 batch = self.shape_set.pack([template], shape=shape)
                 full = (self.shape_set.pack_full([template], shape=shape)
                         if self.shape_set.compact is not None else None)
+                if self.mesh_exec is not None:
+                    # mesh engine: the warmed program IS the stacked
+                    # sharded one — one dispatch covers every device, so
+                    # the compile count is programs, never programs x N
+                    n = len(self.mesh_exec)
+                    forms = [self.mesh_exec.stage(
+                        self.mesh_exec.stack([batch] * n))]
+                    if full is not None:
+                        forms.append(self.mesh_exec.stage(
+                            self.mesh_exec.stack([full] * n)))
+                    for tier in self.precisions:
+                        state, _ = self.param_store.get(0, tier)
+                        for staged in forms:
+                            np.asarray(self.mesh_predict(state, staged))
+                        programs += len(forms)
+                    continue
                 for tier in self.precisions:
                     for i in range(len(self.device_set)):
                         state, _ = self.param_store.get(i, tier)
@@ -292,7 +363,8 @@ class InferenceServer:
         compiled = (self._jit_cache_size() or 0) - (n0 or 0)
         self._log(
             f"serve: warmed {len(self.shape_set)} shapes / {programs} "
-            f"programs on {len(self.device_set)} device(s) / "
+            f"programs on {len(self.device_set)} device(s) "
+            f"[{self.engine} engine] / "
             f"{len(self.precisions)} precision tier(s) "
             f"({compiled} fresh compiles"
             f"{', compact-staged' if self.shape_set.compact else ''})"
@@ -300,9 +372,15 @@ class InferenceServer:
         return compiled
 
     def _jit_cache_size(self) -> int | None:
-        """The jit cache-miss counter (None when the fn isn't a jax.jit)."""
+        """The jit cache-miss counter (None when the fn isn't a jax.jit).
+
+        Under the mesh engine the dispatched program is
+        ``mesh_predict`` — its cache is the one whose growth after
+        warmup would be a recompile."""
+        fn = self.mesh_predict if self.mesh_exec is not None \
+            else self.predict_step
         try:
-            return int(self.predict_step._cache_size())
+            return int(fn._cache_size())
         except AttributeError:
             return None
 
@@ -377,6 +455,7 @@ class InferenceServer:
             "serve_rolling_window_s": self.rolling_window_s,
             "pipeline_pack_workers": float(self._pack_workers),
             "device_count": float(len(self.device_set)),
+            "serve_engine_mesh": float(self.mesh_exec is not None),
         }
         for i, depth in enumerate(self.device_set.inflight_depths()):
             gauges[f"device{i}_inflight"] = float(depth)
@@ -614,6 +693,8 @@ class InferenceServer:
     # ---- the worker ----
 
     def _serve_loop(self) -> None:
+        if self.mesh_exec is not None:
+            return self._serve_loop_mesh()
         if len(self.device_set) > 1:
             return self._serve_loop_multidev()
         if self._pack_workers > 0:
@@ -780,6 +861,148 @@ class InferenceServer:
             for t in workers:
                 t.join()
 
+    def _serve_loop_mesh(self) -> None:
+        """The mesh-engine worker (ISSUE 10): batcher -> packer pool ->
+        ONE sharded dispatch per flush.
+
+        Each packed flush is already split round-robin across the mesh
+        (``_pack_flush``): per-shard sub-batches of one common rung,
+        stacked on the device axis. The single dispatch thread stages
+        the stack batch-axis-sharded (each device receives exactly its
+        slice) and runs ONE jitted call that covers every device — the
+        least-loaded router, the per-device queues, and the N dispatch
+        threads of the threads engine do not exist here. FIFO response
+        order is global (one dispatch stream), and the hot-swap boundary
+        is unchanged: one (params, version) read per flush, now of the
+        single sharded tree.
+        """
+        stream = self._packed_stream(None)  # mesh packs fresh stacks;
+        #                                     the pooled-buffer recycle
+        #                                     contract belongs to the
+        #                                     per-device engines
+        while True:
+            racecheck.heartbeat()
+            t0 = time.perf_counter()
+            try:
+                item = next(stream)
+            except StopIteration:
+                return
+            except Exception as e:  # noqa: BLE001 — keep serving
+                self._log(f"serve: pack pipeline error: {e!r}")
+                continue
+            self.telemetry.observe_value("pipeline_wait_s",
+                                         time.perf_counter() - t0)
+            self._run_flush_mesh(*item)
+
+    def _run_flush_mesh(self, flush: Flush, packed, buf, err) -> None:
+        """Mesh twin of ``_run_flush``: one dispatch serves every shard,
+        so accounting touches every shard the split populated, and a
+        failed flush still fails alone."""
+        counts = packed[1] if packed is not None else []
+        shards = [i for i, c in enumerate(counts) if c > 0]
+        for i in shards:
+            self.device_set.note_enqueue(i)
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            if err is not None:
+                raise err
+            self._dispatch_flush_mesh(flush, packed)
+            ok = True
+        except Exception as e:  # noqa: BLE001 — fail the flush, not the server
+            self._log(f"serve: batch failed (mesh): {e!r}")
+            for r in flush.requests:
+                if not r.future.done():
+                    r.future.set_error(e)
+        finally:
+            busy = time.perf_counter() - t0
+            # the shards ran CONCURRENTLY under one dispatch: each
+            # participating shard was busy for the flush wall, which
+            # keeps per-device occupancy comparable with the threads
+            # engine's per-flush accounting
+            for i in shards:
+                self.device_set.note_complete(i, busy, ok=ok)
+
+    def _dispatch_flush_mesh(self, flush: Flush, packed) -> None:
+        import jax
+
+        stacked, counts, sub_shape = packed
+        n = len(self.mesh_exec)
+        reqs = flush.requests
+        tier = flush.precision
+        # the hot-swap boundary: ONE (sharded params, version) pair read
+        # per flush — a reload landing after this line affects the NEXT
+        # flush; this one keeps its dispatch-time tree alive by reference
+        state, version = self.param_store.get(0, tier)
+        pre = self._jit_cache_size()
+        dispatched = self._stamp()
+        flush.stamps["dispatched"] = dispatched
+        staged = self.mesh_exec.stage(stacked)
+        # np.array: a true host copy of the gathered [N, G, T] result
+        # (device_get ALIASES device buffers on CPU — GC-ALIAS)
+        out = np.array(jax.device_get(self.mesh_predict(state, staged)))
+        fetched = self._stamp()
+        flush.stamps["fetched"] = fetched
+        post = self._jit_cache_size()
+        if self.warmed and pre is not None and post is not None and post > pre:
+            with self._lock:
+                self._compiles_after_warm += post - pre
+            self.telemetry.counter_add("serve_recompiles_after_warm",
+                                       post - pre)
+            self._log(
+                f"serve: UNEXPECTED recompile after warmup "
+                f"(mesh shape {sub_shape}); latency SLO was broken "
+                f"this batch"
+            )
+        if self.telemetry.spans is not None:  # skip arg-building when off
+            self._span("serve.dispatch", dispatched, fetched,
+                       flush_id=flush.flush_id, engine="mesh", shards=n,
+                       shape=str(sub_shape), trace_ids=flush.trace_ids())
+        now = self._clock()
+        # real graphs over the slots the mesh dispatch actually ran
+        occupancy = len(reqs) / (n * sub_shape.graph_cap)
+        for i, c in enumerate(counts):
+            if c > 0:
+                self._count(f"batches_device{i}")
+        for j, r in enumerate(reqs):
+            # request j sat at (shard j % N, row j // N): the
+            # round-robin split coordinate (executor.split_round_robin)
+            shard, row = j % n, j // n
+            prediction = out[shard, row].copy()
+            latency_ms = (now - r.enqueued) * 1e3
+            if self.cache is not None and r.fingerprint is not None:
+                self.cache.put(r.fingerprint, (prediction, version))
+            replied = self._stamp()
+            stamps = {**r.stamps, **flush.stamps, "replied": replied}
+            r.future.set_result(ServeResult(
+                prediction=prediction, param_version=version,
+                latency_ms=latency_ms, batch_occupancy=occupancy,
+                device_id=shard, trace_id=r.trace_id, precision=tier,
+                flush_id=flush.flush_id, stamps=stamps,
+            ))
+            if self.telemetry.spans is not None:  # skip arg-building when off
+                self._span("serve.request", stamps["queued"], replied,
+                           trace_id=r.trace_id, flush_id=flush.flush_id,
+                           device=shard,
+                           queue_ms=round(
+                               (stamps["packed"] - stamps["queued"]) * 1e3,
+                               3),
+                           dispatch_ms=round((fetched - dispatched) * 1e3,
+                                             3))
+            self._record_latency(latency_ms)
+            self._lat_rolling.add(latency_ms)
+            self.telemetry.observe_value("serve_latency_ms", latency_ms)
+            self._count("responses")
+            if tier != "f32":
+                self._count(f"responses_{tier}")
+        self._count("batches")
+        with self._lock:
+            self._occupancies.append(occupancy)
+            del self._occupancies[:-4096]
+        self._occ_rolling.add(occupancy)
+        self.telemetry.observe_value("serve_batch_occupancy", occupancy)
+        self.telemetry.set_gauge("serve_queue_depth", self.batcher.depth)
+
     def _fail_expired(self, flush: Flush) -> None:
         for r in flush.expired:
             self._count("reject_timeout")
@@ -792,8 +1015,25 @@ class InferenceServer:
     def _pack_flush(self, flush: Flush, pool=None):
         """-> (batch, pool buffer or None). Compact staging when the
         shape set carries a spec AND every request in the flush is
-        compactable (admission-time flag); full-fidelity otherwise."""
+        compactable (admission-time flag); full-fidelity otherwise.
+
+        Under the mesh engine the packed form is the SPLIT one: the
+        flush's graphs round-robined across the mesh, each shard packed
+        into one common rung, stacked on the device axis —
+        ``(stacked, per-shard real counts, rung)``."""
         graphs = [r.graph for r in flush.requests]
+        if self.mesh_exec is not None:
+            groups, sub_shape, counts = self.mesh_exec.plan_flush(
+                graphs, self.shape_set)
+            compact = (self.shape_set.compact is not None
+                       and all(r.compactable for r in flush.requests))
+            pack = (self.shape_set.pack if compact
+                    else self.shape_set.pack_full)
+            stacked = self.mesh_exec.stack(
+                [pack(g, shape=sub_shape) for g in groups])
+            if self.shape_set.compact is not None:
+                self._count("pack_compact" if compact else "pack_full")
+            return (stacked, counts, sub_shape), None
         if self.shape_set.compact is not None:
             if all(r.compactable for r in flush.requests):
                 buf = None
@@ -970,6 +1210,10 @@ class InferenceServer:
             "counts": counts,
             "queue_depth": self.batcher.depth,
             "param_version": self.param_store.version,
+            # which execution layer drives the devices (ISSUE 10):
+            # 'mesh' = one sharded dispatch covers the set,
+            # 'threads' = per-device dispatch threads (the A/B engine)
+            "engine": self.engine,
             "devices": self.device_set.stats(),
             "draining": draining,
             "latency_ms": self.latency_quantiles(),
@@ -1028,6 +1272,7 @@ def load_server(
     compact: str = "auto",
     pack_workers: int | None = None,
     devices: str | int = "auto",
+    engine: str = "auto",
     precision: str = "f32",
     watch: bool = True,
     poll_interval_s: float = 2.0,
@@ -1066,9 +1311,18 @@ def load_server(
     local device on accelerator backends, one device on CPU (host
     "devices" share the same cores — serve/devices.py); an int forces
     that many anywhere, which is how the 8-host-device dryrun proves
-    distribution in-container. With more than one device, params are
-    replicated per device, flushes route least-loaded, and hot reload
-    swaps all replicas atomically under one version.
+    distribution in-container.
+
+    ``engine`` (ISSUE 10) selects HOW a multi-device set is driven:
+    ``'mesh'`` (the ``'auto'`` default whenever more than one device is
+    resolved) batch-shards every flush across a ``Mesh`` +
+    ``NamedSharding`` layout and runs ONE jitted dispatch covering all
+    devices — compile count = programs, one sharded param tree per
+    tier, no router threads (parallel/executor.py); ``'threads'`` keeps
+    the ISSUE-5 thread-per-device DeviceSet layer (per-device replicas,
+    least-loaded routing, programs x N executables) for the A/B.
+    Either engine serves bit-exact predictions; hot reload swaps
+    atomically under one version in both.
 
     -> (server, dict of the bits callers reuse: manager, meta, configs,
     template graph, the calibration sample).
@@ -1153,7 +1407,7 @@ def load_server(
         state, shape_set, version=version, telemetry=telemetry,
         max_queue=max_queue, max_wait_ms=max_wait_ms,
         default_timeout_ms=default_timeout_ms, cache_size=cache_size,
-        pack_workers=pack_workers, devices=device_list,
+        pack_workers=pack_workers, devices=device_list, engine=engine,
         precisions=precisions, model=model, log_fn=log_fn,
     )
     server.warm(template)

@@ -67,6 +67,57 @@ _SAVE_RE = re.compile(r"^ckpt-(\d{8})$")
 _TMP_PREFIX = ".tmp-"
 _BEST_POINTER = "best.json"
 
+# orbax-barrier scoping for multi-host runs (ISSUE 10): this manager
+# implements its OWN atomicity (tmp dir + manifest commit marker +
+# os.replace) and its multi-host protocol is process-0-only commits
+# coordinated by parallel/dist.py — but a default orbax Checkpointer
+# sees jax.process_count() > 1 and inserts ITS OWN cross-process
+# barriers around every save/restore. An asymmetric save (only process
+# 0 commits) then posts a collective nobody else joins, which lands in
+# whatever collective the other hosts issued next — measured in the
+# 2-process CPU dryrun as a fatal gloo size-mismatch abort mid-epoch.
+# Scoping every barrier to a singleton {this process} keeps orbax a
+# local serializer; the counter keeps barrier keys unique across the
+# repeated restores a watcher performs.
+import itertools
+
+_LOCAL_SCOPE_SEQ = itertools.count()
+
+
+def _local_mp_options():
+    """Singleton-process MultiprocessingOptions (None single-process)."""
+    if jax.process_count() <= 1:
+        return None
+    from orbax.checkpoint import options as ocp_options
+
+    return ocp_options.MultiprocessingOptions(
+        primary_host=None,
+        active_processes={jax.process_index()},
+        barrier_sync_key_prefix=(
+            f"cgnn-local-p{jax.process_index()}-{next(_LOCAL_SCOPE_SEQ)}"
+        ),
+    )
+
+
+def _standard_checkpointer():
+    mp = _local_mp_options()
+    if mp is None:
+        return ocp.StandardCheckpointer()
+    return ocp.StandardCheckpointer(multiprocessing_options=mp)
+
+
+def _pytree_checkpointer():
+    mp = _local_mp_options()
+    if mp is None:
+        return ocp.PyTreeCheckpointer()
+    # PyTreeCheckpointer's own ctor only exposes primary_host; build the
+    # equivalent Checkpointer with the fully scoped options (same
+    # ocdbt-on handler defaults, so it reads StandardCheckpointer saves)
+    return ocp.Checkpointer(
+        ocp.PyTreeCheckpointHandler(use_ocdbt=True),
+        multiprocessing_options=mp,
+    )
+
 
 class CheckpointRestoreError(RuntimeError):
     """No candidate in the restore fallback chain was usable."""
@@ -123,7 +174,7 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep = keep
-        self._ckptr = ocp.StandardCheckpointer()
+        self._ckptr = _standard_checkpointer()
         # Telemetry.span is already a nullcontext at level 'off'
         self._telemetry = telemetry or Telemetry.disabled()
         self._lock = threading.Lock()
@@ -162,6 +213,15 @@ class CheckpointManager:
         from a process that never saves."""
         saves = self._committed_saves()
         return saves[0] if saves else None
+
+    def is_committed(self, name: str) -> bool:
+        """True iff ``name`` is a committed (manifest-bearing) versioned
+        save in this directory — the cross-host reload coordinator's
+        commit-marker visibility probe (parallel/dist.py): a non-zero
+        host polls this until its filesystem view catches up with the
+        save process 0 announced. Read-only."""
+        return bool(_SAVE_RE.match(name)) and read_manifest(
+            os.path.join(self.directory, name)) is not None
 
     def _best_target(self) -> str | None:
         try:
@@ -452,7 +512,7 @@ class CheckpointManager:
 
     def restore_for_inference(self, state: TrainState, tag: str = _LATEST):
         """Restore params/stats/normalizer only (no optimizer template)."""
-        with ocp.PyTreeCheckpointer() as ckptr:
+        with _pytree_checkpointer() as ckptr:
             _, raw, _ = self._restore_chain(tag, ckptr.restore)
         from cgnn_tpu.train.normalizer import Normalizer
 

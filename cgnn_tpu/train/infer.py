@@ -105,6 +105,7 @@ def run_fast_inference(
     compact=None,
     pack_workers: int = 0,
     devices: Sequence | None = None,
+    engine: str = "auto",
     telemetry=None,
 ) -> tuple[np.ndarray, float]:
     """Predict over ``graphs`` -> ([n, T] predictions in input order,
@@ -128,14 +129,23 @@ def run_fast_inference(
     serially on the calling thread (identical outputs, pinned by test).
 
     ``devices`` (ISSUE 5; e.g. ``serve.devices.resolve_devices('auto')``)
-    round-robins the windowed dispatch across that many device replicas
-    of ``state``: batch k runs on device k % N, each device keeps its own
-    in-flight window with its own value-fetch fence (FIFO per device, so
-    the buffer-pool release contract carries over per device), and the
-    final collection does ONE stacked fetch per (compiled shape, device).
-    Outputs are BIT-identical to the single-device path over identical
-    batches (same packing plan, same program — pinned by test); ``None``
-    keeps the single-device dispatch loop.
+    distributes the dispatch over that many devices; ``None`` keeps the
+    single-device loop. ``engine`` picks HOW (ISSUE 10):
+
+    - ``'mesh'`` (the ``'auto'`` default with > 1 device): consecutive
+      same-shape batches stack N-at-a-time on a device axis and ONE
+      sharded jitted dispatch (Mesh + NamedSharding,
+      parallel/executor.py) runs all N — the program count stays at one
+      per compiled shape (never programs x N executables), and the
+      windowed value-fetch fence bounds in-flight stacks exactly like
+      the single-device loop;
+    - ``'threads'`` keeps the ISSUE-5 replica path: batch k runs on
+      device k % N against that device's committed replica, per-device
+      in-flight windows, ONE stacked fetch per (shape, device).
+
+    Both are BIT-identical to the single-device path over identical
+    batches (same packing plan, same per-shard program — pinned by
+    tests/test_executor.py and test_infer.py).
     """
     if not len(graphs):
         raise ValueError("no graphs to predict")
@@ -143,28 +153,51 @@ def run_fast_inference(
         if compact is not None and compact is not shape_set.compact:
             raise ValueError("shape_set already carries a compact spec")
         compact = shape_set.compact
+    if engine not in ("auto", "mesh", "threads"):
+        raise ValueError(
+            f"engine must be 'auto', 'mesh', or 'threads', got {engine!r}"
+        )
+    predict_body = None
     if predict_step is None:
         expander = None
         if compact is not None:
             from cgnn_tpu.data.compact import make_expander
 
             expander = make_expander(compact)
-        predict_step = jax.jit(make_predict_step(expander))
+        predict_body = make_predict_step(expander)
+        predict_step = jax.jit(predict_body)
     n = len(graphs)
     preds: np.ndarray | None = None
     t0 = time.perf_counter()
 
-    # device replicas: batch k dispatches against states[k % n_dev] — the
-    # replica is committed to its device, the staged batch is uncommitted
-    # host memory, so computation follows the params to the right chip
-    # with no explicit placement per dispatch (serve/devices.py)
-    if devices is not None and len(devices):
+    # the execution layer over the device set (ISSUE 10): 'mesh' = one
+    # sharded dispatch covers N devices (the default); 'threads' = the
+    # ISSUE-5 per-device replica round-robin, kept for the A/B
+    use_mesh = (devices is not None and len(devices) > 1
+                and engine in ("auto", "mesh"))
+    executor = mesh_predict = placed_state = None
+    if use_mesh:
+        from cgnn_tpu.parallel.executor import MeshExecutor
+
+        executor = MeshExecutor(devices)
+        # wrap the raw body when we built it; an injected (jitted)
+        # predict_step traces through inside the sharded program
+        mesh_predict = executor.shard_predict(predict_body or predict_step)
+        placed_state = executor.place_params(state)
+        states = (state,)
+        n_dev = 1  # the per-batch round-robin below is bypassed
+    # device replicas (threads engine): batch k dispatches against
+    # states[k % n_dev] — the replica is committed to its device, the
+    # staged batch is uncommitted host memory, so computation follows
+    # the params to the right chip (serve/devices.py)
+    elif devices is not None and len(devices):
         from cgnn_tpu.serve.devices import replicate_state
 
         states = replicate_state(state, devices)
+        n_dev = len(states)
     else:
         states = (state,)
-    n_dev = len(states)
+        n_dev = 1
     dispatched = [0]
 
     # ((shape key, device) -> [(span, out)]) so the single stacked fetch
@@ -174,8 +207,10 @@ def run_fast_inference(
     recent: list[list] = [[] for _ in range(n_dev)]
     # compact staging buffers in per-device dispatch order; an entry is
     # released to the pool once ITS device's window fence proves its
-    # dispatch completed (execution is FIFO per device, not across them)
-    pool = BufferPool() if compact is not None else None
+    # dispatch completed (execution is FIFO per device, not across them).
+    # The mesh engine packs fresh arrays instead: the group stack copies
+    # every staged byte immediately, so a recycle fence buys nothing
+    pool = BufferPool() if compact is not None and not use_mesh else None
     pending: list[list] = [[] for _ in range(n_dev)]
 
     def _release_fenced(di):
@@ -248,15 +283,16 @@ def run_fast_inference(
                     pack_compact,
                 )
 
-                bkey = compact_buffer_key(nc, dense_m, graph_cap, tdim)
-                buf = (bkey, pool.acquire(
-                    bkey,
-                    lambda: alloc_compact_buffers(nc, dense_m, graph_cap,
-                                                  tdim),
-                ))
+                if pool is not None:
+                    bkey = compact_buffer_key(nc, dense_m, graph_cap, tdim)
+                    buf = (bkey, pool.acquire(
+                        bkey,
+                        lambda: alloc_compact_buffers(nc, dense_m,
+                                                      graph_cap, tdim),
+                    ))
                 batch = pack_compact(sub, nc, ec, graph_cap, compact,
                                      num_targets=tdim, dense_m=dense_m,
-                                     out=buf[1])
+                                     out=None if buf is None else buf[1])
             else:
                 batch = pack_graphs(sub, nc, ec, graph_cap, dense_m=dense_m,
                                     edge_dtype=edge_dtype)
@@ -269,6 +305,58 @@ def run_fast_inference(
                                telemetry=telemetry)
     else:
         packed = map(pack_job, jobs)
+
+    if use_mesh:
+        # mesh engine: consecutive same-shape batches stack N-at-a-time
+        # on the device axis; ONE sharded dispatch runs all N. A group
+        # shorter than the mesh (the shape-boundary or dataset tail)
+        # pads by repeating its last batch — padded rows are never read.
+        group: list = []  # [(span, batch)]
+        group_key = [None]
+        recent_m: list = []
+
+        def _flush_group():
+            if not group:
+                return
+            batches = [b for _, b in group]
+            while len(batches) < len(executor):
+                batches.append(batches[-1])
+            staged = executor.stage(executor.stack(batches))
+            out = mesh_predict(placed_state, staged)
+            outs_by_shape.setdefault(group_key[0], []).append(
+                ([s for s, _ in group], out))
+            recent_m.append(out)
+            if len(recent_m) == _WINDOW:
+                # the same in-flight bound as the single-device loop,
+                # per sharded dispatch: a true value fetch on the
+                # oldest in-window result (FIFO dispatch stream)
+                float(recent_m[0][0, 0, 0])
+                del recent_m[:]
+            del group[:]
+
+        for span, batch, key, _buf in packed:
+            if group_key[0] is not None and (
+                key != group_key[0] or len(group) == len(executor)
+            ):
+                _flush_group()
+            group_key[0] = key
+            group.append((span, batch))
+            if len(group) == len(executor):
+                _flush_group()
+        _flush_group()
+
+        for entries in outs_by_shape.values():
+            # one stacked fetch per compiled shape: [D, N, G, T]
+            fetched = np.array(  # true copy, not an alias (GC-ALIAS)
+                jax.device_get(jnp.stack([o for _, o in entries]))
+            )
+            if preds is None:
+                preds = np.zeros((n, fetched.shape[-1]), np.float32)
+            for (spans, _), o in zip(entries, fetched):
+                for i, span in enumerate(spans):
+                    preds[span] = o[i][: len(span)]
+        return preds, n / (time.perf_counter() - t0)
+
     for span, batch, key, buf in packed:
         _dispatch(span, batch, key, buf)
 

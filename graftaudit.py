@@ -84,6 +84,17 @@ def main(argv=None) -> int:
         return 0
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # deterministic device inventory for CPU audits: the mesh-sharded
+    # predict programs (ISSUE 10) need >= 2 devices to lower, and the
+    # committed ledger carries their GA-SHARD-budgeted rows — a
+    # 1-device run would report them as DROPPED (a budget regression).
+    # 8 virtual host devices matches CI's program-audit job and the
+    # test suite's conftest; a user-provided XLA_FLAGS wins.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     baseline = None
     if not args.no_compile and os.path.exists(args.baseline):
         baseline = load_ledger(args.baseline)

@@ -61,10 +61,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "full-fidelity staging otherwise")
     p.add_argument("--devices", default="auto", metavar="{auto,N}",
                    help="device-parallel dispatch (serve/devices.py): "
-                        "round-robin the windowed dispatch over this many "
-                        "local devices. 'auto' = all devices on "
-                        "accelerator backends, one on CPU (host 'devices' "
-                        "share the same cores); an integer forces")
+                        "distribute over this many local devices. 'auto' "
+                        "= all devices on accelerator backends, one on "
+                        "CPU (host 'devices' share the same cores); an "
+                        "integer forces")
+    p.add_argument("--engine", choices=["auto", "mesh", "threads"],
+                   default="auto",
+                   help="multi-device execution layer (ISSUE 10): 'mesh' "
+                        "(the auto default with >1 device) stacks batches "
+                        "N-at-a-time and ONE sharded jitted dispatch "
+                        "covers all devices; 'threads' keeps the ISSUE-5 "
+                        "per-device replica round-robin (the A/B leg)")
     p.add_argument("--compile-cache", type=str, default="/tmp/jax_cache",
                    metavar="DIR", help="persistent XLA compile cache "
                                        "('' disables)")
@@ -256,10 +263,11 @@ def _run(args, mgr) -> int:
             compact=_probe_compact(args, graphs, data_cfg, layout_m,
                                    edge_dtype),
             pack_workers=args.pack_workers, devices=devices,
+            engine=args.engine,
         )
         print(f"inference throughput: {rate:.0f} structures/sec "
               f"(dispatch-pipelined, single fetch per bucket, "
-              f"{len(devices)} device(s))")
+              f"{len(devices)} device(s), {args.engine} engine)")
     else:
         # default: pack into the serving shape ladder (serve.shapes) —
         # compile count pinned at --rungs, and shared with an online
@@ -277,12 +285,13 @@ def _run(args, mgr) -> int:
         preds, rate = run_fast_inference(
             state, graphs, args.batch_size, shape_set=shape_set,
             pack_workers=args.pack_workers, devices=devices,
+            engine=args.engine,
         )
         print(f"inference throughput: {rate:.0f} structures/sec "
               f"(dispatch-pipelined, {len(shape_set)}-rung shape ladder, "
               f"{'compact' if shape_set.compact else 'full'}-staged, "
               f"{args.pack_workers} pack workers, "
-              f"{len(devices)} device(s))")
+              f"{len(devices)} device(s), {args.engine} engine)")
     if not force_task:
         for g, p in zip(graphs, preds):
             rows.append(

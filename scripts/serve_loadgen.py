@@ -77,6 +77,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "CPU; an integer forces that many anywhere. "
                         "With a forced N > 1 the loadgen HARD-ASSERTS "
                         "that every device answered responses")
+    p.add_argument("--engine", choices=["auto", "mesh", "threads"],
+                   default="auto",
+                   help="multi-device execution layer (ISSUE 10): 'mesh' "
+                        "(the auto default with >1 device) = one "
+                        "batch-sharded jitted dispatch covers all "
+                        "devices, device_id = the shard that computed "
+                        "the row; 'threads' = the ISSUE-5 per-device "
+                        "dispatch threads. The per-device "
+                        "answered/version hard asserts apply to BOTH — "
+                        "under mesh they read the shard-level stats")
     p.add_argument("--precision", default="f32", metavar="TIERS",
                    help="comma-separated precision tiers (f32,bf16,int8): "
                         "the server warms ALL of them, each request "
@@ -252,6 +262,7 @@ def _run_inproc(args) -> dict:
         compact=args.compact,
         pack_workers=args.pack_workers,
         devices=args.devices,
+        engine=args.engine,
         precision=args.precision,
         default_timeout_ms=args.timeout_ms,
         cache_size=0,  # the loadgen reuses structures; caching would
@@ -452,6 +463,7 @@ def _run_inproc(args) -> dict:
         },
         "devices": {
             "requested": str(args.devices),
+            "engine": server.engine,
             "count": len(server.device_set),
             "responses_by_device": {
                 str(k): v

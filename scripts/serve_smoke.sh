@@ -7,8 +7,12 @@
 #     responses, ZERO recompiles after warmup, and responses observed
 #     from BOTH param versions (exit non-zero otherwise);
 #     1b forces the compact+pipelined ingest (ISSUE 4); 1c forces the
-#     device-parallel dispatch layer across 8 virtual host devices
-#     (ISSUE 5: distribution + per-replica swap consistency); 1d reruns
+#     thread-per-device dispatch layer across 8 virtual host devices
+#     (ISSUE 5: distribution + per-replica swap consistency); 1f runs
+#     the same dryrun through the MESH engine (ISSUE 10: one
+#     batch-sharded dispatch covers all 8 devices, compile count =
+#     programs not programs x 8, shard-level distribution + swap
+#     consistency); 1d reruns
 #     the 64-client load under CGNN_TPU_RACECHECK=1 (ISSUE 7) and
 #     asserts ZERO lock-order inversions, ZERO unguarded shared-field
 #     accesses, and ZERO deadlock-watchdog dumps;
@@ -104,16 +108,19 @@ print("leg 1b ok:", r["answered"], "answered @", r["throughput_rps"],
       "rps under compact+pipelined ingest")
 EOF
 
-echo "== leg 1c: device-parallel dispatch, 8 host devices (ISSUE 5) =="
+echo "== leg 1c: thread-per-device dispatch, 8 host devices (ISSUE 5) =="
 # the MULTICHIP dryrun pattern: 8 virtual CPU devices + a FORCED
 # --devices 8 ('auto' is deliberately single-device on CPU backends).
-# Hard invariants: zero drops, zero recompiles after the N-device warmup
-# (compile count = shapes x forms x 8, all at warmup), EVERY device
-# answers responses, and a mid-load hot swap serves both param versions
-# with each response's version consistent with its replica.
+# --engine threads pins the ISSUE-5 DeviceSet layer explicitly (the
+# default engine for a multi-device set is 'mesh' since ISSUE 10 — leg
+# 1f covers it). Hard invariants: zero drops, zero recompiles after the
+# N-device warmup (compile count = shapes x forms x 8, all at warmup),
+# EVERY device answers responses, and a mid-load hot swap serves both
+# param versions with each response's version consistent with its
+# replica.
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python scripts/serve_loadgen.py "$WORK/ckpt" \
-  --clients 64 --duration 6 --hot-swap --devices 8 \
+  --clients 64 --duration 6 --hot-swap --devices 8 --engine threads \
   --report "$WORK/slo_multidev.json"
 python - "$WORK/slo_multidev.json" <<'EOF'
 import json, sys
@@ -122,6 +129,7 @@ assert r["dropped"] == 0, r
 assert r["compiles"]["after_warm"] == 0, r["compiles"]
 assert not r["failures"], r["failures"]
 dev = r["devices"]
+assert dev["engine"] == "threads", dev
 assert dev["count"] == 8, dev
 silent = [i for i in range(8)
           if not dev["responses_by_device"].get(str(i))]
@@ -129,6 +137,41 @@ assert not silent, f"devices {silent} answered nothing: {dev}"
 assert len(r["param_versions"]) >= 2, r["param_versions"]
 print("leg 1c ok:", r["answered"], "answered across", dev["count"],
       "devices", dev["responses_by_device"], "- swap versions",
+      list(r["param_versions"]))
+EOF
+
+echo "== leg 1f: mesh single-dispatch engine, 8 host devices (ISSUE 10) =="
+# the SAME dryrun through the mesh execution layer (the default engine
+# for a multi-device set): one batch-sharded jitted dispatch covers all
+# 8 devices. Beyond leg 1c's invariants, the decisive pin is the
+# compile count: at_warm must equal programs (rungs x staging forms),
+# NOT programs x 8 — one multi-device executable per program.
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --clients 64 --duration 6 --hot-swap --devices 8 --engine mesh \
+  --report "$WORK/slo_mesh.json"
+python - "$WORK/slo_mesh.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["dropped"] == 0, r
+assert r["compiles"]["after_warm"] == 0, r["compiles"]
+assert not r["failures"], r["failures"]
+dev = r["devices"]
+assert dev["engine"] == "mesh", dev
+assert dev["count"] == 8, dev
+shapes = len(r["server_stats"]["shapes"])
+# THE mesh pin: compile count = programs (one sharded executable per
+# rung x staging form), never programs x devices
+assert r["compiles"]["at_warm"] == shapes, (
+    f"mesh warmup compiled {r['compiles']['at_warm']} programs for "
+    f"{shapes} rungs - expected exactly one per rung, not per device")
+silent = [i for i in range(8)
+          if not dev["responses_by_device"].get(str(i))]
+assert not silent, f"shards {silent} answered nothing: {dev}"
+assert len(r["param_versions"]) >= 2, r["param_versions"]
+print("leg 1f ok:", r["answered"], "answered across", dev["count"],
+      "mesh shards", dev["responses_by_device"], "-",
+      r["compiles"]["at_warm"], "compiles for", shapes, "rungs - swap",
       list(r["param_versions"]))
 EOF
 

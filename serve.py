@@ -55,6 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "'auto' = all local devices on accelerator "
                         "backends, one on CPU; an integer forces that "
                         "many anywhere (the 8-host-device dryrun)")
+    p.add_argument("--engine", choices=["auto", "mesh", "threads"],
+                   default="auto",
+                   help="multi-device execution layer (ISSUE 10): 'mesh' "
+                        "(the auto default with >1 device) batch-shards "
+                        "each flush over a Mesh+NamedSharding layout and "
+                        "ONE jitted dispatch covers all devices — compile "
+                        "count = programs, one sharded param tree; "
+                        "'threads' keeps the per-device dispatch-thread "
+                        "DeviceSet layer (the A/B baseline)")
     p.add_argument("--poll-interval", type=float, default=2.0,
                    help="hot-reload checkpoint poll seconds (0 disables)")
     p.add_argument("--calibrate", type=int, default=256,
@@ -131,6 +140,7 @@ def main(argv=None) -> int:
             compact=args.compact,
             pack_workers=args.pack_workers,
             devices=args.devices,
+            engine=args.engine,
             precision=args.precision,
             watch=args.poll_interval > 0,
             poll_interval_s=args.poll_interval or 2.0,
@@ -177,7 +187,8 @@ def main(argv=None) -> int:
     )
     print(f"serving on http://{args.host}:{args.port} "
           f"(params {server.param_store.version}; shapes {shapes}; "
-          f"{len(server.device_set)} device(s); live plane: GET /metrics"
+          f"{len(server.device_set)} device(s), {server.engine} engine; "
+          f"live plane: GET /metrics"
           + (f", POST /profile -> {profile_dir}" if profile_dir else "")
           + ")")
     try:

@@ -350,12 +350,16 @@ def test_fast_inference_multidev_buffer_fence_per_device(monkeypatch):
 
     # window 2 + 4 devices over ~20 batches: every device's fence fires
     # repeatedly, so released buffers are re-acquired while other
-    # devices' dispatches are still in flight
+    # devices' dispatches are still in flight. engine="threads": the
+    # pooled-buffer recycle contract belongs to the per-device engine —
+    # the mesh engine (the multi-device default since ISSUE 10) packs
+    # fresh stacks and never touches the pool
     monkeypatch.setattr(infer_mod, "_WINDOW", 2)
     monkeypatch.setattr(infer_mod, "BufferPool", spy_pool)
     got, _ = run_fast_inference(state, graphs, 8, shape_set=ladder,
                                 predict_step=pstep, pack_workers=3,
-                                devices=jax.devices()[:4])
+                                devices=jax.devices()[:4],
+                                engine="threads")
     np.testing.assert_array_equal(want, got)
     assert pools and pools[0].reused > 0  # buffers really recycled
 

@@ -181,6 +181,72 @@ class TestBrokenProgramFixtures:
         assert "expected" in findings[0].message
 
 
+class TestShardBudgetFixtures:
+    """GA-SHARD (ISSUE 10): the replicated-batch mistake must trip the
+    gate; the correctly batch-sharded twin must pass it."""
+
+    def _mesh_fixtures(self):
+        from jax.sharding import PartitionSpec as P
+
+        from cgnn_tpu.parallel import compat
+        from cgnn_tpu.parallel.executor import MeshExecutor
+
+        ex = MeshExecutor(jax.devices())
+        n = len(ex)
+
+        def body(w, b):
+            return (b @ w).sum(axis=-1)
+
+        good = jax.jit(compat.shard_map(
+            body, mesh=ex.mesh, in_specs=(P(), P("data")),
+            out_specs=P("data"), check_vma=False))
+        # the classic mistake: the batch staged WITHOUT its sharding —
+        # every device holds (and reads) the full stack
+        bad = jax.jit(compat.shard_map(
+            lambda w, b: body(w, b)[:1], mesh=ex.mesh,
+            in_specs=(P(), P()), out_specs=P("data"), check_vma=False))
+        w_av = jax.ShapeDtypeStruct((64, 64), np.float32)
+        b_av = jax.ShapeDtypeStruct((n, 128, 64), np.float32)
+        budget = 64 * 64 * 4 + (n * 128 * 64 * 4) // n
+        return good, bad, (w_av, b_av), budget
+
+    def test_replicated_batch_is_flagged(self):
+        from cgnn_tpu.analysis.program_audit import check_shard_budget
+
+        good, bad, avals, budget = self._mesh_fixtures()
+        mem = bad.lower(*avals).compile().memory_analysis()
+        p = Program(name="fixture/replicated", arg_byte_budget=budget)
+        findings = check_shard_budget(p, mem)
+        assert len(findings) == 1
+        assert findings[0].check == "GA-SHARD"
+        assert "REPLICATED" in findings[0].message
+
+    def test_sharded_batch_passes(self):
+        from cgnn_tpu.analysis.program_audit import check_shard_budget
+
+        good, bad, avals, budget = self._mesh_fixtures()
+        mem = good.lower(*avals).compile().memory_analysis()
+        p = Program(name="fixture/sharded", arg_byte_budget=budget)
+        assert check_shard_budget(p, mem) == []
+
+    def test_unbudgeted_program_is_ungated(self):
+        from cgnn_tpu.analysis.program_audit import check_shard_budget
+
+        _, bad, avals, _ = self._mesh_fixtures()
+        mem = bad.lower(*avals).compile().memory_analysis()
+        assert check_shard_budget(Program(name="x"), mem) == []
+
+    def test_unmeasurable_args_is_itself_a_finding(self):
+        from cgnn_tpu.analysis.program_audit import check_shard_budget
+
+        class _NoArgs:
+            argument_size_in_bytes = 0
+
+        findings = check_shard_budget(
+            Program(name="x", arg_byte_budget=100), _NoArgs())
+        assert len(findings) == 1 and findings[0].check == "GA-SHARD"
+
+
 class TestLowerTrainProgram:
     def test_one_lowering_path_for_train_programs(self):
         """`lower_train_program` is the ONE jit/lower plumbing for
@@ -242,20 +308,35 @@ class TestLiveRepo:
         lowered = {p.name for p in programs if p.lowered is not None}
         expected = ledger["meta"]["predict_programs_expected"]
         rungs = len(ledger["meta"]["ladder"]["shapes"])
-        assert expected == 2 * rungs  # compact + full per rung
+        # the engine dimension (ISSUE 10): compact + full per rung for
+        # the single-device ladder AND the mesh-sharded twin (the
+        # conftest mesh has 8 devices, so the mesh engine registers)
+        assert ledger["meta"]["mesh_devices"] >= 2
+        assert expected == 2 * rungs * 2
         predict = {n for n in lowered if n.startswith("predict/")}
         assert len(predict) == expected, sorted(predict)
+        mesh = {n for n in predict if n.startswith("predict/mesh/")}
+        assert len(mesh) == 2 * rungs, sorted(mesh)
         assert "train/coo" in lowered
         assert "train/coo+guard" in lowered
         assert "train/coo+tap@step" in lowered
         assert "expander/rung0" in lowered
+
+    def test_mesh_programs_carry_shard_budgets(self, live_audit):
+        """Every mesh-sharded predict program is GA-SHARD-budgeted —
+        an unbudgeted one would make the replication gate vacuous."""
+        _, _, programs = live_audit
+        mesh = [p for p in programs if p.name.startswith("predict/mesh/")]
+        assert mesh
+        for p in mesh:
+            assert p.arg_byte_budget > 0, p.name
 
     def test_skips_are_known_backend_gaps_only(self, live_audit):
         _, ledger, _ = live_audit
         # conv/fused_pallas_fwd: Mosaic lowers only on a tpu backend
         # (its structured twin conv/fused_xla_fwd is audited everywhere)
         known = {"train/dense", "train/dp", "train/edge",
-                 "conv/fused_pallas_fwd"}
+                 "conv/fused_pallas_fwd", "predict/mesh"}
         assert set(ledger["meta"]["skipped"]) <= known, (
             "unexpected skip — a program stopped lowering: "
             f"{ledger['meta']['skipped']}"
@@ -287,6 +368,20 @@ class TestCommittedLedger:
                 assert f"predict/rung{rung}/{form}" in names
         assert "train/coo" in names
         assert ledger["meta"]["gate_keys"] == list(LEDGER_GATE_KEYS)
+
+    def test_mesh_engine_coverage(self, ledger):
+        """The committed baseline carries the mesh-sharded predict rows
+        with their GA-SHARD budgets: a future session dropping them (or
+        their budgets) diffs red, not silent."""
+        rungs = len(ledger["meta"]["ladder"]["shapes"])
+        for rung in range(rungs):
+            for form in ("compact", "full"):
+                entry = ledger["programs"].get(
+                    f"predict/mesh/rung{rung}/{form}")
+                assert entry is not None, (rung, form)
+                assert entry.get("arg_byte_budget", 0) > 0
+                assert 0 < entry.get("arg_bytes", 0) <= (
+                    entry["arg_byte_budget"] * 1.5)
 
     def test_train_step_donation_survived_compilation(self, ledger):
         # alias_bytes > 0 is the compiled-side proof donation applied

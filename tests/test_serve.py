@@ -659,7 +659,8 @@ class TestDeviceParallelServing:
             self, graphs, shape_set, model_state):
         _, state = model_state
         server = _make_server(model_state, shape_set, cache_size=0,
-                              pack_workers=1, devices=self._devices())
+                              pack_workers=1, devices=self._devices(),
+                              engine="threads")
         server.warm(graphs[0])
         # the compile pin, N-device form: one executable per (traced
         # program, device), all built AT WARMUP
@@ -704,6 +705,7 @@ class TestDeviceParallelServing:
         v1 = mgr.newest_committed()
         server = _make_server(model_state, shape_set, cache_size=0,
                               pack_workers=1, devices=self._devices(),
+                              engine="threads",
                               version=v1, default_timeout_ms=60000.0,
                               max_queue=4096)
         server.warm(graphs[0])
@@ -783,7 +785,7 @@ class TestDeviceParallelServing:
                               use_clu=False)
         server = _make_server(model_state, shape_set, cache_size=0,
                               pack_workers=1, devices=self._devices(),
-                              telemetry=telemetry)
+                              engine="threads", telemetry=telemetry)
         server.warm(graphs[0])
         server.start()
         futs = [server.submit(g, timeout_ms=30000)

@@ -246,6 +246,12 @@ def main(argv=None) -> int:
     if args.device == "cpu":
         # env var alone is not honored under the axon TPU tunnel
         jax.config.update("jax_platforms", "cpu")
+    # multi-host (ISSUE 10): the CGNN_TPU_COORDINATOR/_NUM_PROCESSES/
+    # _PROCESS_ID env triple turns this process into one controller of a
+    # jax.distributed run — must init BEFORE anything touches a backend
+    from cgnn_tpu.parallel import dist
+
+    dist.initialize_from_env(log_fn=print)
     if args.compile_cache:
         try:
             jax.config.update("jax_compilation_cache_dir", args.compile_cache)
@@ -444,6 +450,30 @@ def main(argv=None) -> int:
         train_g, val_g, test_g = train_val_test_split(
             graphs, args.train_ratio, args.val_ratio, seed=args.seed
         )
+    if dist.active():
+        # multi-host DP: per-host data slicing (the loader side of
+        # ISSUE 10). Every process runs the identical split above
+        # (same seed, same data), then takes its disjoint strided
+        # shard; the global batch is the union across hosts and the
+        # cross-host grad allreduce lives in the shard_map step.
+        if not args.data_parallel:
+            print("multi-host run (jax.distributed) requires "
+                  "--data-parallel: without the global-mesh step there "
+                  "is no cross-host gradient reduction and the hosts "
+                  "would silently train divergent models",
+                  file=sys.stderr)
+            return 2
+        if args.scan_epochs or args.device_resident or args.pack_once:
+            print("multi-host DP runs the per-step loop; drop "
+                  "--scan-epochs/--device-resident/--pack-once",
+                  file=sys.stderr)
+            return 2
+        train_g = dist.host_shard(train_g)
+        val_g = dist.host_shard(val_g)
+        print(f"multi-host: process {dist.process_index()}/"
+              f"{dist.process_count()} trains {len(train_g)} / "
+              f"validates {len(val_g)} structures (strided host shard); "
+              f"test eval runs the full split on every host")
     num_targets = int(train_g[0].target.shape[0])
     classification = args.task == "classification"
     force_task = args.task == "force"
@@ -629,7 +659,22 @@ def main(argv=None) -> int:
         "guard": guard_enabled, "monitor": monitor, "preempt": preempt,
     }
 
+    _skip_noted = [False]
+
     def save_cb(s, e, m, b):
+        if not dist.is_coordinator():
+            # multi-host: checkpoint commits are PROCESS-0-ONLY — two
+            # hosts writing the same versioned-save sequence into one
+            # shared directory would race the commit protocol. The
+            # state is replicated (post-pmean), so process 0's save IS
+            # everyone's save; non-zero hosts pick it up via restore /
+            # the coordinated hot-reload path (parallel/dist.py).
+            if not _skip_noted[0]:
+                _skip_noted[0] = True
+                print(f"multi-host: process {dist.process_index()} "
+                      f"skips checkpoint commits (process 0 is the "
+                      f"single committer)")
+            return
         extra = monitor.meta() if monitor is not None else {}
         ckpt.save(
             s, dict(meta_base, epoch=e, best_mae=m.get(sel_key, -1.0),
@@ -717,6 +762,11 @@ def main(argv=None) -> int:
             **resilience_kw, **step_overrides,
         )
         state = fit_state.replace(apply_fn=state.apply_fn)
+        if dist.active():
+            # post-fit the state is replicated over the GLOBAL mesh;
+            # pull host-local copies so the single-device test eval and
+            # any further checkpointing run without the mesh
+            state = dist.localize(state)
     else:
         if force_task:
             step_overrides |= {
