@@ -261,11 +261,17 @@ def build_entry_programs(config: AuditConfig | None = None,
     cfg = config or AuditConfig()
     m = cfg.dense_m
     fcfg = FeaturizeConfig(radius=6.0, max_num_nbr=m)
-    graphs = load_synthetic_mp(cfg.n_graphs, fcfg, seed=cfg.seed)
+    # keep_geometry: the ISSUE-11 raw-wire spec calibrates its image
+    # caps from the calibration lattices
+    graphs = load_synthetic_mp(cfg.n_graphs, fcfg, seed=cfg.seed,
+                               keep_geometry=True)
     targets = np.stack([g.target for g in graphs])
     spec = CompactSpec.build(graphs, fcfg.gdf(), dense_m=m)
+    from cgnn_tpu.data.rawbatch import plan_raw_spec
+
+    raw_spec = plan_raw_spec(graphs, fcfg.gdf(), fcfg.radius, m)
     ladder = plan_shape_set(graphs, cfg.batch_size, rungs=cfg.rungs,
-                            dense_m=m, compact=spec)
+                            dense_m=m, compact=spec, raw=raw_spec)
 
     def make_state(model, example):
         return create_train_state(
@@ -446,8 +452,11 @@ def build_entry_programs(config: AuditConfig | None = None,
                  "Pallas TPU kernels lower only on a tpu backend "
                  "(config.py backend rule); CI's TPU leg audits it")
 
-    # -- predict: every (rung, staging form) in the warm ladder --
-    pstep = jax.jit(make_predict_step(ladder.expander()))
+    # -- predict: every (rung, staging form) in the warm ladder — the
+    # forms dimension now includes 'raw' (ISSUE 11: the in-program
+    # neighbor-search + featurize program per rung) --
+    pstep = jax.jit(make_predict_step(ladder.expander(),
+                                      ladder.raw_expander()))
     batch_avals = ladder.abstract_batches(graphs[0])
     for (rung, form), batch_av in sorted(batch_avals.items()):
         add(f"predict/rung{rung}/{form}", pstep,
@@ -465,7 +474,7 @@ def build_entry_programs(config: AuditConfig | None = None,
         executor = MeshExecutor(jax.devices())
         mesh_devices = len(executor)
         mesh_pred = executor.shard_predict(
-            make_predict_step(ladder.expander()))
+            make_predict_step(ladder.expander(), ladder.raw_expander()))
 
         def _aval_bytes(tree) -> int:
             total = 0
@@ -499,6 +508,31 @@ def build_entry_programs(config: AuditConfig | None = None,
     add("expander/rung0", jax.jit(make_expander(spec)),
         (batch_avals[(0, "compact")],))
 
+    # -- the in-program neighbor search as its own program, GA-ROOFLINE
+    # budgeted against its analytic candidate-matrix byte model: the
+    # [S, S*K] dense candidate pass is the intended working set, and a
+    # rematerialized per-candidate FEATURE tensor (the G-fold blowup the
+    # budget exists to catch) blows straight through the slack --
+    from cgnn_tpu.ops.neighbor_search import (
+        neighbor_search,
+        neighbor_search_hbm_bytes,
+    )
+
+    raw_av0 = batch_avals[(0, "raw")]
+    g_cap0 = raw_av0.targets.shape[0]
+
+    def _search_fn(frac, lats, amask):
+        return neighbor_search(frac, lats, amask, raw_spec)
+
+    search_budget = neighbor_search_hbm_bytes(
+        g_cap0, raw_spec.snode_cap, raw_spec.n_images, raw_spec.dense_m
+    )["budget_bytes"]
+    programs.append(Program(
+        name="ops/neighbor_search/rung0", jitted=jax.jit(_search_fn),
+        args=(raw_av0.frac, raw_av0.lattices, raw_av0.atom_mask),
+        byte_budget=search_budget,
+    ))
+
     meta = {
         "config": cfg.to_meta(),
         "ladder": ladder.to_meta(),
@@ -517,6 +551,12 @@ def build_entry_programs(config: AuditConfig | None = None,
             **byte_model, "eval_budget_bytes": eval_budget,
             "shape": {"n": ncd, "m": m, "g": gdim, "f": fdim},
         },
+        # the ISSUE-11 neighbor-search byte model (GA-ROOFLINE target)
+        "neighbor_search_byte_model": neighbor_search_hbm_bytes(
+            g_cap0, raw_spec.snode_cap, raw_spec.n_images,
+            raw_spec.dense_m,
+        ),
+        "raw_spec": raw_spec.to_meta(),
     }
     return programs, meta
 

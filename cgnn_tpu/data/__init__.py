@@ -24,6 +24,14 @@ from cgnn_tpu.data.cache import (
 )
 from cgnn_tpu.data.loader import prefetch_to_device
 from cgnn_tpu.data.pipeline import BufferPool, PackError, parallel_pack
+from cgnn_tpu.data.rawbatch import (
+    RawBatch,
+    RawSpec,
+    RawStructure,
+    pack_raw,
+    plan_raw_spec,
+    raw_from_graph,
+)
 
 __all__ = [
     "Structure",
@@ -49,4 +57,10 @@ __all__ = [
     "BufferPool",
     "PackError",
     "parallel_pack",
+    "RawBatch",
+    "RawSpec",
+    "RawStructure",
+    "pack_raw",
+    "plan_raw_spec",
+    "raw_from_graph",
 ]

@@ -81,6 +81,7 @@ def featurize_structure(
         graph.positions = structure.wrapped().cart_coords.astype(np.float32)
         graph.lattice = structure.lattice.astype(np.float32)
         graph.offsets = nl.offsets.astype(np.int32)
+        graph.numbers = structure.numbers.copy()
     return graph
 
 
@@ -144,6 +145,7 @@ def load_synthetic_mp(
     num_structures: int,
     cfg: FeaturizeConfig | None = None,
     seed: int = 0,
+    keep_geometry: bool = False,
 ) -> list[CrystalGraph]:
     """MP-like size distribution (lognormal ~30 atoms) for honest benching."""
     from cgnn_tpu.data.synthetic import synthetic_mp_dataset
@@ -151,7 +153,8 @@ def load_synthetic_mp(
     cfg = cfg or FeaturizeConfig()
     gdf = cfg.gdf()
     return [
-        featurize_structure(s, t, cfg, sid, gdf)
+        featurize_structure(s, t, cfg, sid, gdf,
+                            keep_geometry=keep_geometry)
         for sid, s, t in synthetic_mp_dataset(num_structures, seed)
     ]
 

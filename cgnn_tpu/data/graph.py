@@ -62,6 +62,10 @@ class CrystalGraph:
     distances: np.ndarray | None = None  # [E] raw distances
     target_mask: np.ndarray | None = None  # [T] 1.0 where label present
     forces: np.ndarray | None = None  # [N, 3] per-atom force labels (MD17)
+    # atomic numbers (kept with geometry): the raw wire format is
+    # (positions, lattice, species), so a geometry-carrying graph can be
+    # converted back to wire form (data/rawbatch.raw_from_graph)
+    numbers: np.ndarray | None = None  # [N] int32
 
     @property
     def num_nodes(self) -> int:

@@ -1,0 +1,473 @@
+"""Raw wire format: stage (positions, lattice, species), build graphs
+ON DEVICE (ISSUE 11, ROADMAP item 5).
+
+The compact form (data/compact.py) killed the featurized-array bytes but
+still ships a HOST-BUILT graph: the periodic neighbor search
+(data/neighbors.py) burns host cores per request and the wire carries
+per-edge distances. This module is the next rung down: the wire carries
+only what a structure IS —
+
+    positions [N, 3] f32 (fractional), lattice [3, 3] f32, species [N] i32
+
+— ~100x fewer bytes than featurized arrays (~516 B vs ~70 KB for a
+30-atom MP cell) and near-zero host work per request (slot copies, no
+radius search, no expansion). The in-program front of the pipeline
+(ops/neighbor_search.py) then runs the periodic radius search, the
+max_num_nbr truncation, and the Gaussian featurization INSIDE the
+compiled program, emitting the exact dense-layout ``GraphBatch`` the
+models consume.
+
+Padded-capacity discipline (the repo's one batching idea, applied to
+structures): a :class:`RawBatch` holds ``graph_cap`` structure slots of
+``snode_cap`` atom slots each — per-STRUCTURE caps, not the flat
+concatenated packing, because the neighbor search is per-structure
+(atoms only neighbor atoms of their own crystal) and a block layout
+makes it a dense vmapped candidate matrix instead of a masked
+cross-graph scatter. The periodic image range is capped per rung too
+(``RawSpec.images``): a fixed lexicographic offset grid, calibrated
+from data like every other capacity.
+
+Cap overflow contract (INVARIANTS.md): a structure whose lattice needs
+MORE periodic images than the rung provides would silently lose true
+edges — silently different predictions. The host pre-checks at
+admission (``RawSpec.admits``, f64), and the compiled program
+RE-DERIVES the needed image counts from the staged lattice and flags
+per-structure overflow in its output (the safety net that still works
+when positions are device-resident — relaxation/MD, ROADMAP item 2).
+A flagged structure is never answered from the truncated graph; serving
+routes it to the host-featurized fallback form.
+
+Parity contract vs the host featurizer (pinned in
+tests/test_rawwire.py): graph CONSTRUCTION is bit-exact — identical
+edge sets, neighbor indices, canonical edge order (center, then
+distance, then source atom, then lexicographic image), masks, and atom
+feature rows. Scalar distances and Gaussian features agree to f32
+roundoff (the host search works in f64 and XLA contracts multiply-adds
+into FMAs), the same ≤1-ulp class as the compact expander's ``exp``.
+``raw_neighbor_graph_host`` below is the numpy mirror of the device
+arithmetic used by tests and by nothing on the serving path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+from typing import Any, Sequence
+
+import numpy as np
+from flax import struct
+
+from cgnn_tpu.data.elements import MAX_Z
+
+
+class RawUnsupported(ValueError):
+    """The dataset/calibration cannot plan a raw wire spec (caller
+    should fall back to featurized wire — a capability probe, not a
+    failure)."""
+
+
+@dataclasses.dataclass
+class RawStructure:
+    """One structure in wire form (host-side, f64 for fidelity with the
+    legacy parse path; ``pack_raw`` casts to the f32 wire dtypes)."""
+
+    frac_coords: np.ndarray  # [N, 3] f64, any range (wrapped at pack)
+    lattice: np.ndarray  # [3, 3] f64 row-vector
+    numbers: np.ndarray  # [N] i32 atomic numbers
+    target: np.ndarray | None = None  # [T] f32 (zeros when serving)
+    cif_id: str = ""
+    target_mask: np.ndarray | None = None
+
+    def __post_init__(self):
+        self.frac_coords = np.asarray(self.frac_coords,
+                                      np.float64).reshape(-1, 3)
+        self.lattice = np.asarray(self.lattice, np.float64).reshape(3, 3)
+        self.numbers = np.asarray(self.numbers, np.int32).ravel()
+        if len(self.numbers) != len(self.frac_coords):
+            # checked at CONSTRUCTION so every entry point (HTTP json,
+            # in-proc submit, offline) fails this structure ALONE — a
+            # mismatch reaching pack_raw would poison its whole flush
+            raise ValueError(
+                f"{len(self.numbers)} species but "
+                f"{len(self.frac_coords)} coordinate rows"
+            )
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.numbers)
+
+    @property
+    def num_edges(self) -> int:
+        # structural slot accounting only (the true count is what the
+        # in-program search determines); admission under the dense
+        # layout budgets nodes * dense_m through ShapeSet.graph_counts,
+        # which never reads this
+        return 0
+
+    @property
+    def wire_nbytes(self) -> int:
+        """Bytes this structure occupies in the f32 wire encoding:
+        positions [N,3] f32 + lattice [3,3] f32 + species [N] i32."""
+        n = self.num_nodes
+        return n * 3 * 4 + 9 * 4 + n * 4
+
+    @classmethod
+    def from_structure(cls, s, target=None, cif_id: str = "",
+                       target_mask=None) -> "RawStructure":
+        return cls(s.frac_coords, s.lattice, s.numbers, target=target,
+                   cif_id=cif_id or "", target_mask=target_mask)
+
+
+def raw_from_graph(g) -> RawStructure | None:
+    """Geometry-carrying CrystalGraph -> wire form, or None when the
+    graph lacks geometry/species (featurize with keep_geometry=True).
+    Fractional coordinates are recovered from the stored wrapped f32
+    cartesians — the same f32 fidelity a wire client ships."""
+    if (getattr(g, "positions", None) is None
+            or getattr(g, "lattice", None) is None
+            or getattr(g, "numbers", None) is None):
+        return None
+    lat = np.asarray(g.lattice, np.float64)
+    frac = np.asarray(g.positions, np.float64) @ np.linalg.inv(lat)
+    return RawStructure(frac, lat, g.numbers, target=g.target,
+                        cif_id=g.cif_id, target_mask=g.target_mask)
+
+
+def raw_fingerprint(rs: RawStructure) -> str:
+    """Content hash of the f32 wire encoding (the result-cache key for
+    raw-wire requests; 'raw:'-prefixed so a raw-served row can never
+    collide with a featurized-array fingerprint)."""
+    h = hashlib.sha1()
+    for arr, dt in ((rs.frac_coords, np.float32),
+                    (rs.lattice, np.float32),
+                    (rs.numbers, np.int32)):
+        a = np.ascontiguousarray(np.asarray(arr, dt))
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return "raw:" + h.hexdigest()
+
+
+def host_image_counts(lattice: np.ndarray, radius: float) -> tuple:
+    """Needed periodic images per axis (f64, the admission pre-check
+    twin of data/neighbors._image_counts)."""
+    inv = np.linalg.inv(np.asarray(lattice, np.float64))
+    return tuple(
+        int(math.ceil(radius * np.linalg.norm(inv[:, k]) - 1e-12))
+        for k in range(3)
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RawSpec:
+    """Everything the in-program search needs: the per-structure atom
+    slot cap, the periodic image caps, and the featurization constants.
+
+    ``snode_cap`` and ``images`` are shared by every rung of a ladder
+    (the plan_shape_set floor rule: ANY admitted structure must fit
+    EVERY rung, so a deadline flush holding one lone structure still
+    has a rung to land in); per-rung capacity scaling lives in the
+    ladder's ``graph_cap`` — rung r's raw program holds
+    ``graph_cap_r x snode_cap`` atom slots and
+    ``graph_cap_r x snode_cap x dense_m`` edge slots.
+    """
+
+    snode_cap: int  # atom slots per structure (S)
+    images: tuple  # (na, nb, nc) periodic image caps per axis
+    radius: float
+    dense_m: int  # max_num_nbr == the dense layout M
+    gauss_filter: Any  # [G] f32 mu grid
+    gauss_var: float
+
+    @property
+    def n_images(self) -> int:
+        na, nb, nc = self.images
+        return (2 * na + 1) * (2 * nb + 1) * (2 * nc + 1)
+
+    def offsets_grid(self) -> np.ndarray:
+        """[K, 3] i32 image offsets in lexicographic (ia, ib, ic) order
+        — the canonical tie-break order, identical to the host search's
+        ``np.mgrid`` enumeration restricted to any sub-grid."""
+        na, nb, nc = self.images
+        return (np.mgrid[-na:na + 1, -nb:nb + 1, -nc:nc + 1]
+                .reshape(3, -1).T.astype(np.int32))
+
+    @property
+    def home_image(self) -> int:
+        na, nb, nc = self.images
+        return (na * (2 * nb + 1) + nb) * (2 * nc + 1) + nc
+
+    # ---- admission ----
+
+    def admits(self, rs: RawStructure) -> bool:
+        """Host pre-check (f64): can THIS structure be staged raw
+        without the in-program search losing true edges? Never raises."""
+        try:
+            if rs.num_nodes < 1 or rs.num_nodes > self.snode_cap:
+                return False
+            z = rs.numbers
+            if z.min(initial=1) < 1 or z.max(initial=1) > MAX_Z:
+                return False
+            need = host_image_counts(rs.lattice, self.radius)
+        except (ValueError, np.linalg.LinAlgError):
+            return False
+        return all(n <= c for n, c in zip(need, self.images))
+
+    def oversize_detail(self, rs: RawStructure) -> str:
+        try:
+            need = host_image_counts(rs.lattice, self.radius)
+        except (ValueError, np.linalg.LinAlgError):
+            need = ("?",) * 3
+        return (
+            f"structure has {rs.num_nodes} atoms (cap {self.snode_cap}) "
+            f"and needs {need} periodic images (caps {self.images})"
+        )
+
+    def template(self) -> RawStructure:
+        """A trivially admissible warmup structure (1 H atom, cubic
+        cell sized so one image per axis suffices)."""
+        a = max(self.radius * 1.5, 1.0)
+        return RawStructure(
+            np.zeros((1, 3)), np.eye(3) * a, np.array([1], np.int32),
+            target=np.zeros(1, np.float32), cif_id="raw-template",
+        )
+
+    def to_meta(self) -> dict:
+        return {
+            "snode_cap": self.snode_cap,
+            "images": list(self.images),
+            "radius": self.radius,
+            "dense_m": self.dense_m,
+            "gauss_len": int(len(self.gauss_filter)),
+        }
+
+
+def plan_raw_spec(
+    calibration: Sequence,
+    gdf,
+    radius: float,
+    dense_m: int,
+    coverage: float = 0.95,
+    image_margin: int = 0,
+) -> RawSpec:
+    """Calibrate a RawSpec from a sample of graphs/structures.
+
+    The in-program search's candidate matrix is ``[S, S*K]`` per
+    structure (S atom slots, K periodic images), so the caps ARE the
+    compute: sizing them at the calibration MAX makes every request pay
+    for the single worst tail structure (one 120-atom tiny-cell crystal
+    inflates the whole ladder ~20x). Instead the caps cover the
+    ``coverage`` quantile of the calibration distribution — structures
+    beyond them are simply NOT raw-admitted (``RawSpec.admits``) and
+    ride the host-featurized path, which exists anyway as the overflow
+    fallback. ``coverage=1.0`` restores max-sizing.
+
+    ``snode_cap`` = the coverage-quantile atom count (8-aligned);
+    ``images`` = the per-axis coverage quantile of the f64 needed-image
+    counts (+``image_margin``), floored at 1. Calibration items must
+    carry a ``lattice`` (CrystalGraph with geometry, Structure, or
+    RawStructure) — without one the image caps cannot be derived from
+    data and raw wire is refused rather than guessed.
+    """
+    if not len(calibration):
+        raise RawUnsupported("raw spec planning needs a calibration sample")
+    if dense_m is None or dense_m < 1:
+        raise RawUnsupported("raw wire requires the dense layout (dense_m)")
+    lattices = [getattr(g, "lattice", None) for g in calibration]
+    if any(la is None for la in lattices):
+        raise RawUnsupported(
+            "calibration sample carries no lattices (featurize with "
+            "keep_geometry=True, or calibrate from structures)"
+        )
+    need = np.stack([host_image_counts(la, radius) for la in lattices])
+    q = min(max(float(coverage), 0.0), 1.0)
+    caps = np.maximum(
+        np.quantile(need, q, axis=0, method="higher"), 1
+    ).astype(np.int64) + image_margin
+    sizes = np.asarray([int(g.num_nodes) for g in calibration])
+    snode = int(np.quantile(sizes, q, method="higher"))
+    snode = max(8, -(-snode // 8) * 8)
+    return RawSpec(
+        snode_cap=snode,
+        images=tuple(int(c) for c in caps),
+        radius=float(radius),
+        dense_m=int(dense_m),
+        gauss_filter=np.asarray(gdf.filter, np.float32),
+        gauss_var=float(gdf.var),
+    )
+
+
+class RawBatch(struct.PyTreeNode):
+    """Wire-form packed batch: per-structure slots (device-side pytree).
+
+    Structure slot ``g`` owns atom slots ``[g*S, (g+1)*S)`` of the flat
+    node space the in-program search emits; the rebuilt GraphBatch's
+    ``node_graph`` is ``slot // S`` and its edge slots follow the dense
+    layout (node n owns edge slots ``[n*M, (n+1)*M)``). Padding
+    structures carry an identity lattice (host-written: the in-program
+    3x3 inverse must never see a singular matrix) and all-zero masks.
+    """
+
+    frac: Any  # [Gcap, S, 3] f32, wrapped into [0, 1)
+    lattices: Any  # [Gcap, 3, 3] f32 (padding: eye)
+    species: Any  # [Gcap, S] i32 atomic number Z (padding: 0)
+    atom_mask: Any  # [Gcap, S] u8
+    graph_mask: Any  # [Gcap] f32
+    targets: Any  # [Gcap, T] f32
+    target_mask: Any  # [Gcap, T] f32
+
+    @property
+    def graph_capacity(self) -> int:
+        return self.targets.shape[0]
+
+    @property
+    def snode_cap(self) -> int:
+        return self.frac.shape[1]
+
+    # PaddingStats/driver interface parity with GraphBatch
+    @property
+    def node_capacity(self) -> int:
+        return self.frac.shape[0] * self.frac.shape[1]
+
+
+def raw_shape_key(batch: RawBatch) -> tuple:
+    """Hashable full-shape key (the batch_shape_key analog)."""
+    return ("raw", np.shape(batch.frac), np.shape(batch.targets))
+
+
+def pack_raw(
+    items: Sequence[RawStructure],
+    graph_cap: int,
+    spec: RawSpec,
+    num_targets: int = 1,
+) -> RawBatch:
+    """Stage wire-form structures into one fixed-capacity RawBatch.
+
+    Near-zero host work by design: wrap + cast + slot copies. No
+    neighbor search, no featurization, no per-edge arrays — that is the
+    point of the wire format.
+    """
+    if not items:
+        raise ValueError("cannot pack an empty structure list")
+    n_items = len(items)
+    if n_items > graph_cap:
+        raise ValueError(f"{n_items} structures exceed graph_cap={graph_cap}")
+    s_cap = spec.snode_cap
+    frac = np.zeros((graph_cap, s_cap, 3), np.float32)
+    lattices = np.zeros((graph_cap, 3, 3), np.float32)
+    lattices[:] = np.eye(3, dtype=np.float32)  # padding-safe inverse
+    species = np.zeros((graph_cap, s_cap), np.int32)
+    atom_mask = np.zeros((graph_cap, s_cap), np.uint8)
+    graph_mask = np.zeros(graph_cap, np.float32)
+    targets = np.zeros((graph_cap, num_targets), np.float32)
+    target_mask = np.zeros((graph_cap, num_targets), np.float32)
+    for gi, rs in enumerate(items):
+        n = rs.num_nodes
+        if n > s_cap:
+            raise ValueError(
+                f"structure {rs.cif_id!r} has {n} atoms > snode_cap="
+                f"{s_cap}; RawSpec.admits should have routed it to the "
+                f"featurized fallback"
+            )
+        f = rs.frac_coords % 1.0
+        # tiny negatives give f == 1.0 exactly under %; enforce the
+        # half-open interval the image-count bound relies on
+        # (data/structure.py wrapped())
+        f = np.where(f >= 1.0, 0.0, f)
+        frac[gi, :n] = f.astype(np.float32)
+        lattices[gi] = rs.lattice.astype(np.float32)
+        species[gi, :n] = rs.numbers
+        atom_mask[gi, :n] = 1
+        graph_mask[gi] = 1.0
+        if rs.target is not None:
+            t = np.atleast_1d(np.asarray(rs.target, np.float32))
+            targets[gi, : len(t)] = t
+            if rs.target_mask is not None:
+                target_mask[gi, : len(t)] = np.atleast_1d(rs.target_mask)
+            else:
+                target_mask[gi, : len(t)] = 1.0
+    return RawBatch(
+        frac=frac, lattices=lattices, species=species,
+        atom_mask=atom_mask, graph_mask=graph_mask,
+        targets=targets, target_mask=target_mask,
+    )
+
+
+def abstract_raw_batch(graph_cap: int, spec: RawSpec,
+                       num_targets: int = 1) -> RawBatch:
+    """A zeros RawBatch of one rung's shape (the graftaudit lowering
+    surface; content-free by construction)."""
+    return pack_raw([spec.template()], graph_cap, spec,
+                    num_targets=num_targets)
+
+
+# ---- the numpy mirror of the in-program search (tests only) ----------
+
+
+def raw_neighbor_graph_host(
+    frac: np.ndarray,  # [S, 3] f32 wrapped (padding rows 0)
+    lattice: np.ndarray,  # [3, 3] f32
+    atom_mask: np.ndarray,  # [S] bool/u8
+    spec: RawSpec,
+) -> tuple:
+    """Numpy mirror of ``ops.neighbor_search`` for ONE structure ->
+    (neighbors [S, M] i32 local, distances [S, M] f32, edge_mask
+    [S, M] u8, n_edges int, overflow bool).
+
+    Same f32 arithmetic and the same canonical order — (center, then
+    distance, then source atom, then lexicographic image) — as the
+    device op; distances can differ from the compiled program by f32
+    roundoff (XLA FMA contraction), while the selected edge set and
+    order are exact wherever the radius/tie decisions are exact.
+    """
+    s_cap, m = spec.snode_cap, spec.dense_m
+    frac = np.asarray(frac, np.float32)
+    lat = np.asarray(lattice, np.float32)
+    mask = np.asarray(atom_mask).astype(bool)
+    grid = spec.offsets_grid()
+    k = len(grid)
+    cart = frac @ lat  # [S, 3] f32
+    shifts = grid.astype(np.float32) @ lat  # [K, 3]
+    pos_j = cart[:, None, :] + shifts[None, :, :]  # [S, K, 3]
+    diff = pos_j[None, :, :, :] - cart[:, None, None, :]  # [S, S, K, 3]
+    d2 = (diff[..., 0] * diff[..., 0] + diff[..., 1] * diff[..., 1]
+          + diff[..., 2] * diff[..., 2])
+    d = np.sqrt(d2).reshape(s_cap, s_cap * k)  # candidate order c = j*K + k
+    valid = (mask[None, :, None] & mask[:, None, None]
+             & np.ones((s_cap, s_cap, k), bool))
+    eye = np.eye(s_cap, dtype=bool)[:, :, None] & (
+        np.arange(k) == spec.home_image
+    )[None, None, :]
+    valid &= ~eye
+    valid = valid.reshape(s_cap, s_cap * k)
+    valid &= d <= np.float32(spec.radius)
+    key = np.where(valid, d, np.float32(np.inf))
+    order = np.argsort(key, axis=1, kind="stable")[:, :m]
+    sorted_d = np.take_along_axis(d, order, axis=1)
+    n_valid = valid.sum(axis=1)
+    emask = (np.arange(m)[None, :] < n_valid[:, None]).astype(np.uint8)
+    nbr = np.where(emask > 0, (order // k).astype(np.int32),
+                   np.arange(s_cap, dtype=np.int32)[:, None])
+    dist = np.where(emask > 0, sorted_d, np.float32(0.0))
+    n_edges = int(np.minimum(n_valid, m).sum())
+    need = needed_images_f32(lat, spec.radius)
+    overflow = bool(np.any(need > np.asarray(spec.images, np.float32)))
+    return nbr, dist.astype(np.float32), emask, n_edges, overflow
+
+
+def needed_images_f32(lattice: np.ndarray, radius: float) -> np.ndarray:
+    """[3] f32 needed-image counts from the f32 lattice — the EXACT
+    formula the compiled program re-derives (ops/neighbor_search.py):
+    plane spacing along axis k is |det| / ||a_{k+1} x a_{k+2}||, so
+    needed_k = ceil(radius / spacing_k - 1e-4). The 1e-4 slack (vs the
+    host f64 pre-check's 1e-12) absorbs f32 roundoff at exact-integer
+    boundaries; a lattice engineered within 1e-4 of one can differ from
+    the f64 judgment by one image, which the host pre-check (not this)
+    gates at admission."""
+    a = np.asarray(lattice, np.float32)
+    cross = np.stack([
+        np.cross(a[1], a[2]), np.cross(a[2], a[0]), np.cross(a[0], a[1]),
+    ]).astype(np.float32)
+    det = np.abs(np.float32(np.dot(a[0], cross[0])))
+    norms = np.sqrt((cross * cross).sum(axis=1))
+    return np.ceil(np.float32(radius) * norms / det - np.float32(1e-4))

@@ -121,6 +121,36 @@ def device_gauges(counters: dict, gauges: dict) -> dict:
     return out
 
 
+def ingest_gauges(counters: dict, gauges: dict) -> dict:
+    """Derived health figures for the on-device ingest path (ISSUE 11),
+    from a run's counters/gauges — the raw-wire analog of
+    ``pipeline_gauges``.
+
+    - ``ingest_cap_overflow_total``: structures the IN-PROGRAM
+      neighbor search flagged (lattice needed more periodic images than
+      the rung provides) and re-served host-featurized. Non-zero on a
+      calibrated ladder means the image caps are mis-planned for live
+      traffic — loadgen asserts zero;
+    - ``ingest_rung{i}_edge_occupancy``: true in-program edge count
+      over allocated edge slots per rung, the signal for re-calibrating
+      ``snode_cap``/``dense_m`` (occupancy near 0 = caps too generous,
+      padded search work; near 1 = truncation pressure).
+    """
+    out = {}
+    if "ingest_cap_overflow" in counters:
+        out["ingest_cap_overflow_total"] = float(
+            counters["ingest_cap_overflow"])
+    occ = {k: float(v) for k, v in gauges.items()
+           if k.startswith("ingest_rung") and k.endswith("_edge_occupancy")}
+    if occ:
+        out.update(sorted(occ.items()))
+        out["ingest_edge_occupancy_min"] = min(occ.values())
+        out["ingest_edge_occupancy_max"] = max(occ.values())
+    if "ingest_raw_wire" in gauges:
+        out["ingest_raw_wire"] = float(gauges["ingest_raw_wire"])
+    return out
+
+
 def pipeline_gauges(counters: dict, gauges: dict) -> dict:
     """Derived health figures for the parallel ingest pipeline
     (data/pipeline.py), from a run's counters/gauges — the

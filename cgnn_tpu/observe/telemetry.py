@@ -24,6 +24,7 @@ from typing import Callable, Iterator
 from cgnn_tpu.observe.gauges import (
     device_gauges,
     hbm_gauges,
+    ingest_gauges,
     padding_gauges,
     pipeline_gauges,
 )
@@ -257,6 +258,7 @@ class Telemetry:
             gauges["scan_dispatch_share"] = scan / (scan + per_step)
         gauges.update(pipeline_gauges(counters, gauges))
         gauges.update(device_gauges(counters, gauges))
+        gauges.update(ingest_gauges(counters, gauges))
         if counters or gauges:
             self.logger.event("run_summary", {
                 "counters": counters, "gauges": gauges,

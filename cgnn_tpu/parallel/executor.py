@@ -137,9 +137,13 @@ class MeshExecutor:
         def stacked_body(state, batch):
             # inside shard_map the batch slice is [1, ...]: squeeze to
             # the single-device batch, run the UNCHANGED body, restack —
-            # per-shard HLO identical to a single-device dispatch
+            # per-shard HLO identical to a single-device dispatch.
+            # tree_map, not [None]: the raw-wire program returns a
+            # (preds, overflow, n_edges) TUPLE (ISSUE 11) and every
+            # output leaf restacks on the device axis the same way
             sub = jax.tree_util.tree_map(lambda x: x[0], batch)
-            return predict_body(state, sub)[None]
+            return jax.tree_util.tree_map(
+                lambda x: x[None], predict_body(state, sub))
 
         return jax.jit(compat.shard_map(
             stacked_body, mesh=self.mesh,

@@ -116,6 +116,12 @@ class Request:
     # co-batched requests must share a tier — the batcher cuts a flush
     # at every tier boundary in the FIFO (see _take_locked)
     precision: str = "f32"
+    # staging form (ISSUE 11): 'feat' = a featurized CrystalGraph (or a
+    # wire-form structure the pack stage will featurize on the pool —
+    # graph then holds the RawStructure until pack time), 'raw' = staged
+    # as a RawBatch for the in-program neighbor search. Like precision,
+    # a flush runs ONE program, so the FIFO cuts at form boundaries.
+    form: str = "feat"
 
 
 @dataclasses.dataclass
@@ -137,6 +143,8 @@ class Flush:
     # the tier every member shares (dispatch picks this tier's program
     # + param variant; serve/quantize.py)
     precision: str = "f32"
+    # the staging form every member shares ('feat' | 'raw'; ISSUE 11)
+    form: str = "feat"
 
     def __bool__(self) -> bool:
         return bool(self.requests or self.expired)
@@ -207,22 +215,25 @@ class MicroBatcher:
         like shape-full: the head tier's prefix fires NOW (one program
         per flush), the next tier starts the next batch — strict FIFO is
         preserved (no reordering around the boundary) and a mixed queue
-        degrades to smaller flushes, never to head-of-line blocking."""
+        degrades to smaller flushes, never to head-of-line blocking.
+        A staging-FORM change (featurized vs raw wire, ISSUE 11) is the
+        same kind of boundary: raw and featurized flushes run different
+        warmed programs."""
         big = self.shape_set.largest
         take: list[Request] = []
         expired: list[Request] = []
         n_nodes = n_edges = 0
         full = False
         boundary = False
-        tier: str | None = None
+        key: tuple | None = None
         for req in self._queue:
             if req.deadline is not None and now >= req.deadline:
                 expired.append(req)
                 continue
-            if tier is None:
-                tier = req.precision
-            elif req.precision != tier:
-                boundary = True  # tier cut: fire the head prefix now
+            if key is None:
+                key = (req.precision, req.form)
+            elif (req.precision, req.form) != key:
+                boundary = True  # tier/form cut: fire the head prefix now
                 break
             if not big.fits(len(take) + 1, n_nodes + req.nodes,
                             n_edges + req.edges):
@@ -276,7 +287,8 @@ class MicroBatcher:
             return Flush(fired, shape, expired, reason,
                          flush_id=f"flush-{self._flush_seq:06d}",
                          precision=(fired[0].precision if fired
-                                    else "f32"))
+                                    else "f32"),
+                         form=(fired[0].form if fired else "feat"))
 
     def next_flush(self) -> Flush | None:
         """Block until the policy fires (worker-thread API).

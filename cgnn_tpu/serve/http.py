@@ -8,9 +8,13 @@ lives in server.py; this module only translates wire <-> core:
 - ``POST /predict``  body ``{"graph": {...}}`` (featurized arrays:
   atom_fea [N,D], edge_fea [E,G], centers [E], neighbors [E]) or
   ``{"structure": {...}}`` (lattice [3,3], frac_coords [N,3], numbers
-  [N]) featurized server-side with the checkpoint's config. Response:
+  [N]) — the RAW WIRE format (ISSUE 11, ~100x fewer bytes): admitted
+  in O(1) and either staged straight into the in-program
+  neighbor-search program (response ``"wire": "raw"``) or featurized
+  ON THE PACK POOL with the checkpoint's config (``"featurized"``) —
+  never synchronously on this handler thread. Response:
   ``{"prediction": [T], "param_version", "latency_ms", "cached",
-  "trace_id", "flush_id", "stamps"}``. An inbound ``X-Request-Id``
+  "wire", "trace_id", "flush_id", "stamps"}``. An inbound ``X-Request-Id``
   header (or body ``trace_id``) becomes the request's trace id; the
   response echoes it in the ``X-Request-Id`` header and carries the
   monotonic stage stamps (queued/packed/dispatched/fetched/replied) so
@@ -40,6 +44,7 @@ from typing import Callable
 import numpy as np
 
 from cgnn_tpu.data.graph import CrystalGraph
+from cgnn_tpu.data.rawbatch import RawStructure
 from cgnn_tpu.observe.metrics_io import jsonfinite
 from cgnn_tpu.serve.batcher import (
     MALFORMED,
@@ -75,35 +80,46 @@ def graph_from_json(payload: dict) -> CrystalGraph:
         raise ValueError(f"malformed graph payload: {e}") from None
 
 
+def structure_from_json(payload: dict) -> RawStructure:
+    """JSON structure dict -> wire-form RawStructure (ISSUE 11).
+
+    NO featurization happens here — the server decides per request
+    whether the structure stages raw (the in-program neighbor search
+    builds the graph) or gets featurized on the PACK POOL (never on
+    this HTTP thread, so one large structure cannot head-of-line-block
+    admission — the old handler featurized synchronously right here)."""
+    try:
+        return RawStructure(
+            np.asarray(payload["frac_coords"], np.float64),
+            np.asarray(payload["lattice"], np.float64),
+            np.asarray(payload["numbers"], np.int32),
+            cif_id=str(payload.get("id", "")),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed structure payload: {e}") from None
+
+
 def make_structure_featurizer(data_cfg) -> Callable[[dict], CrystalGraph]:
     """JSON structure dict -> CrystalGraph via the checkpoint's
-    featurization config (so online requests are featurized exactly like
-    the training data was)."""
-    from cgnn_tpu.data.dataset import featurize_structure
-    from cgnn_tpu.data.structure import Structure
+    featurization config (kept for offline callers; the serving path
+    now admits wire-form structures directly — see structure_from_json
+    — and featurizes on the pack pool via server.structure_featurizer)."""
+    from cgnn_tpu.serve.server import structure_featurizer
 
-    cfg = data_cfg.featurize_config()
-    gdf = cfg.gdf()
+    featurize_raw = structure_featurizer(data_cfg)
 
     def featurize(payload: dict) -> CrystalGraph:
-        try:
-            s = Structure(
-                np.asarray(payload["lattice"], np.float64),
-                np.asarray(payload["frac_coords"], np.float64),
-                np.asarray(payload["numbers"], np.int32),
-            )
-        except (KeyError, TypeError, ValueError) as e:
-            raise ValueError(f"malformed structure payload: {e}") from None
-        return featurize_structure(
-            s, np.zeros(1, np.float32), cfg, str(payload.get("id", "")), gdf
-        )
+        return featurize_raw(structure_from_json(payload))
 
     return featurize
 
 
-def make_handler(server: InferenceServer,
-                 featurize: Callable | None = None):
-    """Build the request-handler class bound to ``server``."""
+def make_handler(server: InferenceServer):
+    """Build the request-handler class bound to ``server``.
+
+    No featurizer here (ISSUE 11): wire-form ``structure`` payloads
+    admit directly as :class:`RawStructure` and the SERVER owns
+    featurization (on the pack pool, when a request can't stage raw)."""
 
     class ServeHandler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -199,12 +215,16 @@ def make_handler(server: InferenceServer,
             try:
                 if "graph" in payload:
                     graph = graph_from_json(payload["graph"])
-                elif "structure" in payload and featurize is not None:
-                    graph = featurize(payload["structure"])
+                elif "structure" in payload:
+                    # wire-form admission: the server stages it raw or
+                    # featurizes it on the pack pool — NOT on this
+                    # handler thread (the pre-ISSUE-11 head-of-line
+                    # blocker)
+                    graph = structure_from_json(payload["structure"])
                 else:
                     raise ValueError(
-                        "payload needs 'graph' (featurized arrays)"
-                        + (" or 'structure'" if featurize else "")
+                        "payload needs 'graph' (featurized arrays) "
+                        "or 'structure' (positions/lattice/numbers)"
                     )
             except ValueError as e:
                 self._reply(400, {"error": str(e)})
@@ -236,6 +256,7 @@ def make_handler(server: InferenceServer,
                 "batch_occupancy": result.batch_occupancy,
                 "device_id": result.device_id,
                 "precision": result.precision,
+                "wire": result.wire,
                 "trace_id": result.trace_id,
                 "flush_id": result.flush_id,
                 "stamps": result.stamps,
@@ -244,9 +265,18 @@ def make_handler(server: InferenceServer,
     return ServeHandler
 
 
+class _ServeHTTPServer(ThreadingHTTPServer):
+    # the stdlib default listen backlog is 5: under a CPU-bound burst
+    # (e.g. raw-wire requests whose search competes with the handler
+    # threads for cores) the kernel RSTs connection number six instead
+    # of queueing it — a spurious transport error the batcher's OWN
+    # backpressure (429) should be the one to refuse. 128 matches a
+    # production listener; the admission queue stays the real limit.
+    request_queue_size = 128
+
+
 def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
-                     port: int = 8437,
-                     featurize: Callable | None = None) -> ThreadingHTTPServer:
+                     port: int = 8437) -> ThreadingHTTPServer:
     """Bind the front-end (call ``.serve_forever()`` on the result;
     ``.shutdown()`` from another thread stops it — the drain path)."""
-    return ThreadingHTTPServer((host, port), make_handler(server, featurize))
+    return _ServeHTTPServer((host, port), make_handler(server))

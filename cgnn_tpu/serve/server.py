@@ -78,8 +78,10 @@ import numpy as np
 
 from cgnn_tpu.analysis import racecheck
 from cgnn_tpu.data.graph import CrystalGraph
+from cgnn_tpu.data.rawbatch import RawStructure, raw_fingerprint
 from cgnn_tpu.serve.batcher import (
     MALFORMED,
+    OVERSIZE,
     TIMEOUT,
     Flush,
     MicroBatcher,
@@ -117,6 +119,12 @@ class ServeResult:
     trace_id: str = ""
     flush_id: str = ""
     stamps: dict = dataclasses.field(default_factory=dict)
+    # which wire form computed it (ISSUE 11): 'raw' = the in-program
+    # neighbor search built the graph from (positions, lattice,
+    # species); 'featurized' = a host-built graph (client-featurized
+    # arrays, the deferred pack-pool featurize, or the cap-overflow
+    # fallback)
+    wire: str = "featurized"
 
 
 class InferenceServer:
@@ -148,6 +156,8 @@ class InferenceServer:
         engine: str = "auto",
         precisions: Sequence[str] = ("f32",),
         model=None,
+        featurizer: Callable | None = None,
+        raw_precheck: bool = True,
         clock: Callable[[], float] = time.monotonic,
         log_fn: Callable = print,
     ):
@@ -225,11 +235,23 @@ class InferenceServer:
             self.param_store = ParamStore(state, version,
                                           devices=self.device_set.devices,
                                           tier_specs=tier_specs)
+        # wire-form structure handling (ISSUE 11): ``featurizer``
+        # (RawStructure -> CrystalGraph, see ``structure_featurizer``)
+        # powers the deferred pack-pool featurize and the cap-overflow
+        # fallback; ``raw_precheck=False`` skips the host image-cap
+        # pre-check at admission so tests/smoke can exercise the
+        # IN-PROGRAM overflow flag end to end (production keeps it on —
+        # the flag is the safety net, not the primary gate)
+        self.featurizer = featurizer
+        self._raw_precheck = bool(raw_precheck)
         # a compact shape set rebuilds GraphBatches INSIDE the compiled
-        # program (expander); the same jitted callable still accepts
-        # full-fidelity batches — the fallback for non-compactable
-        # requests (both forms are warmed, so neither ever recompiles)
-        predict_body = make_predict_step(shape_set.expander())
+        # program (expander); a raw shape set ADDITIONALLY carries the
+        # in-program neighbor-search program (raw_expander); the same
+        # jitted callable still accepts full-fidelity batches — the
+        # fallback for non-compactable/non-raw requests (every form is
+        # warmed, so none ever recompiles)
+        predict_body = make_predict_step(shape_set.expander(),
+                                         shape_set.raw_expander())
         self.predict_step = predict_step or jax.jit(predict_body)
         # the mesh engine's one-dispatch-covers-all-devices program
         # (parallel/executor.py): per (rung, form, tier) there is ONE
@@ -272,6 +294,9 @@ class InferenceServer:
         }
         self._latencies: list[float] = []  # recent, bounded (stats())
         self._occupancies: list[float] = []
+        # per-rung edge-slot occupancy, last value per rung index (the
+        # cap-calibration signal; exported via /metrics and stats())
+        self._rung_edge_occ: dict[int, float] = {}
         self.warmed = False
         self._compiles_after_warm = 0
         # expected per-structure feature layout, learned from the warm
@@ -304,7 +329,7 @@ class InferenceServer:
         # violation at runtime, not a 3am scrape mystery (the PR-6 bug)
         racecheck.watch_fields(self, self._lock, (
             "counts", "_latencies", "_occupancies", "_draining",
-            "_compiles_after_warm",
+            "_compiles_after_warm", "_rung_edge_occ",
         ))
 
     # ---- warmup ----
@@ -325,40 +350,53 @@ class InferenceServer:
         executable per device here and NEVER again (devices.py module
         docstring). Dispatches run under ``telemetry.warmup()`` so
         compile executions never pollute serving counters."""
+        import jax
+
         self._feature_dims = (template.atom_fea.shape[1],
                               template.edge_fea.shape[1])
+        raw_tpl = (self.shape_set.raw.template()
+                   if self.shape_set.raw is not None else None)
         n0 = self._jit_cache_size()
         programs = 0
         with self.telemetry.warmup():
             for shape in self.shape_set:
                 # pack once per form on the host; each device's replica
                 # pulls the same staged batch through its own executable
-                batch = self.shape_set.pack([template], shape=shape)
-                full = (self.shape_set.pack_full([template], shape=shape)
-                        if self.shape_set.compact is not None else None)
+                forms = [self.shape_set.pack([template], shape=shape)]
+                if self.shape_set.compact is not None:
+                    forms.append(
+                        self.shape_set.pack_full([template], shape=shape))
+                if raw_tpl is not None:
+                    # the raw-wire program (ISSUE 11): in-program
+                    # neighbor search + featurize, one per rung
+                    forms.append(
+                        self.shape_set.pack_raw([raw_tpl], shape=shape))
                 if self.mesh_exec is not None:
                     # mesh engine: the warmed program IS the stacked
                     # sharded one — one dispatch covers every device, so
                     # the compile count is programs, never programs x N
                     n = len(self.mesh_exec)
-                    forms = [self.mesh_exec.stage(
-                        self.mesh_exec.stack([batch] * n))]
-                    if full is not None:
-                        forms.append(self.mesh_exec.stage(
-                            self.mesh_exec.stack([full] * n)))
+                    staged_forms = [
+                        self.mesh_exec.stage(self.mesh_exec.stack([b] * n))
+                        for b in forms
+                    ]
                     for tier in self.precisions:
                         state, _ = self.param_store.get(0, tier)
-                        for staged in forms:
-                            np.asarray(self.mesh_predict(state, staged))
-                        programs += len(forms)
+                        for staged in staged_forms:
+                            jax.block_until_ready(
+                                self.mesh_predict(state, staged))
+                        programs += len(staged_forms)
                     continue
                 for tier in self.precisions:
                     for i in range(len(self.device_set)):
                         state, _ = self.param_store.get(i, tier)
-                        np.asarray(self.predict_step(state, batch))
-                        if full is not None:
-                            np.asarray(self.predict_step(state, full))
-                    programs += 1 if full is None else 2
+                        for b in forms:
+                            # block_until_ready over the output pytree:
+                            # the raw program returns a (preds,
+                            # overflow, n_edges) tuple
+                            jax.block_until_ready(
+                                self.predict_step(state, b))
+                    programs += len(forms)
         self.warmed = True
         compiled = (self._jit_cache_size() or 0) - (n0 or 0)
         self._log(
@@ -367,7 +405,8 @@ class InferenceServer:
             f"[{self.engine} engine] / "
             f"{len(self.precisions)} precision tier(s) "
             f"({compiled} fresh compiles"
-            f"{', compact-staged' if self.shape_set.compact else ''})"
+            f"{', compact-staged' if self.shape_set.compact else ''}"
+            f"{', raw-wire' if self.shape_set.raw is not None else ''})"
         )
         return compiled
 
@@ -443,10 +482,16 @@ class InferenceServer:
             counts = dict(self.counts)
             draining = self._draining
             compiles_after_warm = self._compiles_after_warm
+            rung_occ = dict(self._rung_edge_occ)
         counters = {f"serve_{k}": float(v) for k, v in counts.items()}
         tcounters = self.telemetry.counters()
         for name in ("pipeline_jobs", "pipeline_pack_s", "pipeline_wait_s"):
             counters[name] = float(tcounters.get(name, 0.0))
+        # the ISSUE-11 overflow counter under its own (unprefixed) name:
+        # /metrics renders it as ingest_cap_overflow_total, the name the
+        # loadgen's zero-overflow assertion scrapes
+        counters["ingest_cap_overflow"] = float(
+            counts.get("ingest_cap_overflow", 0))
         gauges = {
             "serve_queue_depth": float(self.batcher.depth),
             "serve_draining": float(draining),
@@ -456,7 +501,10 @@ class InferenceServer:
             "pipeline_pack_workers": float(self._pack_workers),
             "device_count": float(len(self.device_set)),
             "serve_engine_mesh": float(self.mesh_exec is not None),
+            "ingest_raw_wire": float(self.shape_set.raw is not None),
         }
+        for rung, occ in sorted(rung_occ.items()):
+            gauges[f"ingest_rung{rung}_edge_occupancy"] = float(occ)
         for i, depth in enumerate(self.device_set.inflight_depths()):
             gauges[f"device{i}_inflight"] = float(depth)
         if self.profiler is not None:
@@ -591,21 +639,76 @@ class InferenceServer:
         if problems:
             raise ServeRejection(MALFORMED, "; ".join(problems))
 
-    def submit(self, graph: CrystalGraph,
+    def _check_wellformed_raw(self, rs: RawStructure) -> None:
+        """Admission-time validation of a wire-form structure: shape,
+        species range, finite geometry, invertible lattice — everything
+        the in-program search (or the fallback featurizer) would choke
+        on must fail ALONE at the door (400)."""
+        from cgnn_tpu.data.elements import MAX_Z
+
+        problems = []
+        if rs.num_nodes < 1:
+            problems.append("structure has no atoms")
+        z = rs.numbers
+        if len(z) and (z.min() < 1 or z.max() > MAX_Z):
+            problems.append(
+                f"species outside the element table [1, {MAX_Z}] "
+                f"(min {z.min()}, max {z.max()})"
+            )
+        if not (np.isfinite(rs.frac_coords).all()
+                and np.isfinite(rs.lattice).all()):
+            problems.append("non-finite coordinates or lattice")
+        elif abs(float(np.linalg.det(rs.lattice))) < 1e-6:
+            problems.append("degenerate lattice (volume ~ 0)")
+        if problems:
+            raise ServeRejection(MALFORMED, "; ".join(problems))
+
+    def _admit_form(self, rs: RawStructure) -> str:
+        """'raw' when the wire-form structure fits the raw rung caps
+        (host f64 pre-check — or just the structural atom-slot cap with
+        ``raw_precheck=False``, leaving the image decision to the
+        in-program flag), else 'feat' (deferred pack-pool featurize)."""
+        spec = self.shape_set.raw
+        if spec is not None:
+            if self._raw_precheck:
+                if spec.admits(rs):
+                    return "raw"
+            elif 1 <= rs.num_nodes <= spec.snode_cap:
+                return "raw"
+        if self.featurizer is None:
+            raise ServeRejection(
+                MALFORMED,
+                "wire-form structure cannot be served: "
+                + (self.shape_set.raw.oversize_detail(rs)
+                   if self.shape_set.raw is not None
+                   else "raw wire is not enabled")
+                + " and no fallback featurizer is configured",
+            )
+        return "feat"
+
+    def submit(self, graph,
                timeout_ms: float | None = None,
                trace_id: str | None = None,
                precision: str | None = None) -> RequestFuture:
         """Admit one structure; returns its future (raises ServeRejection
-        on malformed / queue-full / oversize / draining). ``trace_id``
-        carries an inbound X-Request-Id; absent, one is minted here —
-        admission is where a request's journey starts. ``precision``
-        picks the serving tier (None = 'f32'); a tier the server did
-        not warm is rejected AT ADMISSION — flushing it would trace a
-        fresh program (a recompile after warmup)."""
+        on malformed / queue-full / oversize / draining). ``graph`` is a
+        featurized ``CrystalGraph`` OR a wire-form ``RawStructure``
+        (ISSUE 11): wire-form structures that fit the raw rung caps are
+        staged raw (the in-program neighbor search builds the graph);
+        the rest are featurized ON THE PACK POOL at pack time — never
+        on this thread, so one large structure cannot head-of-line-block
+        admission. ``trace_id`` carries an inbound X-Request-Id; absent,
+        one is minted here — admission is where a request's journey
+        starts. ``precision`` picks the serving tier (None = 'f32'); a
+        tier the server did not warm is rejected AT ADMISSION —
+        flushing it would trace a fresh program (a recompile after
+        warmup)."""
         now = self._clock()
         queued = self._stamp()
         tid = self._mint_trace(trace_id)
         tier = precision or "f32"
+        is_raw_wire = isinstance(graph, RawStructure)
+        form = "feat"
         self._count("requests")
         try:
             if tier not in self.precisions:
@@ -614,11 +717,41 @@ class InferenceServer:
                     f"precision {tier!r} not in this server's warmed "
                     f"tiers {list(self.precisions)}",
                 )
-            self._check_wellformed(graph)
+            if is_raw_wire:
+                self._check_wellformed_raw(graph)
+                form = self._admit_form(graph)
+                if form == "feat" and self.shape_set.dense_m is None:
+                    # COO layout: a flush's edge budget needs the TRUE
+                    # edge count, which only featurization knows — the
+                    # legacy inline path (the dense layout, where slot
+                    # ownership is structural, defers to the pack pool)
+                    try:
+                        graph = self.featurizer(graph)
+                    except Exception as e:  # noqa: BLE001 — reject alone
+                        raise ServeRejection(
+                            MALFORMED,
+                            f"structure featurization failed: {e}",
+                        ) from None
+                    is_raw_wire = False
+                    self._check_wellformed(graph)
+            else:
+                self._check_wellformed(graph)
         except ServeRejection as e:
             self._count(f"reject_{e.reason}")
             raise
-        fp = structure_fingerprint(graph) if self.cache is not None else None
+        if self.cache is None:
+            fp = None
+        elif is_raw_wire:
+            # content hash of the wire encoding; form-qualified so a
+            # row computed by the raw program ('raw:...') never answers
+            # a host-featurized request ('fs:...') — the two programs
+            # agree only to f32 roundoff, and a cached row is
+            # (params, structure, PROGRAM)-determined (serve/cache.py)
+            fp = raw_fingerprint(graph)
+            if form != "raw":
+                fp = "fs:" + fp[len("raw:"):]
+        else:
+            fp = structure_fingerprint(graph)
         if fp is not None and tier != "f32":
             # cached rows are (params, structure, TIER)-determined:
             # tier-qualify the key so an f32 answer can never serve an
@@ -643,6 +776,7 @@ class InferenceServer:
                         latency_ms=latency_ms, cached=True,
                         device_id=-1, trace_id=tid, precision=tier,
                         stamps={"queued": queued, "replied": replied},
+                        wire="raw" if form == "raw" else "featurized",
                     ))
                     # cache hits ARE served responses: they must feed the
                     # same latency distributions clients measure, or the
@@ -664,11 +798,14 @@ class InferenceServer:
             deadline=None if timeout is None else now + timeout,
             fingerprint=fp,
             # decided once here: a flush packs compact only when EVERY
-            # member can (batcher.Request docstring)
-            compactable=self.shape_set.compactable(graph),
+            # member can (batcher.Request docstring). Deferred-featurize
+            # structures resolve their probe at pack time, on the pool.
+            compactable=(False if is_raw_wire
+                         else self.shape_set.compactable(graph)),
             trace_id=tid,
             stamps={"queued": queued},
             precision=tier,
+            form=form,
         )
         try:
             self.batcher.offer(req)
@@ -938,9 +1075,17 @@ class InferenceServer:
         dispatched = self._stamp()
         flush.stamps["dispatched"] = dispatched
         staged = self.mesh_exec.stage(stacked)
-        # np.array: a true host copy of the gathered [N, G, T] result
-        # (device_get ALIASES device buffers on CPU — GC-ALIAS)
-        out = np.array(jax.device_get(self.mesh_predict(state, staged)))
+        # tree_map(np.array, ...): a true host copy of every gathered
+        # result leaf — the raw program returns a (preds, overflow,
+        # n_edges) tuple (device_get ALIASES device buffers on CPU —
+        # GC-ALIAS)
+        res = jax.tree_util.tree_map(
+            np.array, jax.device_get(self.mesh_predict(state, staged)))
+        overflow = raw_edges = None
+        if flush.form == "raw":
+            out, overflow, raw_edges = res
+        else:
+            out = res
         fetched = self._stamp()
         flush.stamps["fetched"] = fetched
         post = self._jit_cache_size()
@@ -964,10 +1109,18 @@ class InferenceServer:
         for i, c in enumerate(counts):
             if c > 0:
                 self._count(f"batches_device{i}")
+        # same accounting as the threads engine, over the n shards the
+        # dispatch spanned (raw_edges comes back [n_shards, G'])
+        self._note_edge_occupancy(flush, raw_edges, shape=sub_shape,
+                                  n_shards=n)
+        wire = "raw" if flush.form == "raw" else "featurized"
         for j, r in enumerate(reqs):
             # request j sat at (shard j % N, row j // N): the
             # round-robin split coordinate (executor.split_round_robin)
             shard, row = j % n, j // n
+            if overflow is not None and overflow[shard, row]:
+                self._fallback_overflow(r)
+                continue
             prediction = out[shard, row].copy()
             latency_ms = (now - r.enqueued) * 1e3
             if self.cache is not None and r.fingerprint is not None:
@@ -978,7 +1131,7 @@ class InferenceServer:
                 prediction=prediction, param_version=version,
                 latency_ms=latency_ms, batch_occupancy=occupancy,
                 device_id=shard, trace_id=r.trace_id, precision=tier,
-                flush_id=flush.flush_id, stamps=stamps,
+                flush_id=flush.flush_id, stamps=stamps, wire=wire,
             ))
             if self.telemetry.spans is not None:  # skip arg-building when off
                 self._span("serve.request", stamps["queued"], replied,
@@ -993,6 +1146,8 @@ class InferenceServer:
             self._lat_rolling.add(latency_ms)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
             self._count("responses")
+            if wire == "raw":
+                self._count("responses_raw")
             if tier != "f32":
                 self._count(f"responses_{tier}")
         self._count("batches")
@@ -1012,16 +1167,61 @@ class InferenceServer:
                 f"{(self._clock() - r.enqueued) * 1e3:.1f} ms in queue",
             ))
 
+    def _featurize_pending(self, flush: Flush) -> None:
+        """Resolve deferred wire-form structures in a featurized flush:
+        featurize HERE — this runs on the pack pool (or the worker's
+        pack stage), never on the admission thread, so one large
+        structure cannot head-of-line-block admission (the ISSUE-11
+        bugfix). A structure the featurizer rejects fails ALONE (its
+        future gets the error; co-batched members keep flying)."""
+        keep = []
+        for r in flush.requests:
+            if not isinstance(r.graph, RawStructure):
+                keep.append(r)
+                continue
+            try:
+                if self.featurizer is None:
+                    raise ValueError("no fallback featurizer configured")
+                g = self.featurizer(r.graph)
+                self._check_wellformed(g)
+            except Exception as e:  # noqa: BLE001 — fail THIS request only
+                self._count("reject_malformed")
+                r.future.set_error(ServeRejection(
+                    MALFORMED, f"structure featurization failed: {e}"))
+                continue
+            r.graph = g
+            r.compactable = self.shape_set.compactable(g)
+            keep.append(r)
+        flush.requests = keep
+
     def _pack_flush(self, flush: Flush, pool=None):
-        """-> (batch, pool buffer or None). Compact staging when the
-        shape set carries a spec AND every request in the flush is
-        compactable (admission-time flag); full-fidelity otherwise.
+        """-> (batch, pool buffer or None). Raw-wire flushes stage the
+        RawBatch form (near-zero host work — the in-program search
+        builds the graph); featurized flushes first resolve any
+        deferred wire-form structures (``_featurize_pending``), then
+        compact staging when the shape set carries a spec AND every
+        request in the flush is compactable, full-fidelity otherwise.
 
         Under the mesh engine the packed form is the SPLIT one: the
         flush's graphs round-robined across the mesh, each shard packed
         into one common rung, stacked on the device axis —
         ``(stacked, per-shard real counts, rung)``."""
+        if flush.form != "raw":
+            self._featurize_pending(flush)
+            if not flush.requests:
+                raise ValueError("every request in the flush failed "
+                                 "featurization")
         graphs = [r.graph for r in flush.requests]
+        if flush.form == "raw":
+            self._count("pack_raw")
+            if self.mesh_exec is not None:
+                groups, sub_shape, counts = self.mesh_exec.plan_flush(
+                    graphs, self.shape_set)
+                stacked = self.mesh_exec.stack(
+                    [self.shape_set.pack_raw(g, shape=sub_shape)
+                     for g in groups])
+                return (stacked, counts, sub_shape), None
+            return self.shape_set.pack_raw(graphs, shape=flush.shape), None
         if self.mesh_exec is not None:
             groups, sub_shape, counts = self.mesh_exec.plan_flush(
                 graphs, self.shape_set)
@@ -1104,10 +1304,19 @@ class InferenceServer:
         pre = self._jit_cache_size()
         dispatched = self._stamp()
         flush.stamps["dispatched"] = dispatched
-        # np.array, not asarray: a true host copy (device_get ALIASES
-        # device buffers on CPU — graftcheck GC-ALIAS) so response rows
-        # never share memory with a buffer the pool is about to recycle
-        out = np.array(jax.device_get(self.predict_step(state, batch)))
+        # tree_map(np.array, ...), not asarray: a true host copy of
+        # every output leaf (device_get ALIASES device buffers on CPU —
+        # graftcheck GC-ALIAS) so response rows never share memory with
+        # a buffer the pool is about to recycle
+        res = jax.tree_util.tree_map(
+            np.array, jax.device_get(self.predict_step(state, batch)))
+        overflow = raw_edges = None
+        if flush.form == "raw":
+            # the raw program's output contract (train/step.py): a
+            # (predictions, cap_overflow, n_edges) tuple
+            out, overflow, raw_edges = res
+        else:
+            out = res
         fetched = self._stamp()
         flush.stamps["fetched"] = fetched
         post = self._jit_cache_size()
@@ -1133,7 +1342,17 @@ class InferenceServer:
         now = self._clock()
         occupancy = len(reqs) / flush.shape.graph_cap
         self._count(f"batches_device{device}")
+        self._note_edge_occupancy(flush, raw_edges)
+        wire = "raw" if flush.form == "raw" else "featurized"
         for i, r in enumerate(reqs):
+            if overflow is not None and overflow[i]:
+                # the in-program cap-overflow flag (INVARIANTS.md): this
+                # structure's lattice needs more periodic images than
+                # the rung provides — its row was computed from a
+                # TRUNCATED graph and must never be served. Route it to
+                # the host-featurized fallback form instead.
+                self._fallback_overflow(r)
+                continue
             row = out[i].copy()
             latency_ms = (now - r.enqueued) * 1e3
             if self.cache is not None and r.fingerprint is not None:
@@ -1144,7 +1363,7 @@ class InferenceServer:
                 prediction=row, param_version=version,
                 latency_ms=latency_ms, batch_occupancy=occupancy,
                 device_id=device, trace_id=r.trace_id, precision=tier,
-                flush_id=flush.flush_id, stamps=stamps,
+                flush_id=flush.flush_id, stamps=stamps, wire=wire,
             ))
             # the whole journey, one span per request: admission ->
             # reply, args carrying the flush join key and stage stamps
@@ -1163,6 +1382,8 @@ class InferenceServer:
             # describe the same distribution stats() does (PERF.md §10)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
             self._count("responses")
+            if wire == "raw":
+                self._count("responses_raw")
             if tier != "f32":
                 self._count(f"responses_{tier}")
         self._count("batches")
@@ -1172,6 +1393,66 @@ class InferenceServer:
         self._occ_rolling.add(occupancy)
         self.telemetry.observe_value("serve_batch_occupancy", occupancy)
         self.telemetry.set_gauge("serve_queue_depth", self.batcher.depth)
+
+    # ---- raw-wire overflow + occupancy bookkeeping (ISSUE 11) ----
+
+    def _fallback_overflow(self, r) -> None:
+        """Route one overflow-flagged raw request to the featurized
+        fallback: re-offer it as a deferred-featurize request sharing
+        the SAME future/trace/deadline (the pack pool featurizes it, a
+        featurized flush answers it). Runs on the dispatch thread —
+        cheap (no featurization here), and the counter is the telemetry
+        the loadgen/smoke pin."""
+        self._count("ingest_cap_overflow")
+        self.telemetry.counter_add("ingest_cap_overflow", 1)
+        if self.featurizer is None:
+            r.future.set_error(ServeRejection(
+                OVERSIZE,
+                self.shape_set.raw.oversize_detail(r.graph)
+                + " (in-program cap-overflow flag; no fallback "
+                  "featurizer configured)",
+            ))
+            return
+        fallback = Request(
+            graph=r.graph, enqueued=r.enqueued, deadline=r.deadline,
+            future=r.future, fingerprint=None, compactable=False,
+            trace_id=r.trace_id, stamps=r.stamps, precision=r.precision,
+            form="feat",
+        )
+        try:
+            self.batcher.offer(fallback)
+        except ServeRejection as e:
+            self._count(f"reject_{e.reason}")
+            r.future.set_error(e)
+
+    def _note_edge_occupancy(self, flush: Flush, raw_edges,
+                             shape=None, n_shards: int = 1) -> None:
+        """Per-rung edge-slot occupancy — the cap-calibration signal
+        (observe/gauges.py ``ingest_gauges``; /metrics). For raw
+        flushes the TRUE edge count comes back from the program
+        (``n_edges``); featurized flushes count host-known edges. The
+        mesh engine passes its common rung + shard count (the dispatch
+        spanned ``n_shards`` copies of the rung's slots) — ONE
+        accounting shared by both engines, so the formula cannot
+        drift between them."""
+        shape = shape or flush.shape
+        try:
+            rung = self.shape_set.shapes.index(shape)
+        except ValueError:
+            return
+        if flush.form == "raw":
+            if raw_edges is None:
+                return
+            spec = self.shape_set.raw
+            slots = (n_shards * shape.graph_cap * spec.snode_cap
+                     * spec.dense_m)
+            occ = float(np.asarray(raw_edges).sum()) / max(slots, 1)
+        else:
+            occ = sum(r.graph.num_edges for r in flush.requests) \
+                / max(n_shards * shape.edge_cap, 1)
+        with self._lock:
+            self._rung_edge_occ[rung] = occ
+        self.telemetry.set_gauge(f"ingest_rung{rung}_edge_occupancy", occ)
 
     # ---- bookkeeping ----
 
@@ -1206,6 +1487,7 @@ class InferenceServer:
             occ = list(self._occupancies)
             draining = self._draining
             compiles_after_warm = self._compiles_after_warm
+            rung_occ = dict(self._rung_edge_occ)
         out = {
             "counts": counts,
             "queue_depth": self.batcher.depth,
@@ -1232,6 +1514,11 @@ class InferenceServer:
             "recompiles_after_warm": compiles_after_warm,
             "ingest": {
                 "compact": self.shape_set.compact is not None,
+                "raw": self.shape_set.raw is not None,
+                "cap_overflows": counts.get("ingest_cap_overflow", 0),
+                "rung_edge_occupancy": {
+                    str(k): v for k, v in sorted(rung_occ.items())
+                },
                 "pack_workers": self._pack_workers,
                 "pack_s": self.telemetry.series_quantiles("serve_pack_s"),
                 "pipeline_wait_s": self.telemetry.series_quantiles(
@@ -1244,6 +1531,27 @@ class InferenceServer:
             out["reload"] = {"swaps": self._watcher.swaps,
                              "skips": self._watcher.skips}
         return out
+
+
+def structure_featurizer(data_cfg) -> Callable:
+    """RawStructure -> CrystalGraph via the checkpoint's featurization
+    config (the deferred pack-pool featurize + cap-overflow fallback;
+    http.py's JSON featurizer delegates here so online requests are
+    featurized exactly like the training data was)."""
+    from cgnn_tpu.data.dataset import featurize_structure
+    from cgnn_tpu.data.structure import Structure
+
+    cfg = data_cfg.featurize_config()
+    gdf = cfg.gdf()
+
+    def featurize(rs: RawStructure) -> CrystalGraph:
+        s = Structure(rs.lattice, rs.frac_coords, rs.numbers)
+        target = (rs.target if rs.target is not None
+                  else np.zeros(1, np.float32))
+        return featurize_structure(s, target, cfg, rs.cif_id, gdf,
+                                   target_mask=rs.target_mask)
+
+    return featurize
 
 
 def plan_from_state(meta: dict) -> dict:
@@ -1270,6 +1578,8 @@ def load_server(
     default_timeout_ms: float | None = 1000.0,
     cache_size: int = 1024,
     compact: str = "auto",
+    wire: str = "auto",
+    raw_precheck: bool = True,
     pack_workers: int | None = None,
     devices: str | int = "auto",
     engine: str = "auto",
@@ -1355,8 +1665,13 @@ def load_server(
     model_cfg = model_cfg.for_arbitrary_inputs()
     model = build_model(model_cfg, data_cfg, cfg["task"])
     if calibration is None:
+        # keep_geometry: raw-wire spec planning (below) calibrates its
+        # periodic image caps from the calibration LATTICES; the graphs'
+        # packed shapes are unchanged (pack_graphs always allocates the
+        # geometry fields)
         calibration = load_synthetic(
-            calibration_n, data_cfg.featurize_config(), seed=0
+            calibration_n, data_cfg.featurize_config(), seed=0,
+            keep_geometry=True,
         )
     dense_m = model_cfg.dense_m or None
     edge_dtype = (jax.numpy.bfloat16 if model_cfg.dtype == "bfloat16"
@@ -1383,10 +1698,35 @@ def load_server(
         except CompactUnsupported as e:
             log_fn(f"serve: compact staging unavailable ({e}); "
                    f"full-fidelity packing")
+    # raw wire (ISSUE 11): like compact, 'auto' engages on accelerator
+    # backends only — on CPU the host IS the device, so moving the
+    # neighbor search "on device" just moves it between host cores while
+    # paying padded per-structure slots; 'raw' forces (the CI smoke and
+    # A/B legs), 'featurized' disables
+    if wire not in ("auto", "raw", "featurized"):
+        raise ValueError(
+            f"wire must be 'auto', 'raw', or 'featurized', got {wire!r}"
+        )
+    want_raw = wire == "raw" or (wire == "auto" and on_accelerator)
+    raw_spec = None
+    if want_raw and dense_m is not None:
+        from cgnn_tpu.data.rawbatch import RawUnsupported, plan_raw_spec
+
+        fcfg = data_cfg.featurize_config()
+        try:
+            raw_spec = plan_raw_spec(
+                list(calibration), fcfg.gdf(), fcfg.radius, dense_m,
+            )
+        except RawUnsupported as e:
+            log_fn(f"serve: raw wire unavailable ({e}); "
+                   f"featurized wire only")
+    elif want_raw:
+        log_fn("serve: raw wire requires the dense layout; "
+               "featurized wire only")
     shape_set = plan_shape_set(
         calibration, batch_size, rungs=rungs, dense_m=dense_m,
         edge_dtype=edge_dtype, num_targets=model_cfg.num_targets,
-        compact=compact_spec,
+        compact=compact_spec, raw=raw_spec,
     )
     template = calibration[0]
     # model init reads the expanded form regardless of staging mode
@@ -1408,7 +1748,9 @@ def load_server(
         max_queue=max_queue, max_wait_ms=max_wait_ms,
         default_timeout_ms=default_timeout_ms, cache_size=cache_size,
         pack_workers=pack_workers, devices=device_list, engine=engine,
-        precisions=precisions, model=model, log_fn=log_fn,
+        precisions=precisions, model=model,
+        featurizer=structure_featurizer(data_cfg),
+        raw_precheck=raw_precheck, log_fn=log_fn,
     )
     server.warm(template)
     if profile_dir:

@@ -78,6 +78,7 @@ class ShapeSet:
         edge_dtype=np.float32,
         num_targets: int = 1,
         compact=None,
+        raw=None,
     ):
         if not shapes:
             raise ValueError("a ShapeSet needs at least one shape")
@@ -94,6 +95,24 @@ class ShapeSet:
         if compact is not None and dense_m is None:
             raise ValueError("compact staging requires the dense layout "
                              "(dense_m)")
+        # RawSpec | None (ISSUE 11): with one, the set ALSO compiles a
+        # raw-wire program per rung — wire-form (positions, lattice,
+        # species) structures stage as RawBatch and the in-program
+        # neighbor search builds the graph (ops/neighbor_search.py).
+        # The spec's snode_cap/image caps are shared by every rung (the
+        # admitted-fits-every-rung floor rule); rung r's raw program
+        # holds graph_cap_r structure slots.
+        self.raw = raw
+        if raw is not None:
+            if dense_m is None:
+                raise ValueError("raw wire requires the dense layout "
+                                 "(dense_m)")
+            if raw.dense_m != dense_m:
+                raise ValueError(
+                    f"raw spec max_num_nbr {raw.dense_m} != layout "
+                    f"dense_m {dense_m} (the in-program truncation must "
+                    f"match the model's slot layout)"
+                )
         for s in self.shapes:
             if dense_m is not None and s.edge_cap != s.node_cap * dense_m:
                 raise ValueError(
@@ -126,6 +145,44 @@ class ShapeSet:
         False without one; never raises — the serving admission probe.)"""
         return (self.compact is not None
                 and self.compact.graph_compactable(graph))
+
+    def raw_expander(self, impl: str = "xla"):
+        """Jit-composable RawBatch -> (GraphBatch, overflow, n_edges)
+        for this set's raw spec (None without one) — hand it to
+        ``train.step.make_predict_step(raw_expander=...)``."""
+        if self.raw is None:
+            return None
+        from cgnn_tpu.ops.neighbor_search import make_raw_expander
+
+        return make_raw_expander(self.raw, edge_dtype=self.edge_dtype,
+                                 impl=impl)
+
+    def admits_raw(self, rs) -> bool:
+        """Host pre-check: can this wire-form structure be staged raw
+        (atom count + periodic image caps, f64)? Always False without a
+        raw spec; never raises — the serving admission probe. A False
+        here routes the request to the host-featurized fallback, not to
+        a rejection."""
+        return self.raw is not None and self.raw.admits(rs)
+
+    def pack_raw(self, items: Sequence, shape: BatchShape | None = None):
+        """Stage wire-form structures into one rung's RawBatch (default:
+        the smallest rung whose graph slots fit them)."""
+        if self.raw is None:
+            raise ValueError("this shape set carries no raw spec")
+        from cgnn_tpu.data.rawbatch import pack_raw
+
+        if shape is None:
+            for s in self.shapes:
+                if len(items) <= s.graph_cap:
+                    shape = s
+                    break
+            if shape is None:
+                raise ValueError(
+                    f"{len(items)} structures fit no rung's graph slots"
+                )
+        return pack_raw(list(items), shape.graph_cap, self.raw,
+                        num_targets=self.num_targets)
 
     def graph_counts(self, graph: CrystalGraph) -> tuple[int, int]:
         """(nodes, edge slots) one graph consumes under this set's layout.
@@ -234,6 +291,9 @@ class ShapeSet:
             if self.compact is not None:
                 forms["compact"] = self.pack([template], shape=shape)
             forms["full"] = self.pack_full([template], shape=shape)
+            if self.raw is not None:
+                forms["raw"] = self.pack_raw([self.raw.template()],
+                                             shape=shape)
             for form, batch in forms.items():
                 out[(i, form)] = jax.tree_util.tree_map(aval, batch)
         return out
@@ -265,6 +325,7 @@ class ShapeSet:
             if self.edge_dtype is not np.float32 else "float32",
             "num_targets": self.num_targets,
             "compact": self.compact is not None,
+            "raw": None if self.raw is None else self.raw.to_meta(),
         }
 
 
@@ -277,6 +338,7 @@ def plan_shape_set(
     edge_dtype=np.float32,
     num_targets: int | None = None,
     compact=None,
+    raw=None,
 ) -> ShapeSet:
     """Quantize a serving ladder from a calibration sample.
 
@@ -317,4 +379,5 @@ def plan_shape_set(
         edge_dtype=edge_dtype,
         num_targets=num_targets,
         compact=compact,
+        raw=raw,
     )

@@ -91,6 +91,178 @@ def _shape_set_plan(graphs: Sequence, shape_set):
                shape_set.shape_for(len(cur), n, e))
 
 
+def run_raw_inference(
+    state,
+    items: Sequence,
+    shape_set,
+    *,
+    predict_step=None,
+    devices: Sequence | None = None,
+    engine: str = "auto",
+    raw_fallback=None,
+) -> tuple[np.ndarray, float]:
+    """Predict over wire-form ``RawStructure`` items through the
+    in-program neighbor search (ISSUE 11) -> ([n, T] predictions in
+    input order, end-to-end structures/sec).
+
+    ``shape_set`` must carry a raw spec; every item must pass
+    ``shape_set.admits_raw`` (callers route the rest through the
+    featurized path — predict.py does). Packing is near-zero host work
+    (slot copies), so there is no pack pipeline here; batches fill the
+    largest rung's graph slots greedily and the tail takes the smallest
+    fitting rung. In-program cap-overflow flags (a lattice needing more
+    images than the rung provides — possible only within the f32/f64
+    eps band once ``admits_raw`` passed) are re-served through
+    ``raw_fallback`` (RawStructure -> CrystalGraph) when given, else
+    raised — NEVER silently answered from a truncated graph.
+
+    ``devices``/``engine`` mirror ``run_fast_inference``: 'mesh' stacks
+    batches N-at-a-time under one sharded dispatch; 'threads'
+    round-robins per-device replicas; both bit-exact vs single-device.
+    """
+    from cgnn_tpu.data.rawbatch import RawStructure
+
+    if shape_set is None or shape_set.raw is None:
+        raise ValueError("run_raw_inference needs a shape set with a "
+                         "raw spec (plan_shape_set(raw=...))")
+    if not len(items):
+        raise ValueError("no structures to predict")
+    for it in items:
+        if not isinstance(it, RawStructure):
+            raise ValueError("run_raw_inference takes RawStructure items")
+        if not shape_set.admits_raw(it):
+            raise ValueError(
+                f"structure {it.cif_id!r} exceeds the raw rung caps: "
+                f"{shape_set.raw.oversize_detail(it)} — route it "
+                f"through the featurized path"
+            )
+    if predict_step is None:
+        predict_body = make_predict_step(
+            shape_set.expander(), shape_set.raw_expander())
+        predict_step = jax.jit(predict_body)
+    else:
+        predict_body = predict_step
+    n = len(items)
+    t0 = time.perf_counter()
+
+    big = shape_set.largest
+
+    def plan():
+        start = 0
+        while start < n:
+            end = min(start + big.graph_cap, n)
+            count = end - start
+            shape = next(s for s in shape_set.shapes
+                         if s.graph_cap >= count)
+            yield np.arange(start, end), items[start:end], shape
+            start = end
+
+    use_mesh = (devices is not None and len(devices) > 1
+                and engine in ("auto", "mesh"))
+    if use_mesh:
+        from cgnn_tpu.parallel.executor import MeshExecutor
+
+        executor = MeshExecutor(devices)
+        mesh_predict = executor.shard_predict(predict_body)
+        placed = executor.place_params(state)
+        states, n_dev = (state,), 1
+    elif devices is not None and len(devices) > 1:
+        from cgnn_tpu.serve.devices import replicate_state
+
+        states = replicate_state(state, devices)
+        n_dev = len(states)
+    else:
+        states, n_dev = (state,), 1
+
+    preds: np.ndarray | None = None
+    overflow_at: list = []  # (global index, item) pairs to re-serve
+    outs: list = []  # (spans, shape, out tuple) per dispatch
+    recent: list[list] = [[] for _ in range(max(n_dev, 1))]
+    di_seq = [0]
+
+    if use_mesh:
+        group: list = []
+        group_shape = [None]
+
+        def _flush_group():
+            if not group:
+                return
+            batches = [b for _, b in group]
+            while len(batches) < len(executor):
+                batches.append(batches[-1])
+            staged = executor.stage(executor.stack(batches))
+            out = mesh_predict(placed, staged)
+            outs.append(([s for s, _ in group], group_shape[0], out))
+            recent[0].append(out)
+            if len(recent[0]) == _WINDOW:
+                # fence on the OLDEST in-window result (the _WINDOW
+                # discipline): the newer dispatches stay in flight
+                float(recent[0][0][0][0, 0, 0])
+                del recent[0][:]
+            del group[:]
+
+        for span, sub, shape in plan():
+            if group_shape[0] is not None and (
+                shape != group_shape[0] or len(group) == len(executor)
+            ):
+                _flush_group()
+            group_shape[0] = shape
+            group.append((span, shape_set.pack_raw(sub, shape=shape)))
+            if len(group) == len(executor):
+                _flush_group()
+        _flush_group()
+        for spans, _shape, out in outs:
+            fetched = jax.tree_util.tree_map(
+                lambda x: np.array(jax.device_get(x)), out)
+            p, ovf = fetched[0], fetched[1]
+            if preds is None:
+                preds = np.zeros((n, p.shape[-1]), np.float32)
+            for i, span in enumerate(spans):
+                preds[span] = p[i][: len(span)]
+                for k in np.nonzero(ovf[i][: len(span)])[0]:
+                    overflow_at.append(int(span[k]))
+    else:
+        for span, sub, shape in plan():
+            batch = shape_set.pack_raw(sub, shape=shape)
+            di = di_seq[0] % n_dev
+            di_seq[0] += 1
+            out = predict_step(states[di], batch)
+            outs.append(([span], shape, out))
+            recent[di].append(out)
+            if len(recent[di]) == _WINDOW:
+                # value-fetch fence on the oldest in-window result
+                # (train.loop._WINDOW discipline, tuple-aware)
+                float(recent[di][0][0][0, 0])
+                del recent[di][:]
+        for spans, _shape, out in outs:
+            p = np.array(jax.device_get(out[0]))
+            ovf = np.array(jax.device_get(out[1]))
+            span = spans[0]
+            if preds is None:
+                preds = np.zeros((n, p.shape[-1]), np.float32)
+            preds[span] = p[: len(span)]
+            for k in np.nonzero(ovf[: len(span)])[0]:
+                overflow_at.append(int(span[k]))
+
+    if overflow_at:
+        # the in-program flag fired (INVARIANTS.md: never serve a
+        # truncated graph): re-serve those rows host-featurized
+        if raw_fallback is None:
+            bad = [items[i].cif_id or str(i) for i in overflow_at]
+            raise RuntimeError(
+                f"in-program cap-overflow flag on {bad}; pass "
+                f"raw_fallback= to re-serve them host-featurized"
+            )
+        fgraphs = [raw_fallback(items[i]) for i in overflow_at]
+        fpreds, _ = run_fast_inference(
+            state, fgraphs, max(1, len(fgraphs)), shape_set=shape_set,
+            predict_step=predict_step,
+        )
+        for row, i in enumerate(overflow_at):
+            preds[i] = fpreds[row]
+    return preds, n / (time.perf_counter() - t0)
+
+
 def run_fast_inference(
     state,
     graphs: Sequence,

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from cgnn_tpu.data.graph import GraphBatch
+from cgnn_tpu.data.rawbatch import RawBatch
 from cgnn_tpu.train.state import TrainState
 
 
@@ -171,7 +172,8 @@ def jit_train_step(body: Callable):
     return jax.jit(body, donate_argnums=TRAIN_STEP_DONATE)
 
 
-def make_predict_step(expander: Callable | None = None) -> Callable:
+def make_predict_step(expander: Callable | None = None,
+                      raw_expander: Callable | None = None) -> Callable:
     """(state, batch) -> denormalized predictions [G, T].
 
     ``expander`` (``data.compact.make_expander``) lets the step accept
@@ -182,9 +184,23 @@ def make_predict_step(expander: Callable | None = None) -> Callable:
     trace time, so ONE jitted callable serves both staging modes — a
     full-fidelity ``GraphBatch`` traces its own cache entry and runs
     unchanged (the serving fallback for non-compactable requests).
+
+    ``raw_expander`` (``ops.neighbor_search.make_raw_expander``) adds
+    the third staging form (ISSUE 11): a ``RawBatch`` of wire-form
+    structures is turned into a GraphBatch by the IN-PROGRAM periodic
+    neighbor search + featurization, and the step returns the tuple
+    ``(predictions [G, T], cap_overflow [G] bool, n_edges [G] i32)`` —
+    the overflow flag is part of the program's contract (a flagged
+    structure's row must never be served; INVARIANTS.md), and the edge
+    counts feed the per-rung edge-occupancy gauges.
     """
 
     def predict_step(state: TrainState, batch):
+        if raw_expander is not None and isinstance(batch, RawBatch):
+            gb, overflow, n_edges = raw_expander(batch)
+            out = state.apply_fn(state.variables(), gb, train=False)
+            preds = state.normalizer.denorm(out) * gb.graph_mask[:, None]
+            return preds, overflow, n_edges
         if expander is not None and not isinstance(batch, GraphBatch):
             batch = expander(batch)
         out = state.apply_fn(state.variables(), batch, train=False)

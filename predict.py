@@ -52,6 +52,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "serially on the main thread (default: 4 on an "
                         "accelerator backend, 0 on CPU — overlap threads "
                         "only steal cores from a CPU 'device')")
+    p.add_argument("--wire", choices=["auto", "raw", "featurized"],
+                   default="auto",
+                   help="wire format of the ladder path (ISSUE 11): "
+                        "'raw' stages (positions, lattice, species) and "
+                        "the compiled program runs the periodic neighbor "
+                        "search + featurization itself (~100x fewer "
+                        "staged bytes, near-zero host work; structures "
+                        "outside the raw rung caps ride the featurized "
+                        "path); 'auto' engages on accelerator backends "
+                        "— on CPU the host IS the device, so moving the "
+                        "search 'on device' buys nothing")
     p.add_argument("--compact", choices=["auto", "on", "off"],
                    default="auto",
                    help="stage raw CompactBatch forms (~12x fewer host and "
@@ -172,6 +183,11 @@ def _run(args, mgr) -> int:
     if args.cache and not os.path.exists(args.cache):
         print(f"--cache {args.cache} does not exist", file=sys.stderr)
         return 2
+    # raw wire wants geometry kept at featurize time (the graphs convert
+    # back to wire form via raw_from_graph); CPU 'auto' stays featurized
+    # — the host IS the device (the compact/pack-workers rule)
+    want_raw = (args.wire == "raw"
+                or (args.wire == "auto" and jax.default_backend() != "cpu"))
     if args.cache:
         from cgnn_tpu.data.cache import load_graph_cache
 
@@ -181,7 +197,9 @@ def _run(args, mgr) -> int:
         if force_task:
             graphs = load_trajectory(args.synthetic, data_cfg.featurize_config())
         else:
-            graphs = load_synthetic(args.synthetic, data_cfg.featurize_config())
+            graphs = load_synthetic(args.synthetic,
+                                    data_cfg.featurize_config(),
+                                    keep_geometry=want_raw)
     else:
         if not args.root_dir:
             print("DATA_DIR, --cache, or --synthetic is required",
@@ -201,7 +219,7 @@ def _run(args, mgr) -> int:
         else:
             graphs = load_cif_directory(
                 args.root_dir, data_cfg.featurize_config(),
-                keep_geometry=force_task,
+                keep_geometry=force_task or want_raw,
             )
     # pack the way the model expects (dense slot layout rides in the
     # checkpoint meta; see data/graph.py pack_graphs)
@@ -276,22 +294,74 @@ def _run(args, mgr) -> int:
         # and the ladder's packers run on --pack-workers threads.
         from cgnn_tpu.serve.shapes import plan_shape_set
 
+        raw_spec = None
+        if want_raw and layout_m is not None and not force_task:
+            from cgnn_tpu.data.rawbatch import RawUnsupported, plan_raw_spec
+
+            fcfg = data_cfg.featurize_config()
+            try:
+                raw_spec = plan_raw_spec(graphs, fcfg.gdf(), fcfg.radius,
+                                         layout_m)
+            except RawUnsupported as e:
+                print(f"raw wire unavailable ({e}); featurized wire",
+                      file=sys.stderr)
         shape_set = plan_shape_set(
             graphs, args.batch_size, rungs=args.rungs, dense_m=layout_m,
             edge_dtype=edge_dtype, num_targets=model_cfg.num_targets,
             compact=_probe_compact(args, graphs, data_cfg, layout_m,
                                    edge_dtype),
+            raw=raw_spec,
         )
-        preds, rate = run_fast_inference(
-            state, graphs, args.batch_size, shape_set=shape_set,
-            pack_workers=args.pack_workers, devices=devices,
-            engine=args.engine,
-        )
-        print(f"inference throughput: {rate:.0f} structures/sec "
-              f"(dispatch-pipelined, {len(shape_set)}-rung shape ladder, "
-              f"{'compact' if shape_set.compact else 'full'}-staged, "
-              f"{args.pack_workers} pack workers, "
-              f"{len(devices)} device(s), {args.engine} engine)")
+        if raw_spec is not None:
+            # raw wire (ISSUE 11): structures stage as (positions,
+            # lattice, species) and the compiled program builds the
+            # graph; anything outside the raw rung caps rides the
+            # featurized ladder, rows merged back in input order
+            from cgnn_tpu.data.rawbatch import raw_from_graph
+            from cgnn_tpu.train.infer import run_raw_inference
+
+            raws = [raw_from_graph(g) for g in graphs]
+            raw_idx = [i for i, r in enumerate(raws)
+                       if r is not None and shape_set.admits_raw(r)]
+            admitted = set(raw_idx)
+            feat_idx = [i for i in range(len(graphs))
+                        if i not in admitted]
+            by_id = {id(raws[i]): graphs[i] for i in raw_idx}
+            preds = np.zeros((len(graphs), model_cfg.num_targets),
+                             np.float32)
+            rate = 0.0
+            if raw_idx:
+                rp, rate = run_raw_inference(
+                    state, [raws[i] for i in raw_idx], shape_set,
+                    devices=devices, engine=args.engine,
+                    raw_fallback=lambda rs: by_id[id(rs)],
+                )
+                preds[raw_idx] = rp
+            if feat_idx:
+                fpreds, _ = run_fast_inference(
+                    state, [graphs[i] for i in feat_idx],
+                    args.batch_size, shape_set=shape_set,
+                    pack_workers=args.pack_workers, devices=devices,
+                    engine=args.engine,
+                )
+                preds[feat_idx] = fpreds
+            print(f"inference throughput: {rate:.0f} structures/sec "
+                  f"(raw wire, in-program neighbor search, "
+                  f"{len(raw_idx)}/{len(graphs)} structures raw-staged, "
+                  f"{len(shape_set)}-rung ladder, {len(devices)} "
+                  f"device(s), {args.engine} engine)")
+        else:
+            preds, rate = run_fast_inference(
+                state, graphs, args.batch_size, shape_set=shape_set,
+                pack_workers=args.pack_workers, devices=devices,
+                engine=args.engine,
+            )
+            print(f"inference throughput: {rate:.0f} structures/sec "
+                  f"(dispatch-pipelined, {len(shape_set)}-rung shape "
+                  f"ladder, "
+                  f"{'compact' if shape_set.compact else 'full'}-staged, "
+                  f"{args.pack_workers} pack workers, "
+                  f"{len(devices)} device(s), {args.engine} engine)")
     if not force_task:
         for g, p in zip(graphs, preds):
             rows.append(

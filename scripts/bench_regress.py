@@ -55,6 +55,13 @@ DEFAULT_KEYS = (
     "inference_structs_per_sec",
     "inference_e2e_structs_per_sec",
     "inference_e2e_multidev_structs_per_sec",
+    # ISSUE 11: raw-wire ingest — the e2e rate through the in-program
+    # neighbor search and the structural bytes-on-wire win (both
+    # higher-is-better; dropping either from a bench round is how the
+    # raw path would silently rot)
+    "inference_e2e_raw_structs_per_sec",
+    "ingest_wire_bytes_ratio",
+    "ingest_raw_admit_share",
     "padding_eff_nodes",
     "padding_eff_edges",
     "oc20.oc20_structs_per_sec",

@@ -54,6 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", default="auto",
                    help="device-parallel e2e leg (ISSUE 5): 'auto' or a "
                         "forced count (the CI 8-host-device dryrun)")
+    p.add_argument("--wire", choices=["both", "raw", "featurized"],
+                   default="both",
+                   help="ISSUE-11 raw-wire leg: measure bytes-on-wire "
+                        "and host-ms/request for raw (positions/"
+                        "lattice/species + in-program neighbor search) "
+                        "vs compact vs full staging, parity-asserted; "
+                        "'featurized' skips it (the pre-ISSUE-11 "
+                        "output)")
     return p
 
 
@@ -113,7 +121,8 @@ def main(argv=None) -> int:
 
     m = args.dense_m
     cfg = FeaturizeConfig(radius=6.0, max_num_nbr=m)
-    graphs = load_synthetic_mp(args.n, cfg, seed=args.seed)
+    graphs = load_synthetic_mp(args.n, cfg, seed=args.seed,
+                               keep_geometry=args.wire != "featurized")
     spec = CompactSpec.build(graphs, cfg.gdf(), dense_m=m)
     ladder = plan_shape_set(graphs, args.batch_size, rungs=args.rungs,
                             dense_m=m, compact=spec)
@@ -178,7 +187,7 @@ def main(argv=None) -> int:
     )
     np.testing.assert_array_equal(preds, mdev_preds)
 
-    print(json.dumps(jsonfinite({
+    out = {
         "pack_structs_per_sec": round(args.n / pack_s, 1),
         "e2e_structs_per_sec": round(e2e, 1),
         "e2e_multidev_structs_per_sec": round(mdev_e2e, 1),
@@ -191,7 +200,90 @@ def main(argv=None) -> int:
         "n": args.n,
         "workers": args.workers,
         "compact": True,
-    })))
+    }
+
+    if args.wire != "featurized":
+        # ---- ISSUE-11 raw-wire leg: bytes-on-wire + host-ms/request
+        # for raw vs compact vs full, parity-asserted ----
+        from cgnn_tpu.data.rawbatch import plan_raw_spec, raw_from_graph
+        from cgnn_tpu.serve.shapes import plan_shape_set as _plan
+        from cgnn_tpu.train.infer import run_raw_inference
+        from cgnn_tpu.train.step import make_predict_step as _mps
+
+        raw_spec = plan_raw_spec(graphs, cfg.gdf(), cfg.radius, m)
+        raw_ladder = _plan(graphs, args.batch_size, rungs=args.rungs,
+                           dense_m=m, compact=spec, raw=raw_spec)
+        all_raws = [raw_from_graph(g) for g in graphs]
+        # coverage-quantile caps (plan_raw_spec): the tail beyond them
+        # rides the featurized path by design — report the admit share
+        admit = [i for i, r in enumerate(all_raws)
+                 if r is not None and raw_ladder.admits_raw(r)]
+        assert len(admit) >= 0.8 * args.n, (
+            f"only {len(admit)}/{args.n} of the calibration set fits "
+            f"its own calibrated caps {raw_spec.to_meta()}"
+        )
+        raws = [all_raws[i] for i in admit]
+        n_raw = len(raws)
+        # bytes ON THE WIRE per request: the f32 raw encoding vs the
+        # featurized arrays a legacy client ships (the acceptance
+        # criterion is the ratio, >= 20x)
+        wire_raw = sum(r.wire_nbytes for r in raws)
+        wire_feat = sum(
+            g.atom_fea.nbytes + g.edge_fea.nbytes + g.centers.nbytes
+            + g.neighbors.nbytes for g in (graphs[i] for i in admit)
+        )
+        # host work per request: pack time only — the raw pack is slot
+        # copies, the search itself runs in-program
+        def _time_pack(fn):
+            best = float("inf")
+            for _ in range(args.repeats):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        def _pack_raw_all():
+            big = raw_ladder.largest
+            for s0 in range(0, n_raw, big.graph_cap):
+                raw_ladder.pack_raw(raws[s0:s0 + big.graph_cap],
+                                    shape=big)
+
+        raw_pack_s = _time_pack(_pack_raw_all)
+        rstep = jax.jit(_mps(raw_ladder.expander(),
+                             raw_ladder.raw_expander()))
+        raw_preds, _ = run_raw_inference(state, raws, raw_ladder,
+                                         predict_step=rstep)
+        raw_e2e = max(
+            run_raw_inference(state, raws, raw_ladder,
+                              predict_step=rstep)[1]
+            for _ in range(args.repeats)
+        )
+        # parity: the in-program graph construction must agree with the
+        # host featurizer's predictions (f32-roundoff tolerance — the
+        # search runs in f32 where the host ran f64; tests pin the
+        # bit-exact structural contract)
+        feat_preds, _ = run_fast_inference(
+            state, [graphs[i] for i in admit], args.batch_size,
+            shape_set=raw_ladder, predict_step=rstep, pack_workers=0,
+        )
+        np.testing.assert_allclose(raw_preds, feat_preds, rtol=1e-3,
+                                   atol=1e-3)
+        out.update({
+            "raw_e2e_structs_per_sec": round(raw_e2e, 1),
+            "raw_pack_structs_per_sec": round(n_raw / raw_pack_s, 1),
+            "raw_admit_share": round(len(admit) / args.n, 3),
+            "wire_bytes_raw": int(wire_raw),
+            "wire_bytes_featurized": int(wire_feat),
+            "wire_bytes_ratio": round(wire_feat / max(wire_raw, 1), 1),
+            "host_ms_per_request_raw": round(raw_pack_s / n_raw * 1e3,
+                                             4),
+            "host_ms_per_request_compact": round(pack_s / args.n * 1e3,
+                                                 4),
+            "host_ms_per_request_full": round(
+                serial_pack_s / args.n * 1e3, 4),
+        })
+
+    print(json.dumps(jsonfinite(out)))
     return 0
 
 

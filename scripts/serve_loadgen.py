@@ -67,6 +67,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="compact-staged serving (ISSUE 4): auto = "
                         "accelerator backends only; on/off force the "
                         "A/B legs")
+    p.add_argument("--wire", choices=["featurized", "raw", "mixed"],
+                   default="featurized",
+                   help="request wire format (ISSUE 11): 'raw' submits "
+                        "wire-form (positions, lattice, species) "
+                        "structures — the server's in-program neighbor "
+                        "search builds the graph; 'mixed' draws "
+                        "raw/featurized 50:50 per request (exercises "
+                        "the batcher's form-boundary cut). Both force "
+                        "raw-wire serving on (CPU CI never picks it "
+                        "under 'auto'). The report breaks responses "
+                        "down per wire and HARD-ASSERTS zero "
+                        "in-program cap overflows on the calibrated "
+                        "ladder (unless --raw-overflow-probe)")
+    p.add_argument("--raw-overflow-probe", action="store_true",
+                   help="disable the host image-cap pre-check and "
+                        "submit one tiny-cell structure that the "
+                        "IN-PROGRAM overflow flag must catch and route "
+                        "to the featurized fallback (asserted); "
+                        "in-proc raw/mixed modes only")
     p.add_argument("--pack-workers", type=int, default=None,
                    help="server pack pipeline threads (0 = in-line pack, "
                         "the pre-ISSUE-4 worker; default follows the "
@@ -201,6 +220,8 @@ class _ClientStats:
         self.trace_ids: set = set()
         self.missing_trace = 0
         self.flush_ids: set = set()
+        # wire form -> responses ('raw' | 'featurized'; ISSUE 11)
+        self.wire_responses: dict[str, int] = {}
 
 
 def _measured_p99(stats: _ClientStats) -> float:
@@ -252,6 +273,7 @@ def _run_inproc(args) -> dict:
         telemetry = Telemetry(args.telemetry, tdir)
     else:
         telemetry = Telemetry.disabled()
+    want_raw = args.wire in ("raw", "mixed")
     server, parts = load_server(
         args.ckpt_dir,
         batch_size=args.batch_size,
@@ -260,6 +282,10 @@ def _run_inproc(args) -> dict:
         max_queue=args.max_queue,
         max_wait_ms=args.max_wait_ms,
         compact=args.compact,
+        # raw/mixed legs FORCE raw-wire serving (CPU CI would never
+        # pick it under 'auto' — the host IS the device there)
+        wire="raw" if want_raw else "auto",
+        raw_precheck=not args.raw_overflow_probe,
         pack_workers=args.pack_workers,
         devices=args.devices,
         engine=args.engine,
@@ -276,10 +302,16 @@ def _run_inproc(args) -> dict:
     compiles_at_warm = server._jit_cache_size()
 
     from cgnn_tpu.data.dataset import load_synthetic
+    from cgnn_tpu.data.rawbatch import raw_from_graph
 
     pool = load_synthetic(args.structures, parts["data_cfg"].
-                          featurize_config(), seed=args.seed + 1)
+                          featurize_config(), seed=args.seed + 1,
+                          keep_geometry=want_raw)
     pool = [g for g in pool if server.shape_set.admits(g)]
+    raw_pool = []
+    if want_raw:
+        raw_pool = [r for r in (raw_from_graph(g) for g in pool)
+                    if r is not None]
 
     stats = _ClientStats()
     stop = threading.Event()
@@ -288,8 +320,12 @@ def _run_inproc(args) -> dict:
         rng = np.random.default_rng(args.seed + ci)
         interval = 1.0 / args.rate if args.rate > 0 else 0.0
         tiers = [t.strip() for t in args.precision.split(",") if t.strip()]
+        raw_share = {"featurized": 0.0, "mixed": 0.5, "raw": 1.0}[args.wire]
         while not stop.is_set():
-            g = pool[int(rng.integers(len(pool)))]
+            if raw_pool and rng.random() < raw_share:
+                g = raw_pool[int(rng.integers(len(raw_pool)))]
+            else:
+                g = pool[int(rng.integers(len(pool)))]
             # uniform random tier per request: with more than one tier
             # this exercises the batcher's tier-boundary flush cut under
             # real concurrency (a random draw can starve a tier on very
@@ -341,6 +377,8 @@ def _run_inproc(args) -> dict:
                 fid = getattr(res, "flush_id", "")
                 if fid:
                     stats.flush_ids.add(fid)
+                w = getattr(res, "wire", "featurized")
+                stats.wire_responses[w] = stats.wire_responses.get(w, 0) + 1
                 if res.cached:
                     stats.cached += 1
                 else:
@@ -409,6 +447,50 @@ def _run_inproc(args) -> dict:
         except Exception as e:  # noqa: BLE001 — reported as a failure
             probe_trace = f"ERROR: {e!r}"
 
+    # raw-wire probes (ISSUE 11), fired alongside the load:
+    # - parity: ONE structure submitted both raw and featurized must
+    #   agree to f32 roundoff (the two wire forms run different warmed
+    #   programs — the in-program search vs the host featurizer);
+    # - overflow (with --raw-overflow-probe): a tiny cell needing more
+    #   periodic images than the calibrated caps, admitted past the
+    #   disabled pre-check — the IN-PROGRAM flag must catch it and the
+    #   featurized fallback answer it (wire='featurized', counter > 0).
+    raw_probe: dict = {}
+    if want_raw and raw_pool:
+        try:
+            pg, pr = next(
+                (g, r) for g, r in ((g, raw_from_graph(g)) for g in pool)
+                if r is not None and server.shape_set.admits_raw(r)
+            )
+            r_raw = server.submit(pr, timeout_ms=args.timeout_ms)
+            r_feat = server.submit(pg, timeout_ms=args.timeout_ms)
+            a = r_raw.result(args.timeout_ms / 1000.0 + 60.0)
+            b = r_feat.result(args.timeout_ms / 1000.0 + 60.0)
+            diff = float(np.abs(a.prediction - b.prediction).max())
+            raw_probe["parity"] = {
+                "wire_a": a.wire, "wire_b": b.wire,
+                "max_abs_diff": diff,
+                "ok": a.wire == "raw" and diff < 1e-3,
+            }
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            raw_probe["parity"] = {"ok": False, "error": repr(e)}
+    if args.raw_overflow_probe and want_raw:
+        from cgnn_tpu.data.rawbatch import RawStructure
+
+        tiny = RawStructure(
+            np.array([[0.2, 0.2, 0.2], [0.7, 0.6, 0.5]]),
+            np.eye(3) * 1.8, np.array([6, 8], np.int32),
+            cif_id="overflow-probe",
+        )
+        try:
+            res = server.predict(tiny, timeout_ms=args.timeout_ms)
+            raw_probe["overflow"] = {
+                "wire": res.wire,
+                "ok": res.wire == "featurized",
+            }
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            raw_probe["overflow"] = {"ok": False, "error": repr(e)}
+
     swapped_to = None
     if args.hot_swap:
         time.sleep(args.duration / 2)
@@ -460,6 +542,13 @@ def _run_inproc(args) -> dict:
             "requested": args.precision,
             "responses_by_tier": dict(sorted(
                 stats.precision_responses.items())),
+        },
+        "wire": {
+            "requested": args.wire,
+            "responses_by_wire": dict(sorted(
+                stats.wire_responses.items())),
+            "raw_pool": len(raw_pool),
+            "probes": raw_probe,
         },
         "devices": {
             "requested": str(args.devices),
@@ -523,12 +612,28 @@ def _run_http(args) -> dict:
 
     from cgnn_tpu.config import DataConfig
     from cgnn_tpu.data.dataset import load_synthetic
+    from cgnn_tpu.data.rawbatch import raw_from_graph
 
+    want_raw = args.wire in ("raw", "mixed")
     pool = load_synthetic(
         min(args.structures, 64),
         DataConfig(radius=6.0, max_num_nbr=12).featurize_config(),
         seed=args.seed + 1,
+        keep_geometry=want_raw,
     )
+    # wire-form request bodies (ISSUE 11): the ~100x smaller encoding a
+    # raw-wire client ships — positions/lattice/species only
+    raw_bodies = []
+    if want_raw:
+        for g in pool:
+            r = raw_from_graph(g)
+            if r is not None:
+                raw_bodies.append({
+                    "frac_coords": r.frac_coords.tolist(),
+                    "lattice": r.lattice.tolist(),
+                    "numbers": r.numbers.tolist(),
+                    "id": r.cif_id,
+                })
     stats = _ClientStats()
     stop = threading.Event()
 
@@ -536,18 +641,26 @@ def _run_http(args) -> dict:
 
     def client(ci: int):
         rng = np.random.default_rng(args.seed + ci)
+        raw_share = {"featurized": 0.0, "mixed": 0.5, "raw": 1.0}[args.wire]
         while not stop.is_set():
-            g = pool[int(rng.integers(len(pool)))]
             # allow_nan=False, not jsonfinite(): features are finite by
             # construction, and the recursive rebuild in N client hot
             # loops would skew the rps/p99 this tool exists to measure
-            body = json.dumps({"graph": {
-                "atom_fea": g.atom_fea.tolist(),
-                "edge_fea": g.edge_fea.tolist(),
-                "centers": g.centers.tolist(),
-                "neighbors": g.neighbors.tolist(),
-                "id": g.cif_id,
-            }, "timeout_ms": args.timeout_ms}, allow_nan=False).encode()
+            if raw_bodies and rng.random() < raw_share:
+                payload_body = {"structure": raw_bodies[
+                    int(rng.integers(len(raw_bodies)))]}
+            else:
+                g = pool[int(rng.integers(len(pool)))]
+                payload_body = {"graph": {
+                    "atom_fea": g.atom_fea.tolist(),
+                    "edge_fea": g.edge_fea.tolist(),
+                    "centers": g.centers.tolist(),
+                    "neighbors": g.neighbors.tolist(),
+                    "id": g.cif_id,
+                }}
+            body = json.dumps({**payload_body,
+                               "timeout_ms": args.timeout_ms},
+                              allow_nan=False).encode()
             req = urllib.request.Request(
                 base + "/predict", data=body,
                 headers={"Content-Type": "application/json"},
@@ -579,6 +692,8 @@ def _run_http(args) -> dict:
                 fid = payload.get("flush_id", "")
                 if fid:
                     stats.flush_ids.add(fid)
+                w = payload.get("wire", "featurized")
+                stats.wire_responses[w] = stats.wire_responses.get(w, 0) + 1
 
     # mid-load wire-path plane checks (GET /metrics, POST /profile) —
     # fired against the LIVE server while the clients keep hammering it
@@ -627,32 +742,42 @@ def _run_http(args) -> dict:
         t.start()
 
     # the X-Request-Id contract, over the wire: a probe's inbound header
-    # must come back as its trace id (response body AND echo header)
+    # must come back as its trace id (response body AND echo header).
+    # Bounded retries on TRANSPORT errors only: under a CPU-bound burst
+    # a connection can be refused/reset before the listener accepts it —
+    # that is load-shedding noise, not the header-echo contract this
+    # probe pins (HTTP rejections still fail it immediately).
     probe_trace = None
-    try:
-        g = pool[0]
-        req = urllib.request.Request(
-            base + "/predict",
-            data=json.dumps({"graph": {
-                "atom_fea": g.atom_fea.tolist(),
-                "edge_fea": g.edge_fea.tolist(),
-                "centers": g.centers.tolist(),
-                "neighbors": g.neighbors.tolist(),
-            }, "timeout_ms": args.timeout_ms}, allow_nan=False).encode(),
-            headers={"Content-Type": "application/json",
-                     "X-Request-Id": "loadgen-probe-1"},
-        )
-        with urllib.request.urlopen(
-            req, timeout=args.timeout_ms / 1000.0 + 30.0
-        ) as resp:
-            payload = json.loads(resp.read())
-            header_echo = resp.headers.get("X-Request-Id")
-        probe_trace = payload.get("trace_id")
-        if header_echo != probe_trace:
-            probe_trace = (f"ERROR: body {probe_trace!r} != header "
-                           f"{header_echo!r}")
-    except Exception as e:  # noqa: BLE001 — reported as a failure
-        probe_trace = f"ERROR: {e!r}"
+    g = pool[0]
+    req = urllib.request.Request(
+        base + "/predict",
+        data=json.dumps({"graph": {
+            "atom_fea": g.atom_fea.tolist(),
+            "edge_fea": g.edge_fea.tolist(),
+            "centers": g.centers.tolist(),
+            "neighbors": g.neighbors.tolist(),
+        }, "timeout_ms": args.timeout_ms}, allow_nan=False).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "loadgen-probe-1"},
+    )
+    for attempt in range(4):
+        try:
+            with urllib.request.urlopen(
+                req, timeout=args.timeout_ms / 1000.0 + 30.0
+            ) as resp:
+                payload = json.loads(resp.read())
+                header_echo = resp.headers.get("X-Request-Id")
+            probe_trace = payload.get("trace_id")
+            if header_echo != probe_trace:
+                probe_trace = (f"ERROR: body {probe_trace!r} != header "
+                               f"{header_echo!r}")
+            break
+        except (ConnectionError, OSError) as e:
+            probe_trace = f"ERROR: {e!r}"
+            time.sleep(1.0 + attempt)
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            probe_trace = f"ERROR: {e!r}"
+            break
 
     time.sleep(max(0.0, args.duration - (time.monotonic() - t_start)))
     stop.set()
@@ -676,6 +801,13 @@ def _run_http(args) -> dict:
             "p99": float(np.percentile(lat, 99)),
         },
         "param_versions": stats.versions,
+        "wire": {
+            "requested": args.wire,
+            "responses_by_wire": dict(sorted(
+                stats.wire_responses.items())),
+            "raw_pool": len(raw_bodies),
+            "probes": {},
+        },
         "tracing": {
             "unique_trace_ids": len(stats.trace_ids),
             "missing_trace_ids": stats.missing_trace,
@@ -781,6 +913,44 @@ def main(argv=None) -> int:
             failures.append(
                 f"mid-load profile capture wrote an EMPTY artifact: {prof}"
             )
+    wire = report.get("wire", {})
+    if args.wire in ("raw", "mixed"):
+        by_wire = wire.get("responses_by_wire", {})
+        if not by_wire.get("raw"):
+            failures.append(
+                f"raw wire requested but no raw-wire responses: {by_wire}"
+            )
+        if args.wire == "mixed" and not by_wire.get("featurized"):
+            failures.append(
+                f"mixed wire load produced no featurized responses "
+                f"(form-boundary cut unexercised): {by_wire}"
+            )
+    if not args.http and args.wire in ("raw", "mixed"):
+        probes = wire.get("probes", {})
+        if "parity" in probes and not probes["parity"].get("ok"):
+            failures.append(f"raw-vs-featurized parity probe failed: "
+                            f"{probes['parity']}")
+        if args.raw_overflow_probe:
+            if not probes.get("overflow", {}).get("ok"):
+                failures.append(f"in-program overflow probe failed: "
+                                f"{probes.get('overflow')}")
+            ovf = (report.get("server_stats", {}).get("ingest", {})
+                   .get("cap_overflows", 0))
+            if not ovf:
+                failures.append(
+                    "overflow probe ran but ingest_cap_overflow_total "
+                    "never incremented"
+                )
+        else:
+            # the satellite invariant: on a CALIBRATED ladder with the
+            # host pre-check on, the in-program flag must never fire
+            ovf = (report.get("server_stats", {}).get("ingest", {})
+                   .get("cap_overflows", 0))
+            if ovf:
+                failures.append(
+                    f"{ovf} in-program cap overflows on the calibrated "
+                    f"ladder (pre-check on: must be 0)"
+                )
     if args.hot_swap and not args.http:
         versions = report["param_versions"]
         if report["hot_swap"]["watcher_swaps"] < 1:

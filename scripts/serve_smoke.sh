@@ -229,8 +229,44 @@ print("leg 1e ok:", r["answered"], "answered across tiers", by_tier,
       "- 0 drops / 0 recompiles")
 EOF
 
+echo "== leg 1g: raw-wire ingest under load (ISSUE 11) =="
+# mixed raw/featurized traffic against a raw-wire server (forced: CPU
+# 'auto' keeps raw off — the host IS the device). Invariants: zero
+# drops, ZERO recompiles after warmup (raw programs warmed per rung
+# like every other form), BOTH wires answered (the batcher's
+# form-boundary cut runs constantly), the raw-vs-featurized parity
+# probe agrees to f32 roundoff, and the --raw-overflow-probe leg
+# proves the IN-PROGRAM cap-overflow flag end to end: a tiny cell
+# needing more periodic images than the calibrated caps slips past
+# the (disabled) host pre-check, the compiled program flags it, and
+# the featurized fallback answers it — never the truncated graph.
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --clients 32 --duration 6 --wire mixed --raw-overflow-probe \
+  --report "$WORK/slo_rawwire.json"
+python - "$WORK/slo_rawwire.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["dropped"] == 0, r
+assert r["compiles"]["after_warm"] == 0, r["compiles"]
+assert not r["failures"], r["failures"]
+w = r["wire"]["responses_by_wire"]
+assert w.get("raw") and w.get("featurized"), w
+p = r["wire"]["probes"]
+assert p["parity"]["ok"] and p["parity"]["max_abs_diff"] < 1e-3, p
+assert p["overflow"]["ok"] and p["overflow"]["wire"] == "featurized", p
+ing = r["server_stats"]["ingest"]
+assert ing["raw"] and ing["cap_overflows"] >= 1, ing
+assert ing["rung_edge_occupancy"], ing
+print("leg 1g ok:", r["answered"], "answered across wires", w,
+      "- parity", p["parity"]["max_abs_diff"], "- overflow fallback",
+      ing["cap_overflows"], "- rung occupancy",
+      ing["rung_edge_occupancy"], "- 0 drops / 0 recompiles")
+EOF
+
 echo "== leg 2: HTTP front-end + graceful SIGTERM drain =="
-python serve.py "$WORK/ckpt" --port "$PORT" --calibrate 64 \
+# --wire raw: the HTTP leg doubles as the raw-wire wire-path smoke —
+# structure payloads admit straight into the in-program search
+python serve.py "$WORK/ckpt" --port "$PORT" --calibrate 64 --wire raw \
   >"$WORK/serve.log" 2>&1 &
 SPID=$!
 for _ in $(seq 1 600); do
@@ -249,7 +285,8 @@ curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null
 # the exposition format + required families with an independent curl
 # while the server is still up
 python scripts/serve_loadgen.py --http "http://127.0.0.1:$PORT" \
-  --clients 8 --duration 6 --profile-mid --report "$WORK/slo_http.json"
+  --clients 8 --duration 6 --profile-mid --wire mixed \
+  --report "$WORK/slo_http.json"
 
 echo "== leg 2b: metrics-scrape (exposition format + families) =="
 curl -sf "http://127.0.0.1:$PORT/metrics" > "$WORK/metrics.prom"
@@ -279,6 +316,11 @@ import json, sys
 r = json.load(open(sys.argv[1]))
 assert r["answered"] > 0, "HTTP leg answered nothing"
 assert not r["failures"], r["failures"]
+# raw wire over the wire: structure payloads must have been answered by
+# the in-program search (response "wire": "raw"), graph payloads by the
+# featurized programs — mixed traffic, zero recompiles by construction
+w = r["wire"]["responses_by_wire"]
+assert w.get("raw") and w.get("featurized"), w
 t = r["tracing"]
 assert t["missing_trace_ids"] == 0, t
 assert t["probe_trace_id"] == "loadgen-probe-1", t

@@ -40,6 +40,16 @@ def build_parser() -> argparse.ArgumentParser:
                    default="auto",
                    help="compact-staged serving (data/compact.py): auto "
                         "engages on accelerator backends, on/off force")
+    p.add_argument("--wire", choices=["auto", "raw", "featurized"],
+                   default="auto",
+                   help="raw-wire serving (ISSUE 11): 'raw' admits "
+                        "(positions, lattice, species) structure "
+                        "payloads straight into a warmed in-program "
+                        "neighbor-search + featurize program (~100x "
+                        "fewer request bytes, near-zero host work; "
+                        "structures outside the raw rung caps fall "
+                        "back to pack-pool featurization); 'auto' "
+                        "engages on accelerator backends")
     p.add_argument("--pack-workers", type=int, default=None,
                    help="pack pipeline threads between batcher and "
                         "dispatch (0 = in-line; default follows the "
@@ -109,7 +119,7 @@ def main(argv=None) -> int:
             print(f"compilation cache unavailable: {e}", file=sys.stderr)
 
     from cgnn_tpu.observe import Telemetry
-    from cgnn_tpu.serve.http import make_http_server, make_structure_featurizer
+    from cgnn_tpu.serve.http import make_http_server
     from cgnn_tpu.serve.server import load_server
 
     telemetry = (
@@ -138,6 +148,7 @@ def main(argv=None) -> int:
             default_timeout_ms=args.timeout_ms or None,
             cache_size=args.cache_size,
             compact=args.compact,
+            wire=args.wire,
             pack_workers=args.pack_workers,
             devices=args.devices,
             engine=args.engine,
@@ -169,10 +180,9 @@ def main(argv=None) -> int:
             interval_s=args.live_metrics,
         ).start()
 
-    httpd = make_http_server(
-        server, host=args.host, port=args.port,
-        featurize=make_structure_featurizer(parts["data_cfg"]),
-    )
+    # no handler-side featurizer: wire-form structures admit directly
+    # and the SERVER featurizes on the pack pool when needed (ISSUE 11)
+    httpd = make_http_server(server, host=args.host, port=args.port)
 
     # SIGTERM/SIGINT -> drain the batcher, stop the listener, exit 0
     # (resilience.preempt signal plumbing; second signal kills)
@@ -188,6 +198,8 @@ def main(argv=None) -> int:
     print(f"serving on http://{args.host}:{args.port} "
           f"(params {server.param_store.version}; shapes {shapes}; "
           f"{len(server.device_set)} device(s), {server.engine} engine; "
+          f"wire: "
+          f"{'raw+featurized' if server.shape_set.raw is not None else 'featurized'}; "
           f"live plane: GET /metrics"
           + (f", POST /profile -> {profile_dir}" if profile_dir else "")
           + ")")

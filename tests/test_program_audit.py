@@ -308,19 +308,21 @@ class TestLiveRepo:
         lowered = {p.name for p in programs if p.lowered is not None}
         expected = ledger["meta"]["predict_programs_expected"]
         rungs = len(ledger["meta"]["ladder"]["shapes"])
-        # the engine dimension (ISSUE 10): compact + full per rung for
-        # the single-device ladder AND the mesh-sharded twin (the
-        # conftest mesh has 8 devices, so the mesh engine registers)
+        # the engine dimension (ISSUE 10) x the staging-form dimension
+        # (ISSUE 11): compact + full + raw per rung for the
+        # single-device ladder AND the mesh-sharded twin (the conftest
+        # mesh has 8 devices, so the mesh engine registers)
         assert ledger["meta"]["mesh_devices"] >= 2
-        assert expected == 2 * rungs * 2
+        assert expected == 3 * rungs * 2
         predict = {n for n in lowered if n.startswith("predict/")}
         assert len(predict) == expected, sorted(predict)
         mesh = {n for n in predict if n.startswith("predict/mesh/")}
-        assert len(mesh) == 2 * rungs, sorted(mesh)
+        assert len(mesh) == 3 * rungs, sorted(mesh)
         assert "train/coo" in lowered
         assert "train/coo+guard" in lowered
         assert "train/coo+tap@step" in lowered
         assert "expander/rung0" in lowered
+        assert "ops/neighbor_search/rung0" in lowered
 
     def test_mesh_programs_carry_shard_budgets(self, live_audit):
         """Every mesh-sharded predict program is GA-SHARD-budgeted —
@@ -364,9 +366,14 @@ class TestCommittedLedger:
         names = set(ledger["programs"])
         rungs = len(ledger["meta"]["ladder"]["shapes"])
         for rung in range(rungs):
-            for form in ("compact", "full"):
+            for form in ("compact", "full", "raw"):
                 assert f"predict/rung{rung}/{form}" in names
         assert "train/coo" in names
+        # the ISSUE-11 neighbor-search program rides its GA-ROOFLINE
+        # budget in the baseline: dropping either diffs red
+        entry = ledger["programs"].get("ops/neighbor_search/rung0")
+        assert entry is not None and entry.get("byte_budget", 0) > 0
+        assert entry["bytes"] <= entry["byte_budget"] * 2.0
         assert ledger["meta"]["gate_keys"] == list(LEDGER_GATE_KEYS)
 
     def test_mesh_engine_coverage(self, ledger):
@@ -375,7 +382,7 @@ class TestCommittedLedger:
         their budgets) diffs red, not silent."""
         rungs = len(ledger["meta"]["ladder"]["shapes"])
         for rung in range(rungs):
-            for form in ("compact", "full"):
+            for form in ("compact", "full", "raw"):
                 entry = ledger["programs"].get(
                     f"predict/mesh/rung{rung}/{form}")
                 assert entry is not None, (rung, form)
