@@ -1,0 +1,40 @@
+"""Fleet-scale resilient serving (ISSUE 14; ROADMAP item 4b).
+
+A thin router process fronting N independent ``InferenceServer``
+replicas — the layer where the per-process reflexes PRs 2/6/7 built
+(graceful drain, hot reload, the live /healthz + /metrics plane)
+compose into a system that stays up when a replica dies:
+
+- :mod:`breaker`  — per-replica circuit breaker (eject after K
+  consecutive failures, half-open probe re-admission);
+- :mod:`replica`  — one replica's client-side state: health snapshot
+  scraped from ITS /healthz + /metrics, in-flight depth, rolling
+  latency, the transport that actually carries a request;
+- :mod:`router`   — health/load-aware dispatch with bounded retries
+  (exponential backoff + jitter), deadline-aware hedging, an
+  idempotency key (the PR-6 trace id) shared by every attempt so a
+  retried/hedged request is answered exactly once, and graceful
+  degradation (503 + Retry-After) when nothing is admittable;
+- :mod:`http`     — the stdlib HTTP front-end over the router;
+- :mod:`spawn`    — replica subprocess lifecycle (the serve.py boot),
+  incl. the kill -9 / restart legs the chaos harness drives.
+"""
+
+from cgnn_tpu.fleet.breaker import CircuitBreaker
+from cgnn_tpu.fleet.replica import (
+    FleetTransportError,
+    ReplicaState,
+    http_transport,
+)
+from cgnn_tpu.fleet.router import FleetRouter
+from cgnn_tpu.fleet.spawn import ReplicaProcess, spawn_fleet
+
+__all__ = [
+    "CircuitBreaker",
+    "FleetRouter",
+    "FleetTransportError",
+    "ReplicaProcess",
+    "ReplicaState",
+    "http_transport",
+    "spawn_fleet",
+]

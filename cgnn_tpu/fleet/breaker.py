@@ -1,0 +1,167 @@
+"""Per-replica circuit breaker: eject after K consecutive failures,
+half-open probe re-admission.
+
+The state machine (injectable clock, synchronously testable):
+
+- ``closed``    — healthy; requests flow. ``k`` CONSECUTIVE failures
+  (any success resets the streak) trip it open.
+- ``open``      — ejected; ``admit()`` refuses everything until
+  ``cooldown_s`` has passed. Each re-open without an intervening close
+  doubles the cooldown (bounded by ``max_cooldown_s``) so a flapping
+  replica backs itself off instead of absorbing a probe per tick.
+- ``half_open`` — the cooldown expired; ``admit()`` grants exactly ONE
+  in-flight trial request (concurrent callers are refused until it
+  resolves). Trial success — or a successful health probe
+  (``record_probe_success``, the router's poller seeing ``ready``) —
+  closes the breaker; trial failure re-opens it with the doubled
+  cooldown.
+
+``admit()`` MUTATES (it claims the half-open trial), so callers score
+candidates with ``would_admit()`` first and claim only the one they
+picked — a scored-but-unchosen replica must not leak its trial slot.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from cgnn_tpu.analysis import racecheck
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        k: int = 3,
+        cooldown_s: float = 2.0,
+        max_cooldown_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        name: str = "fleet.breaker",
+    ):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if cooldown_s <= 0:
+            raise ValueError(f"cooldown_s must be > 0, got {cooldown_s}")
+        self.k = int(k)
+        self.base_cooldown = float(cooldown_s)
+        self.max_cooldown = float(max_cooldown_s)
+        self._clock = clock
+        self._lock = racecheck.make_lock(name)
+        # all mutated under self._lock (graftcheck GC-LOCKSHARE)
+        self._state = CLOSED
+        self._failures = 0          # consecutive-failure streak
+        self._cooldown = float(cooldown_s)
+        self._opened_at = 0.0
+        self._trial_inflight = False
+        self.opens = 0              # lifetime trips (telemetry)
+        self.closes = 0
+
+    # ---- observation ----
+
+    @property
+    def state(self) -> str:
+        """Current state; promotes open -> half_open on cooldown expiry
+        (observation only — the trial slot is claimed by admit())."""
+        with self._lock:
+            return self._state_locked(self._clock())
+
+    def _state_locked(self, now: float) -> str:
+        if self._state == OPEN and now - self._opened_at >= self._cooldown:
+            self._state = HALF_OPEN
+            self._trial_inflight = False
+        return self._state
+
+    def would_admit(self) -> bool:
+        """Non-mutating admission check (candidate scoring)."""
+        with self._lock:
+            s = self._state_locked(self._clock())
+            if s == CLOSED:
+                return True
+            if s == HALF_OPEN:
+                return not self._trial_inflight
+            return False
+
+    def retry_after_s(self) -> float:
+        """How long until this breaker could admit again (0 = now) —
+        the Retry-After hint when a whole tier is ejected."""
+        with self._lock:
+            s = self._state_locked(self._clock())
+            if s != OPEN:
+                return 0.0
+            return max(
+                0.0, self._cooldown - (self._clock() - self._opened_at)
+            )
+
+    # ---- the request path ----
+
+    def admit(self) -> bool:
+        """Claim admission for one request (the half-open TRIAL when
+        half-open). The claimer MUST later call record_success or
+        record_failure — that is what releases the trial slot."""
+        with self._lock:
+            s = self._state_locked(self._clock())
+            if s == CLOSED:
+                return True
+            if s == HALF_OPEN and not self._trial_inflight:
+                self._trial_inflight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._trial_inflight = False
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self._cooldown = self.base_cooldown
+                self.closes += 1
+
+    def record_probe_success(self) -> None:
+        """A health probe (not a served request) found the replica
+        ready. Re-admits from HALF-OPEN only: while the cooldown is
+        still running the breaker stays open even if /healthz looks
+        fine — K consecutive DISPATCH failures on a ready-looking
+        replica is exactly the wedged-server case the cooldown exists
+        to keep traffic away from."""
+        with self._lock:
+            s = self._state_locked(self._clock())
+            if s == HALF_OPEN:
+                self._failures = 0
+                self._trial_inflight = False
+                self._state = CLOSED
+                self._cooldown = self.base_cooldown
+                self.closes += 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            now = self._clock()
+            s = self._state_locked(now)
+            self._failures += 1
+            if s == HALF_OPEN:
+                # failed trial: back off harder each consecutive trip
+                self._trial_inflight = False
+                self._cooldown = min(self._cooldown * 2.0,
+                                     self.max_cooldown)
+                self._state = OPEN
+                self._opened_at = now
+                self.opens += 1
+            elif s == CLOSED and self._failures >= self.k:
+                self._state = OPEN
+                self._opened_at = now
+                self.opens += 1
+            # already OPEN: stragglers from in-flight attempts land here;
+            # they neither extend nor restart the cooldown
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state_locked(self._clock()),
+                "consecutive_failures": self._failures,
+                "cooldown_s": self._cooldown,
+                "opens": self.opens,
+                "closes": self.closes,
+            }

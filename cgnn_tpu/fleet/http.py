@@ -1,0 +1,120 @@
+"""Stdlib HTTP front-end over the FleetRouter (the thin router process).
+
+Mirrors serve/http.py deliberately: a fleet client speaks the SAME wire
+protocol as a single-replica client — ``POST /predict`` with a
+``graph`` or ``structure`` body — and the router adds its resilience
+headers to the response:
+
+- ``X-Request-Id``     — the trace id every attempt carried (the
+  idempotency key; inbound ids honored);
+- ``X-Fleet-Replica``  — which replica answered;
+- ``X-Fleet-Attempts`` — how many attempts it took (1 = first try).
+
+``GET /healthz`` reports fleet readiness (200 when at least one replica
+is admittable, 503 + Retry-After otherwise — same ready-vs-live split
+the replicas expose). ``GET /stats`` and ``GET /metrics`` expose the
+router's own counters, per-replica gauges, and rolling latency — the
+fleet-level twin of the replica plane.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from cgnn_tpu.fleet.router import FleetRouter
+from cgnn_tpu.observe.metrics_io import jsonfinite
+
+
+def make_fleet_handler(router: FleetRouter):
+    class FleetHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: ARG002 — not operator signal
+            pass
+
+        def _reply(self, status: int, payload: dict,
+                   headers: dict | None = None) -> None:
+            try:
+                body = json.dumps(payload, allow_nan=False).encode()
+            except ValueError:
+                body = json.dumps(jsonfinite(payload)).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path == "/healthz":
+                ready = router.admittable()
+                payload = {
+                    "ok": True,
+                    "ready": ready,
+                    "replicas": len(router.replicas),
+                    "replicas_ready": router.ready_count(),
+                    "versions": {str(k): v
+                                 for k, v in router.versions().items()},
+                }
+                if ready:
+                    self._reply(200, payload)
+                else:
+                    self._reply(503, payload, headers={
+                        "Retry-After": str(int(router._retry_after_s()))
+                    })
+            elif self.path == "/stats":
+                self._reply(200, router.stats())
+            elif self.path == "/metrics":
+                body = router.registry.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/predict":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError as e:
+                self._reply(400, {"error": f"malformed JSON body: {e}"})
+                return
+            if not isinstance(body, dict):
+                self._reply(400, {"error": "body must be a JSON object"})
+                return
+            trace_id = (self.headers.get("X-Request-Id")
+                        or body.get("trace_id"))
+            status, payload, meta = router.dispatch(
+                body, timeout_ms=body.get("timeout_ms"),
+                trace_id=trace_id)
+            headers = {
+                "X-Request-Id": meta["trace_id"],
+                "X-Fleet-Replica": str(meta["replica"]),
+                "X-Fleet-Attempts": str(meta["attempts"]),
+            }
+            if "retry_after_s" in meta:
+                headers["Retry-After"] = str(
+                    int(max(meta["retry_after_s"], 1)))
+            self._reply(status, payload, headers=headers)
+
+    return FleetHandler
+
+
+class _FleetHTTPServer(ThreadingHTTPServer):
+    # same rationale as serve/http.py: the stdlib backlog of 5 RSTs
+    # bursty clients the router's own shedding should be refusing
+    request_queue_size = 128
+
+
+def make_fleet_http_server(router: FleetRouter, host: str = "127.0.0.1",
+                           port: int = 8440) -> ThreadingHTTPServer:
+    return _FleetHTTPServer((host, port), make_fleet_handler(router))

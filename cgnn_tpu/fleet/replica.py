@@ -1,0 +1,270 @@
+"""One replica, as the router sees it.
+
+``ReplicaState`` is pure client-side bookkeeping: the health snapshot
+the poller scraped from the replica's OWN ``/healthz`` + ``/metrics``
+plane (readiness, draining, param version, queue depth, rolling p99 —
+the PR-6 surfaces, reused as the routing signal), the router-local
+in-flight depth (requests this router has outstanding there), the
+breaker, and a rolling latency window of what this router measured.
+
+The transport is injectable: production uses :func:`http_transport`
+(urllib against ``POST /predict``); unit tests inject fakes that fail,
+stall, or refuse deterministically. A transport returns
+``(status, payload_dict)`` for anything that produced an HTTP response
+(including 4xx/5xx) and raises :class:`FleetTransportError` when the
+wire itself failed (refused/reset/timeout) — the distinction the retry
+policy keys on.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable
+
+from cgnn_tpu.analysis import racecheck
+from cgnn_tpu.fleet.breaker import CircuitBreaker
+from cgnn_tpu.observe.export import RollingSeries, parse_prometheus_text
+
+
+class FleetTransportError(RuntimeError):
+    """The wire failed before an HTTP response existed (connection
+    refused/reset, socket timeout) — the retryable-by-definition case:
+    a dead or mid-restart replica presents exactly like this."""
+
+
+def http_transport(replica: "ReplicaState", body: dict,
+                   timeout_s: float) -> tuple[int, dict]:
+    """POST ``body`` to the replica's /predict; -> (status, payload).
+
+    HTTP error statuses are RETURNED (the payload carries the replica's
+    typed rejection reason); only wire-level failures raise."""
+    data = json.dumps(body, allow_nan=False).encode()
+    req = urllib.request.Request(
+        replica.base_url + "/predict", data=data,
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": str(body.get("trace_id", ""))},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            payload = {"error": str(e)}
+        return e.code, payload
+    except (urllib.error.URLError, ConnectionError, OSError,
+            TimeoutError) as e:
+        raise FleetTransportError(
+            f"{replica.name}: {e!r}"
+        ) from None
+
+
+def http_get_json(url: str, timeout_s: float = 2.0) -> tuple[int, dict]:
+    """GET a JSON endpoint (the /healthz probe); raises
+    FleetTransportError on wire failure."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            return e.code, {}
+    except (urllib.error.URLError, ConnectionError, OSError,
+            TimeoutError) as e:
+        raise FleetTransportError(f"{url}: {e!r}") from None
+
+
+def http_get_text(url: str, timeout_s: float = 2.0) -> str:
+    """GET a text endpoint (the /metrics scrape)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.read().decode()
+    except (urllib.error.URLError, ConnectionError, OSError,
+            TimeoutError) as e:
+        raise FleetTransportError(f"{url}: {e!r}") from None
+
+
+class ReplicaState:
+    """Router-side state for one replica endpoint."""
+
+    def __init__(
+        self,
+        rid: int,
+        base_url: str,
+        *,
+        breaker: CircuitBreaker | None = None,
+        breaker_k: int = 3,
+        breaker_cooldown_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+        rolling_window_s: float = 60.0,
+    ):
+        self.rid = int(rid)
+        self.base_url = base_url.rstrip("/")
+        self.name = f"replica{self.rid}"
+        self.breaker = breaker or CircuitBreaker(
+            k=breaker_k, cooldown_s=breaker_cooldown_s, clock=clock,
+            name=f"fleet.breaker.{self.rid}",
+        )
+        self._clock = clock
+        self._lock = racecheck.make_lock(f"fleet.replica.{self.rid}")
+        # router-measured success latencies (ms); own internal lock
+        self.rolling = RollingSeries(window_s=rolling_window_s,
+                                     clock=clock)
+        # all below mutated under self._lock (graftcheck GC-LOCKSHARE)
+        self._inflight = 0
+        self._ready = False          # last probed readiness
+        self._draining = False
+        self._version = ""           # last probed param_version
+        self._queue_depth = 0.0      # scraped serve_queue_depth
+        self._scraped_p99_ms = 0.0   # scraped rolling p99
+        self._probe_ok = False       # last probe reached the replica
+        self._probes = 0
+        self.counts: dict[str, int] = {
+            "sent": 0, "answered": 0, "transport_errors": 0,
+            "server_errors": 0, "rejections": 0,
+        }
+
+    # ---- health (the poller writes, the picker reads) ----
+
+    def note_probe(self, *, ready: bool, draining: bool = False,
+                   version: str = "", queue_depth: float | None = None,
+                   p99_ms: float | None = None) -> None:
+        with self._lock:
+            self._probe_ok = True
+            self._probes += 1
+            self._ready = bool(ready)
+            self._draining = bool(draining)
+            if version:
+                self._version = str(version)
+            if queue_depth is not None:
+                self._queue_depth = float(queue_depth)
+            if p99_ms is not None:
+                self._scraped_p99_ms = float(p99_ms)
+        if ready and not draining:
+            # half-open probe re-admission: a restarted replica that
+            # reports ready is probed back into rotation
+            self.breaker.record_probe_success()
+
+    def note_unreachable(self) -> None:
+        with self._lock:
+            self._probe_ok = False
+            self._probes += 1
+            self._ready = False
+
+    def probe(self, timeout_s: float = 2.0) -> bool:
+        """One health round against the live replica: GET /healthz
+        (readiness, draining, version) + GET /metrics (queue depth,
+        rolling p99 — the PR-6 plane as the routing signal). Returns
+        readiness; an unreachable replica is marked not ready."""
+        try:
+            status, health = http_get_json(self.base_url + "/healthz",
+                                           timeout_s)
+        except FleetTransportError:
+            self.note_unreachable()
+            return False
+        queue_depth = p99 = None
+        try:
+            fams = parse_prometheus_text(
+                http_get_text(self.base_url + "/metrics", timeout_s))
+            for labels, value in fams.get(
+                    "cgnn_serve_queue_depth", {}).get("samples", []):
+                queue_depth = value
+            for labels, value in fams.get(
+                    "cgnn_serve_latency_ms", {}).get("samples", []):
+                if 'quantile="0.99"' in labels:
+                    p99 = value
+        except (FleetTransportError, ValueError):
+            pass  # health alone still counts; the signal just goes stale
+        ready = bool(health.get("ready", status == 200))
+        self.note_probe(
+            ready=ready and status == 200,
+            draining=bool(health.get("draining", False)),
+            version=str(health.get("param_version", "")),
+            queue_depth=queue_depth, p99_ms=p99,
+        )
+        return ready
+
+    # ---- the request path ----
+
+    def note_sent(self) -> None:
+        with self._lock:
+            self._inflight += 1
+            self.counts["sent"] += 1
+
+    def note_result(self, outcome: str, latency_ms: float | None = None,
+                    version: str = "") -> None:
+        """``outcome``: 'answered' | 'rejections' | 'server_errors' |
+        'transport_errors'. Releases the in-flight slot and feeds the
+        breaker (server/transport errors are failures; an answered OR
+        typed-rejected request proves the replica alive)."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self.counts[outcome] = self.counts.get(outcome, 0) + 1
+            if version:
+                self._version = str(version)
+            if outcome == "transport_errors":
+                # a dead replica must stop looking pickable before the
+                # next poll round gets around to probing it
+                self._ready = False
+        if outcome in ("transport_errors", "server_errors"):
+            self.breaker.record_failure()
+        else:
+            self.breaker.record_success()
+        if outcome == "answered" and latency_ms is not None:
+            self.rolling.add(latency_ms)
+
+    # ---- scoring ----
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @property
+    def ready(self) -> bool:
+        with self._lock:
+            return self._ready and not self._draining
+
+    @property
+    def version(self) -> str:
+        with self._lock:
+            return self._version
+
+    def pickable(self) -> bool:
+        return self.ready and self.breaker.would_admit()
+
+    def score(self) -> tuple:
+        """Lower is better: router-view in-flight depth plus the
+        replica's own scraped queue depth (load), tie-broken by the
+        scraped rolling p99 (health), then rid (determinism)."""
+        with self._lock:
+            load = self._inflight + self._queue_depth
+            p99 = self._scraped_p99_ms
+        return (load, p99, self.rid)
+
+    def local_p99_ms(self) -> float:
+        q = self.rolling.quantiles()
+        return float(q.get("p99", 0.0)) if q else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "url": self.base_url,
+                "ready": self._ready,
+                "draining": self._draining,
+                "param_version": self._version,
+                "inflight": self._inflight,
+                "queue_depth": self._queue_depth,
+                "scraped_p99_ms": self._scraped_p99_ms,
+                "probes": self._probes,
+                "probe_ok": self._probe_ok,
+                "counts": dict(self.counts),
+            }
+        out["breaker"] = self.breaker.stats()
+        out["router_p99_ms"] = self.local_p99_ms()
+        return out

@@ -1,0 +1,468 @@
+"""Health/load-aware dispatch over a replica fleet, with per-request
+resilience.
+
+``FleetRouter.dispatch`` is the whole request policy, synchronously on
+the caller's thread (the HTTP front-end calls it per connection; the
+fleet loadgen calls it directly):
+
+- **pick** — among replicas that are ready (scraped ``/healthz``), not
+  draining, and whose breaker admits: least (router-view in-flight +
+  scraped queue depth), tie-broken by scraped rolling p99 — the PR-6
+  observability plane reused as the routing signal;
+- **retry** — a transport failure (refused/reset/timeout: how a
+  kill -9'd replica presents) or a retryable upstream status (429
+  queue-full, 500 dispatch failure, 502, 503 draining) costs the
+  replica a breaker failure and the request retries on the next-best
+  replica after exponential backoff with jitter, bounded by
+  ``max_attempts`` and the request deadline;
+- **hedge** — with one attempt in flight past its hedge point (fixed
+  ``hedge_ms``, or auto: 2x the replica's router-measured p99) and
+  deadline budget left, a second attempt fires on a DIFFERENT replica;
+  the first success wins;
+- **exactly once** — every attempt of a request carries the SAME trace
+  id (the PR-6 idempotency key, forwarded as ``X-Request-Id``), and the
+  single coordinator is the only consumer of attempt results: the
+  client gets exactly one answer no matter how many attempts resolve
+  (a straggler's success is counted as ``fleet_hedge_waste``, never
+  delivered);
+- **shed** — when NO replica is admittable (all ejected or draining)
+  the router degrades gracefully: 503 with a Retry-After derived from
+  the soonest breaker cooldown, instead of queueing unboundedly;
+- **pass through** — non-retryable upstream rejections (400 malformed,
+  413 oversize, 504 deadline) return to the client as-is: retrying a
+  malformed request burns fleet capacity to fail again.
+
+All policy state is host-side; the router never touches jax.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import random
+import threading
+import time
+from typing import Callable, Sequence
+
+from cgnn_tpu.analysis import racecheck
+from cgnn_tpu.fleet.replica import (
+    FleetTransportError,
+    ReplicaState,
+    http_transport,
+)
+from cgnn_tpu.observe.export import MetricsRegistry, RollingSeries
+
+# upstream statuses worth another replica (the replica is loaded,
+# draining, or failed — a sibling may well answer)
+RETRYABLE_STATUS = frozenset((429, 500, 502, 503))
+# upstream rejections that are about the REQUEST, not the replica:
+# retrying elsewhere would just fail again
+PASSTHROUGH_STATUS = frozenset((400, 404, 413, 501, 504))
+
+
+class _Call:
+    """Per-request coordination: the shared trace id and the delivered
+    latch attempt threads consult before posting (a straggler success
+    after delivery is wasted compute, counted, never a second answer)."""
+
+    def __init__(self, tid: str):
+        self.tid = tid
+        self.done = threading.Event()
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        replicas: Sequence[ReplicaState],
+        *,
+        transport: Callable | None = None,
+        max_attempts: int = 4,
+        backoff_ms: float = 25.0,
+        backoff_mult: float = 2.0,
+        max_backoff_ms: float = 1000.0,
+        jitter: float = 0.5,
+        hedge_ms: float | None = None,
+        default_timeout_ms: float = 30000.0,
+        health_interval_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+        rng: random.Random | None = None,
+        log_fn: Callable = print,
+    ):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.replicas = list(replicas)
+        self._by_rid = {r.rid: r for r in self.replicas}
+        if len(self._by_rid) != len(self.replicas):
+            raise ValueError("replica ids must be unique")
+        self._transport = transport or http_transport
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = backoff_ms / 1e3
+        self.backoff_mult = float(backoff_mult)
+        self.max_backoff_s = max_backoff_ms / 1e3
+        self.jitter = float(jitter)
+        # None = auto (2x the picked replica's router-measured p99,
+        # floored); <= 0 disables hedging entirely
+        self.hedge_ms = hedge_ms
+        self.default_timeout_ms = float(default_timeout_ms)
+        self.health_interval_s = float(health_interval_s)
+        self._clock = clock
+        self._rng = rng or random.Random(0x5EED)
+        self._log = log_fn
+        self._lock = racecheck.make_lock("fleet.router")
+        # mutated under self._lock (graftcheck GC-LOCKSHARE)
+        self.counts: dict[str, int] = {
+            "fleet_requests": 0, "fleet_answered": 0, "fleet_retries": 0,
+            "fleet_hedges": 0, "fleet_hedge_wins": 0,
+            "fleet_hedge_waste": 0, "fleet_shed": 0,
+            "fleet_exhausted": 0, "fleet_deadline_exceeded": 0,
+            "fleet_transport_errors": 0, "fleet_passthrough_rejects": 0,
+            "fleet_duplicate_answers": 0,
+        }
+        self._trace_prefix = os.urandom(3).hex()
+        self._trace_seq = itertools.count(1)
+        self._stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+        self._lat_rolling = RollingSeries(window_s=60.0, clock=clock)
+        self.registry = MetricsRegistry(window_s=60.0)
+        self.registry.add_provider("fleet", self._registry_snapshot)
+
+    # ---- lifecycle ----
+
+    def start(self, probe_now: bool = True) -> "FleetRouter":
+        """Arm the health poller (one synchronous probe round first so
+        the first dispatch already has a routing signal)."""
+        if probe_now:
+            self.probe_all()
+        if self._health_thread is None or not self._health_thread.is_alive():
+            self._stop.clear()
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True, name="fleet-health"
+            )
+            self._health_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=10.0)
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            racecheck.heartbeat()
+            self.probe_all()
+
+    def probe_all(self, timeout_s: float = 2.0) -> int:
+        """Probe every replica once; returns how many are ready."""
+        ready = 0
+        for r in self.replicas:
+            try:
+                ready += bool(r.probe(timeout_s))
+            except Exception as e:  # noqa: BLE001 — the poller must survive
+                self._log(f"fleet: health probe {r.name} failed: {e!r}")
+        return ready
+
+    # ---- dispatch ----
+
+    def _mint(self, requested: str | None) -> str:
+        if requested:
+            rid = "".join(c if c.isprintable() and c not in '\\"' else "_"
+                          for c in str(requested).strip())
+            if rid:
+                return rid[:128]
+        return f"flt-{self._trace_prefix}-{next(self._trace_seq):06x}"
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counts[key] = self.counts.get(key, 0) + n
+
+    def _pick(self, exclude=(), hard_exclude=()) -> ReplicaState | None:
+        """Best admittable replica, preferring ones this request has
+        not failed on; falls back to retrying a previously-failed (but
+        still admittable) replica over shedding. ``hard_exclude`` is
+        never relaxed — the hedge path passes its live attempt's
+        replica there, so a hedge can NEVER land on the replica it is
+        racing (the fallback would otherwise double down on the slow
+        one and corrupt the live-attempt bookkeeping).
+        ``breaker.admit()`` is called only on the chosen candidate —
+        scoring uses the non-mutating check so an unchosen half-open
+        replica keeps its trial slot."""
+        pool = [r for r in self.replicas
+                if r.rid not in hard_exclude and r.pickable()]
+        fresh = [r for r in pool if r.rid not in exclude]
+        for r in sorted(fresh or pool, key=lambda r: r.score()):
+            if r.breaker.admit():
+                return r
+        return None
+
+    def _hedge_after_s(self, rid: int) -> float:
+        if self.hedge_ms is not None:
+            return max(self.hedge_ms, 0.0) / 1e3
+        p99 = self._by_rid[rid].local_p99_ms()
+        return max(0.1, 2.0 * p99 / 1e3)
+
+    def _retry_after_s(self) -> float:
+        """The Retry-After hint when shedding: the soonest any breaker
+        could re-admit (bounded 1..30 s; 5 s when nothing is ejected —
+        i.e. everything is draining/unready and only time will tell)."""
+        waits = [b for b in
+                 (r.breaker.retry_after_s() for r in self.replicas)
+                 if b > 0]
+        return min(max(min(waits) if waits else 5.0, 1.0), 30.0)
+
+    def _launch(self, replica: ReplicaState, body: dict, timeout_s: float,
+                q: queue.Queue, call: _Call, attempt_no: int) -> None:
+        replica.note_sent()
+        threading.Thread(
+            target=self._attempt,
+            args=(replica, body, timeout_s, q, call),
+            daemon=True, name=f"fleet-try-{call.tid[-10:]}-{attempt_no}",
+        ).start()
+
+    def _attempt(self, replica: ReplicaState, body: dict, timeout_s: float,
+                 q: queue.Queue, call: _Call) -> None:
+        t0 = time.perf_counter()
+        err: BaseException | None = None
+        status, payload = 0, None
+        try:
+            # +2 s grace past the request deadline so a replica-side 504
+            # arrives as a typed response instead of a socket timeout
+            status, payload = self._transport(replica, body,
+                                              timeout_s + 2.0)
+        except FleetTransportError as e:
+            err = e
+        except Exception as e:  # noqa: BLE001 — a transport bug is a failed attempt
+            err = e
+        lat_ms = (time.perf_counter() - t0) * 1e3
+        version = ""
+        if err is not None:
+            outcome = "transport_errors"
+        elif status == 200:
+            outcome = "answered"
+            version = str((payload or {}).get("param_version", ""))
+        elif status in (500, 502):
+            outcome = "server_errors"
+        else:
+            outcome = "rejections"
+        replica.note_result(outcome, lat_ms if status == 200 else None,
+                            version=version)
+        if call.done.is_set():
+            # the request was already answered by another attempt: this
+            # result is wasted compute, NEVER a second answer
+            if outcome == "answered":
+                self._count("fleet_hedge_waste")
+            return
+        q.put((replica.rid, status, payload, err, lat_ms))
+
+    def dispatch(self, body: dict, *, timeout_ms: float | None = None,
+                 trace_id: str | None = None) -> tuple[int, dict, dict]:
+        """Route one request; -> (status, payload, meta).
+
+        ``meta``: replica (the answering rid, or -1), attempts,
+        retries, hedges, latency_ms, trace_id, retry_after_s (shed
+        only). The payload of a 200 is the replica's own response
+        (param_version, prediction, stamps, ...) untouched."""
+        timeout_ms = (self.default_timeout_ms if timeout_ms is None
+                      else float(timeout_ms))
+        t_start = self._clock()
+        deadline = t_start + timeout_ms / 1e3
+        tid = self._mint(trace_id)
+        # the idempotency key: EVERY attempt of this request carries the
+        # same trace id, so replica-side journals/caches and the
+        # loadgen's exactly-once assertion can join duplicates
+        body = dict(body)
+        body["trace_id"] = tid
+        body.setdefault("timeout_ms", timeout_ms)
+        call = _Call(tid)
+        results: queue.Queue = queue.Queue()
+        self._count("fleet_requests")
+        live: dict[int, float] = {}  # rid -> launch time (hedge timer)
+        tried_failed: set[int] = set()
+        hedged_rids: set[int] = set()
+        launched = retries = hedges = 0
+        hedge_spent = False  # one hedge per request (budget, not a fan-out)
+        backoff = self.backoff_s
+        last_failure = ""
+
+        def meta(replica_id: int = -1, **extra) -> dict:
+            return {
+                "replica": replica_id, "attempts": launched,
+                "retries": retries, "hedges": hedges, "trace_id": tid,
+                "latency_ms": (self._clock() - t_start) * 1e3, **extra,
+            }
+
+        while True:
+            now = self._clock()
+            remaining = deadline - now
+            if remaining <= 0:
+                call.done.set()
+                self._count("fleet_deadline_exceeded")
+                return 504, {
+                    "error": f"fleet deadline exceeded "
+                             f"({timeout_ms:.0f} ms, {launched} attempts; "
+                             f"last failure: {last_failure or 'none'})",
+                    "reason": "timeout", "trace_id": tid,
+                }, meta()
+            if not live:
+                if launched >= self.max_attempts:
+                    call.done.set()
+                    self._count("fleet_exhausted")
+                    return 502, {
+                        "error": f"all {launched} attempts failed "
+                                 f"(last: {last_failure})",
+                        "reason": "upstream_exhausted", "trace_id": tid,
+                    }, meta()
+                r = self._pick(exclude=tried_failed)
+                if r is None:
+                    call.done.set()
+                    retry_after = self._retry_after_s()
+                    self._count("fleet_shed")
+                    return 503, {
+                        "error": "no replica admittable (all ejected, "
+                                 "draining, or unready); load shed",
+                        "reason": "no_replicas", "trace_id": tid,
+                        "retry_after_s": retry_after,
+                    }, meta(retry_after_s=retry_after)
+                if launched > 0:
+                    retries += 1
+                    self._count("fleet_retries")
+                self._launch(r, body, remaining, results, call, launched)
+                live[r.rid] = now
+                launched += 1
+            # wait for the next attempt result; with a single attempt in
+            # flight and hedge budget left, wake at its hedge point
+            wait_s = remaining
+            hedge_at = None
+            if (len(live) == 1 and launched < self.max_attempts
+                    and not hedge_spent
+                    and (self.hedge_ms is None or self.hedge_ms > 0)):
+                rid0, t_launch = next(iter(live.items()))
+                hedge_at = t_launch + self._hedge_after_s(rid0)
+                wait_s = min(wait_s, max(hedge_at - now, 0.0))
+            try:
+                rid, status, payload, err, lat_ms = results.get(
+                    timeout=max(wait_s, 0.005))
+            except queue.Empty:
+                now = self._clock()
+                if (hedge_at is not None and now >= hedge_at
+                        and now < deadline):
+                    # deadline-aware hedge: a second attempt on a
+                    # DIFFERENT replica races the slow first one. One
+                    # hedge per request — spent whether or not a sibling
+                    # was available, so an unhedgeable single-replica
+                    # fleet waits quietly instead of re-polling
+                    hedge_spent = True
+                    r2 = self._pick(exclude=tried_failed,
+                                    hard_exclude=set(live))
+                    if r2 is not None:
+                        self._count("fleet_hedges")
+                        hedges += 1
+                        hedged_rids.add(r2.rid)
+                        self._launch(r2, body, deadline - now, results,
+                                     call, launched)
+                        live[r2.rid] = now
+                        launched += 1
+                continue
+            live.pop(rid, None)
+            if err is None and status == 200:
+                if call.done.is_set():
+                    # structurally unreachable (one coordinator, one
+                    # consumer) — counted so the loadgen can assert it
+                    self._count("fleet_duplicate_answers")
+                call.done.set()
+                self._count("fleet_answered")
+                if rid in hedged_rids:
+                    self._count("fleet_hedge_wins")
+                total_ms = (self._clock() - t_start) * 1e3
+                self._lat_rolling.add(total_ms)
+                return 200, payload, meta(rid)
+            if err is None and status in PASSTHROUGH_STATUS:
+                # about the request, not the replica: hand it back
+                call.done.set()
+                self._count("fleet_passthrough_rejects")
+                return status, payload or {}, meta(rid)
+            # retryable: transport failure or 429/500/502/503
+            tried_failed.add(rid)
+            if err is not None:
+                self._count("fleet_transport_errors")
+                last_failure = f"{self._by_rid[rid].name}: {err!r}"
+            else:
+                self._count(f"fleet_upstream_{status}")
+                detail = (payload or {}).get("error", "")
+                last_failure = f"{self._by_rid[rid].name}: HTTP {status} {detail}"
+            if live:
+                continue  # a hedge is still racing; let it win first
+            if launched < self.max_attempts:
+                # exponential backoff + jitter before the next attempt.
+                # A plain sleep, NOT self._stop.wait: that event is the
+                # health poller's shutdown latch, and a stop() landing
+                # mid-drain would collapse every in-flight request's
+                # backoff to zero (hot-looping retries at the draining
+                # replicas). The sleep is bounded by the request
+                # deadline, so it cannot outlive the drain by much.
+                delay = backoff * (1.0 + self.jitter * self._rng.random())
+                backoff = min(backoff * self.backoff_mult,
+                              self.max_backoff_s)
+                remaining = deadline - self._clock()
+                if remaining > 0 and delay > 0:
+                    time.sleep(min(delay, remaining))
+
+    # ---- observation ----
+
+    def versions(self) -> dict:
+        """param_version per replica (the rolling-promotion view)."""
+        return {r.rid: r.version for r in self.replicas}
+
+    def ready_count(self) -> int:
+        return sum(1 for r in self.replicas if r.ready)
+
+    def admittable(self) -> bool:
+        return any(r.pickable() for r in self.replicas)
+
+    def stats(self) -> dict:
+        with self._lock:
+            counts = dict(self.counts)
+        return {
+            "counts": counts,
+            "replicas": {str(r.rid): r.stats() for r in self.replicas},
+            "versions": {str(k): v for k, v in self.versions().items()},
+            "ready": self.ready_count(),
+            "rolling_latency_ms": self._lat_rolling.quantiles(),
+        }
+
+    def _registry_snapshot(self) -> dict:
+        """The fleet provider behind GET /metrics: router counters,
+        per-replica gauges (folded into ``replica``-labeled families by
+        observe/export.py), and the rolling latency summaries."""
+        with self._lock:
+            counts = dict(self.counts)
+        counters = {k: float(v) for k, v in counts.items()}
+        gauges = {
+            "fleet_replicas": float(len(self.replicas)),
+            "fleet_replicas_ready": float(self.ready_count()),
+            "fleet_replicas_admittable": float(
+                sum(1 for r in self.replicas if r.pickable())),
+        }
+        series = {}
+        q = self._lat_rolling.quantiles()
+        if q:
+            series["fleet_latency_ms"] = q
+        breaker_num = {"closed": 0.0, "half_open": 0.5, "open": 1.0}
+        for r in self.replicas:
+            s = r.stats()
+            i = r.rid
+            gauges[f"replica{i}_inflight"] = float(s["inflight"])
+            gauges[f"replica{i}_ready"] = float(s["ready"])
+            gauges[f"replica{i}_queue_depth"] = float(s["queue_depth"])
+            gauges[f"replica{i}_scraped_p99_ms"] = float(
+                s["scraped_p99_ms"])
+            gauges[f"replica{i}_breaker_open"] = breaker_num.get(
+                s["breaker"]["state"], 1.0)
+            gauges[f"replica{i}_answered"] = float(
+                s["counts"]["answered"])
+            rq = r.rolling.quantiles()
+            if rq:
+                series[f"replica{i}_latency_ms"] = rq
+        return {"counters": counters, "gauges": gauges, "series": series}

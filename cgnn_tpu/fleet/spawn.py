@@ -1,0 +1,159 @@
+"""Replica process lifecycle: boot serve.py subprocesses, wait for
+readiness, kill them (the chaos harness's kill -9 leg), restart them.
+
+Each replica is a REAL process running the existing serve.py entrypoint
+against the SAME checkpoint directory — which is exactly what makes
+rolling promotion work with no new machinery: every replica's own
+CheckpointWatcher (PR 3) polls that directory, so one committed save
+rolls across the fleet within a poll interval, each replica swapping
+atomically mid-load like the single-process invariant always promised.
+
+``wait_ready`` polls ``GET /healthz`` until it reports ``ready`` (the
+ISSUE-14 readiness split: a warming replica answers 503, so the fleet
+never routes traffic into cold-compile latency).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from cgnn_tpu.fleet.replica import FleetTransportError, http_get_json
+
+_SERVE_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "serve.py")
+
+
+class ReplicaProcess:
+    """One serve.py subprocess bound to a fixed port (stable across
+    restarts, so the router's endpoint list never changes)."""
+
+    def __init__(
+        self,
+        rid: int,
+        ckpt_dir: str,
+        port: int,
+        *,
+        host: str = "127.0.0.1",
+        log_path: str | None = None,
+        serve_args: list | None = None,
+        env: dict | None = None,
+        serve_py: str = _SERVE_PY,
+    ):
+        self.rid = int(rid)
+        self.ckpt_dir = ckpt_dir
+        self.host = host
+        self.port = int(port)
+        self.base_url = f"http://{host}:{port}"
+        self.log_path = log_path
+        self.serve_args = list(serve_args or [])
+        self.env = dict(env) if env is not None else None
+        self.serve_py = serve_py
+        self.proc: subprocess.Popen | None = None
+        self.starts = 0
+        self.kills = 0
+
+    def start(self) -> "ReplicaProcess":
+        if self.proc is not None and self.proc.poll() is None:
+            return self
+        cmd = [sys.executable, self.serve_py, self.ckpt_dir,
+               "--host", self.host, "--port", str(self.port),
+               *self.serve_args]
+        env = dict(os.environ if self.env is None else self.env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log = (open(self.log_path, "ab")
+               if self.log_path else subprocess.DEVNULL)
+        try:
+            self.proc = subprocess.Popen(
+                cmd, stdout=log, stderr=subprocess.STDOUT, env=env)
+        finally:
+            if self.log_path:
+                log.close()  # the child holds its own fd now
+        self.starts += 1
+        return self
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def wait_ready(self, timeout_s: float = 300.0,
+                   poll_s: float = 0.25) -> bool:
+        """Poll /healthz until ready (True) or the process dies / the
+        timeout passes (False)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not self.alive():
+                return False
+            try:
+                status, payload = http_get_json(
+                    self.base_url + "/healthz", timeout_s=2.0)
+                if status == 200 and payload.get("ready", True):
+                    return True
+            except FleetTransportError:
+                pass  # not listening yet
+            time.sleep(poll_s)
+        return False
+
+    def kill9(self) -> None:
+        """The chaos leg: SIGKILL, no drain, no cleanup — in-flight
+        requests die with their sockets, exactly like a machine loss."""
+        if self.proc is not None and self.proc.poll() is None:
+            os.kill(self.proc.pid, signal.SIGKILL)
+            self.proc.wait(timeout=30)
+            self.kills += 1
+
+    def terminate(self, timeout_s: float = 60.0) -> int | None:
+        """SIGTERM -> the replica's graceful drain; returns its exit
+        code (None if it had to be killed after the timeout)."""
+        if self.proc is None:
+            return None
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                return self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=30)
+                return None
+        return self.proc.poll()
+
+    def restart(self) -> "ReplicaProcess":
+        """Bring a (dead) replica back on its port."""
+        if self.alive():
+            self.kill9()
+        return self.start()
+
+
+def spawn_fleet(
+    ckpt_dir: str,
+    n: int,
+    *,
+    base_port: int = 8441,
+    host: str = "127.0.0.1",
+    log_dir: str | None = None,
+    serve_args: list | None = None,
+    wait_ready_s: float = 300.0,
+) -> list:
+    """Boot ``n`` replicas on consecutive ports and wait until every
+    one reports ready. Raises RuntimeError (after terminating the
+    stragglers) when any replica fails to come up."""
+    procs = []
+    for i in range(n):
+        log_path = (os.path.join(log_dir, f"replica-{i}.log")
+                    if log_dir else None)
+        procs.append(ReplicaProcess(
+            i, ckpt_dir, base_port + i, host=host, log_path=log_path,
+            serve_args=serve_args,
+        ).start())
+    failed = [p.rid for p in procs if not p.wait_ready(wait_ready_s)]
+    if failed:
+        for p in procs:
+            p.terminate(timeout_s=5.0)
+        raise RuntimeError(
+            f"replicas {failed} never became ready within "
+            f"{wait_ready_s:.0f} s (logs: {log_dir or 'discarded'})"
+        )
+    return procs

@@ -44,8 +44,10 @@ from cgnn_tpu.observe.metrics_io import jsonfinite
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
-# device{i}_metric gauges become one labeled family per metric
+# device{i}_metric / replica{i}_metric gauges become one labeled family
+# per metric (the per-chip and per-fleet-replica series in /metrics)
 _DEVICE_GAUGE = re.compile(r"^device(\d+)_(\w+)$")
+_REPLICA_GAUGE = re.compile(r"^replica(\d+)_(\w+)$")
 
 
 class RollingSeries:
@@ -247,20 +249,24 @@ class MetricsRegistry:
             cname = name if name.endswith("_total") else f"{name}_total"
             emit(cname, "counter", [("", float(value))])
 
-        # fold device{i}_* gauges into labeled families
-        device_fams: dict[str, list[tuple[str, float]]] = {}
+        # fold device{i}_* / replica{i}_* gauges into labeled families
+        labeled_fams: dict[str, list[tuple[str, float]]] = {}
         plain: list[tuple[str, float]] = []
         for name, value in sorted(snap["gauges"].items()):
-            m = _DEVICE_GAUGE.match(name)
-            if m:
-                device_fams.setdefault(f"device_{m.group(2)}", []).append(
-                    (f'{{device="{m.group(1)}"}}', float(value))
-                )
+            for pattern, label in ((_DEVICE_GAUGE, "device"),
+                                   (_REPLICA_GAUGE, "replica")):
+                m = pattern.match(name)
+                if m:
+                    labeled_fams.setdefault(
+                        f"{label}_{m.group(2)}", []).append(
+                        (f'{{{label}="{m.group(1)}"}}', float(value))
+                    )
+                    break
             else:
                 plain.append((name, float(value)))
         for name, value in plain:
             emit(name, "gauge", [("", value)])
-        for fam, samples in sorted(device_fams.items()):
+        for fam, samples in sorted(labeled_fams.items()):
             emit(fam, "gauge", samples)
 
         for name, q in sorted(snap["series"].items()):

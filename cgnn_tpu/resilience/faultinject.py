@@ -18,6 +18,23 @@ provokes is reproducible:
 - ``loader_exc=N``   — raise ``InjectedLoaderError`` in place of the
   N-th training batch (exercises producer-thread shutdown).
 
+Serve-side fault points (ISSUE 14 — the chaos substrate the fleet
+harness drives; all counted over the SERVING dispatch stream):
+
+- ``dispatch_exc=N``       — raise ``InjectedDispatchError`` at the
+  N-th (0-based) flush dispatch: the flush fails alone, its futures get
+  the error, HTTP clients see a typed 500 — the fleet router's
+  retry-on-5xx path;
+- ``wedge_flush=N[:SECS]`` — stall the N-th flush dispatch for SECS
+  (default 600) seconds: the wedged-worker case the bounded
+  ``--drain-timeout`` force-exit exists for;
+- ``slow_dispatch=MS[:EVERY]`` — add MS milliseconds to every
+  EVERY-th (default every) flush dispatch: the degraded-replica case
+  the router's health scoring avoids and hedging races;
+- ``drop_conn=N``          — close every N-th HTTP ``/predict``
+  connection without a response: how a dying replica presents on the
+  wire (the router's transport-error retry path).
+
 With the variable unset every hook is a cheap no-op: ``plan()`` is
 ``None`` and iterators are returned unwrapped.
 
@@ -31,11 +48,18 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import threading
+import time
 from typing import Iterable, Iterator
 
 import numpy as np
 
 ENV_VAR = "CGNN_TPU_FAULTS"
+
+# serve-side ordinal counters are bumped from concurrent dispatch /
+# HTTP-handler threads; the lock keeps "every N-th" exactly every N-th
+# (the training-side counters run on one thread and stay lock-free)
+_serve_lock = threading.Lock()
 
 
 class InjectedCrash(RuntimeError):
@@ -46,6 +70,10 @@ class InjectedLoaderError(RuntimeError):
     """An injected data-loader failure."""
 
 
+class InjectedDispatchError(RuntimeError):
+    """An injected serving-dispatch failure (the flush fails alone)."""
+
+
 @dataclasses.dataclass
 class FaultPlan:
     nan_batch: int | None = None
@@ -54,10 +82,19 @@ class FaultPlan:
     crash_hit: int = 1
     crash_exit: bool = False
     loader_exc: int | None = None
+    # serve-side faults (ISSUE 14)
+    dispatch_exc: int | None = None
+    wedge_flush: int | None = None
+    wedge_secs: float = 600.0
+    slow_dispatch_ms: float | None = None
+    slow_every: int = 1
+    drop_conn: int | None = None
     # mutable hit counters (the determinism bookkeeping)
     _crash_hits: dict = dataclasses.field(default_factory=dict)
     _batches_seen: int = 0
     _sigterm_fired: bool = False
+    _dispatches_seen: int = 0
+    _conns_seen: int = 0
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -76,6 +113,20 @@ class FaultPlan:
                 if len(fields) > 1 and fields[1]:
                     plan.crash_hit = int(fields[1])
                 plan.crash_exit = len(fields) > 2 and fields[2] == "exit"
+            elif key == "dispatch_exc":
+                plan.dispatch_exc = int(value)
+            elif key == "wedge_flush":
+                fields = value.split(":")
+                plan.wedge_flush = int(fields[0])
+                if len(fields) > 1 and fields[1]:
+                    plan.wedge_secs = float(fields[1])
+            elif key == "slow_dispatch":
+                fields = value.split(":")
+                plan.slow_dispatch_ms = float(fields[0])
+                if len(fields) > 1 and fields[1]:
+                    plan.slow_every = max(1, int(fields[1]))
+            elif key == "drop_conn":
+                plan.drop_conn = int(value)
             else:
                 raise ValueError(
                     f"unknown fault key {key!r} in {ENV_VAR}={spec!r}"
@@ -95,6 +146,19 @@ class FaultPlan:
             )
         if self.loader_exc is not None:
             parts.append(f"loader exception @batch {self.loader_exc}")
+        if self.dispatch_exc is not None:
+            parts.append(f"dispatch exception @flush {self.dispatch_exc}")
+        if self.wedge_flush is not None:
+            parts.append(
+                f"wedge @flush {self.wedge_flush} ({self.wedge_secs:g} s)"
+            )
+        if self.slow_dispatch_ms is not None:
+            parts.append(
+                f"+{self.slow_dispatch_ms:g} ms every "
+                f"{self.slow_every} dispatch(es)"
+            )
+        if self.drop_conn is not None:
+            parts.append(f"drop every {self.drop_conn}th connection")
         return ", ".join(parts) or "none"
 
 
@@ -191,6 +255,40 @@ def poison_batches(batches: Iterable) -> Iterator:
             yield poison_nan(b) if i == p.nan_batch else b
 
     return wrapped()
+
+
+def dispatch_point() -> None:
+    """Serve-side fault point, called once per flush dispatch (ISSUE
+    14). Counts dispatches across the run and fires the configured
+    slow/wedge/exception faults at their exact ordinals — a no-op (one
+    None check) without a plan."""
+    p = plan()
+    if p is None or (p.dispatch_exc is None and p.wedge_flush is None
+                     and p.slow_dispatch_ms is None):
+        return
+    with _serve_lock:  # concurrent per-device dispatch threads
+        i = p._dispatches_seen
+        p._dispatches_seen += 1
+    if p.slow_dispatch_ms is not None and i % p.slow_every == 0:
+        time.sleep(p.slow_dispatch_ms / 1e3)
+    if p.wedge_flush is not None and i == p.wedge_flush:
+        time.sleep(p.wedge_secs)
+    if p.dispatch_exc is not None and i == p.dispatch_exc:
+        raise InjectedDispatchError(
+            f"injected dispatch failure at flush {i}"
+        )
+
+
+def drop_connection() -> bool:
+    """True when the plan says to kill this HTTP connection without a
+    response (serve/http.py closes the socket) — every N-th /predict."""
+    p = plan()
+    if p is None or p.drop_conn is None or p.drop_conn < 1:
+        return False
+    with _serve_lock:  # concurrent HTTP handler threads
+        i = p._conns_seen
+        p._conns_seen += 1
+    return i % p.drop_conn == p.drop_conn - 1
 
 
 # ---- host-side corruption (test utility; no plan needed) ----

@@ -19,7 +19,12 @@ lives in server.py; this module only translates wire <-> core:
   response echoes it in the ``X-Request-Id`` header and carries the
   monotonic stage stamps (queued/packed/dispatched/fetched/replied) so
   a slow request is attributable to its stage from the client side.
-- ``GET /healthz``   liveness + current param version.
+- ``GET /healthz``   liveness AND readiness (ISSUE 14): ``ok`` says
+  the process is up; ``ready`` says it can serve at its warm latency —
+  200 only once ``warm()`` has compiled the shape set and the server is
+  not draining, 503 (+ Retry-After) otherwise. A fleet router keys on
+  ``ready``: a warming replica looks alive but would eat traffic into
+  cold-compile latency.
 - ``GET /stats``     the server's full stats() dict (SLO numbers,
   including the live ``rolling`` window + per-device in-flight depth).
 - ``GET /metrics``   Prometheus text exposition from the server's
@@ -32,7 +37,10 @@ lives in server.py; this module only translates wire <-> core:
 
 Rejections map to the HTTP codes clients expect from a loaded service:
 429 queue-full (back off), 413 oversize (never retry), 504 deadline
-exceeded, 503 draining (connection: retry elsewhere).
+exceeded, 503 draining/warming (retry elsewhere). The backpressure
+codes (429, 503) carry a ``Retry-After`` header so well-behaved clients
+and the fleet router back off for a concrete interval instead of
+hammering a loaded or draining replica.
 """
 
 from __future__ import annotations
@@ -46,6 +54,7 @@ import numpy as np
 from cgnn_tpu.data.graph import CrystalGraph
 from cgnn_tpu.data.rawbatch import RawStructure
 from cgnn_tpu.observe.metrics_io import jsonfinite
+from cgnn_tpu.resilience import faultinject
 from cgnn_tpu.serve.batcher import (
     MALFORMED,
     OVERSIZE,
@@ -62,6 +71,16 @@ _REJECT_STATUS = {
     OVERSIZE: 413,
     TIMEOUT: 504,
     SHUTDOWN: 503,
+}
+
+# backpressure responses name a concrete back-off (ISSUE 14): a full
+# queue clears within a couple of flush intervals (seconds at most); a
+# draining replica needs its restart window. 4xx/504 rejections are
+# about the REQUEST — retrying them sooner or later changes nothing, so
+# they carry no header.
+_RETRY_AFTER_S = {
+    QUEUE_FULL: 1,
+    SHUTDOWN: 5,
 }
 
 
@@ -159,11 +178,28 @@ def make_handler(server: InferenceServer):
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
             if self.path == "/healthz":
-                self._reply(200, {
+                # liveness vs READINESS (ISSUE 14): 200 only when the
+                # warm shape set is compiled and the server is taking
+                # work — a router must not route traffic into a warming
+                # (cold-compile latency) or draining replica. serve.py
+                # binds the listener BEFORE warm(), so this signal is
+                # real for the whole boot window.
+                draining = server.stats()["draining"]
+                ready = server.warmed and not draining
+                payload = {
                     "ok": True,
+                    "ready": ready,
+                    "warmed": server.warmed,
+                    "draining": draining,
                     "param_version": server.param_store.version,
-                    "draining": server.stats()["draining"],
-                })
+                    "queue_depth": server.batcher.depth,
+                }
+                if ready:
+                    self._reply(200, payload)
+                else:
+                    self._reply(503, payload,
+                                headers={"Retry-After":
+                                         str(_RETRY_AFTER_S[SHUTDOWN])})
             elif self.path == "/stats":
                 self._reply(200, server.stats())
             elif self.path == "/metrics":
@@ -200,6 +236,16 @@ def make_handler(server: InferenceServer):
             self._reply(200, {"ok": True, **record})
 
         def do_POST(self):  # noqa: N802
+            # serve-side chaos point (resilience/faultinject.py):
+            # close the socket without a response — the way a dying
+            # replica presents to a client mid-request. Exercises the
+            # fleet router's transport-error retry path. /predict ONLY:
+            # the fault contract is "every N-th /predict", and eating a
+            # /profile ordinal would both drop the wrong request and
+            # shift the advertised cadence.
+            if self.path == "/predict" and faultinject.drop_connection():
+                self.close_connection = True
+                return
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length) or b"{}")
@@ -211,6 +257,16 @@ def make_handler(server: InferenceServer):
                 return
             if self.path != "/predict":
                 self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            if not server.warmed:
+                # readiness guard: admitting now would either queue the
+                # request behind the whole warmup or trace a cold
+                # program — both break the latency contract /healthz
+                # readiness promises the router
+                self._reply(503, {
+                    "error": "server is warming (shape set compiling)",
+                    "reason": SHUTDOWN,
+                }, headers={"Retry-After": str(_RETRY_AFTER_S[SHUTDOWN])})
                 return
             try:
                 if "graph" in payload:
@@ -240,13 +296,23 @@ def make_handler(server: InferenceServer):
                     precision=payload.get("precision"),
                 )
             except ServeRejection as e:
+                headers = None
+                if e.reason in _RETRY_AFTER_S:
+                    headers = {"Retry-After": str(_RETRY_AFTER_S[e.reason])}
                 self._reply(_REJECT_STATUS.get(e.reason, 500), {
                     "error": str(e), "reason": e.reason,
-                })
+                }, headers=headers)
                 return
             except TimeoutError:
                 self._reply(504, {"error": "result wait timed out",
                                   "reason": TIMEOUT})
+                return
+            except Exception as e:  # noqa: BLE001 — a failed flush must
+                # surface as a TYPED 500, not a closed socket: the fleet
+                # router retries it on a sibling replica (the
+                # dispatch-exception chaos leg drives exactly this path)
+                self._reply(500, {"error": repr(e),
+                                  "reason": "dispatch_failed"})
                 return
             self._reply(200, {
                 "prediction": result.prediction.tolist(),

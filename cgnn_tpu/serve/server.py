@@ -79,6 +79,7 @@ import numpy as np
 from cgnn_tpu.analysis import racecheck
 from cgnn_tpu.data.graph import CrystalGraph
 from cgnn_tpu.data.rawbatch import RawStructure, raw_fingerprint
+from cgnn_tpu.resilience import faultinject
 from cgnn_tpu.serve.batcher import (
     MALFORMED,
     OVERSIZE,
@@ -1063,6 +1064,8 @@ class InferenceServer:
     def _dispatch_flush_mesh(self, flush: Flush, packed) -> None:
         import jax
 
+        # same chaos point as the single-device path (ISSUE 14)
+        faultinject.dispatch_point()
         stacked, counts, sub_shape = packed
         n = len(self.mesh_exec)
         reqs = flush.requests
@@ -1293,6 +1296,10 @@ class InferenceServer:
     def _dispatch_flush(self, flush: Flush, batch, device: int = 0) -> None:
         import jax
 
+        # serve-side chaos point (resilience/faultinject.py, ISSUE 14):
+        # deterministic dispatch exception / wedge / slowdown — a no-op
+        # without a CGNN_TPU_FAULTS plan
+        faultinject.dispatch_point()
         reqs = flush.requests
         # the hot-swap boundary: one consistent (params, version) REPLICA
         # pair per batch, read from the dispatch device's slot FOR THE
@@ -1585,6 +1592,7 @@ def load_server(
     engine: str = "auto",
     precision: str = "f32",
     watch: bool = True,
+    warm: bool = True,
     poll_interval_s: float = 2.0,
     profile_dir: str = "",
     log_fn: Callable = print,
@@ -1752,7 +1760,12 @@ def load_server(
         featurizer=structure_featurizer(data_cfg),
         raw_precheck=raw_precheck, log_fn=log_fn,
     )
-    server.warm(template)
+    # ``warm=False`` (ISSUE 14): the caller compiles later — serve.py
+    # binds its HTTP listener FIRST so /healthz can report ready=False
+    # for the whole warmup window instead of connection-refused (a
+    # router cannot tell refused-because-warming from dead)
+    if warm:
+        server.warm(template)
     if profile_dir:
         server.enable_profiling(profile_dir)
     if watch:

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Fleet serving entrypoint (cgnn_tpu.fleet; ISSUE 14).
+
+Boots N independent serve.py replica processes against one checkpoint
+directory, fronts them with a health-routed resilient router (bounded
+retries + backoff, deadline-aware hedging, per-replica circuit
+breakers, 503 + Retry-After load shedding), and serves the same
+``POST /predict`` wire protocol a single replica does — plus
+``GET /healthz`` (fleet readiness), ``GET /stats``, and
+``GET /metrics`` (router counters + per-replica gauges/series).
+
+The replicas share the checkpoint directory, so a rolling promotion is
+just the trainer committing a new save: every replica's own hot-reload
+watcher picks it up within its poll interval, swapping atomically
+mid-load — old and new ``param_version`` serve fleet-wide with zero
+drops, exactly like the single-process invariant, now N-wide.
+
+SIGTERM/SIGINT drains: the router sheds new work, replicas get SIGTERM
+(their own graceful drain answers queued requests), exit 0.
+
+Usage:
+    python fleet.py CKPT_DIR --replicas 3 [--port 8440] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("ckpt_dir", help="checkpoint directory written by train.py")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8440,
+                   help="router listen port")
+    p.add_argument("--replicas", type=int, default=3,
+                   help="serve.py replica processes to boot")
+    p.add_argument("--replica-base-port", type=int, default=8441,
+                   help="replicas bind base..base+N-1")
+    p.add_argument("--log-dir", default="",
+                   help="per-replica log files ('' = discard)")
+    p.add_argument("--retries", type=int, default=3,
+                   help="max extra attempts per request (attempt budget "
+                        "= retries + 1, shared with the hedge)")
+    p.add_argument("--backoff-ms", type=float, default=25.0,
+                   help="initial retry backoff (exponential, jittered)")
+    p.add_argument("--hedge-ms", type=float, default=None,
+                   help="hedge a request to a second replica after this "
+                        "long in flight (default: auto, 2x the "
+                        "replica's rolling p99; 0 disables)")
+    p.add_argument("--breaker-k", type=int, default=3,
+                   help="consecutive failures that eject a replica")
+    p.add_argument("--breaker-cooldown", type=float, default=2.0,
+                   help="seconds ejected before the half-open probe")
+    p.add_argument("--health-interval", type=float, default=1.0,
+                   help="seconds between /healthz + /metrics probe rounds")
+    p.add_argument("--timeout-ms", type=float, default=30000.0,
+                   help="default per-request fleet deadline")
+    p.add_argument("--drain-timeout", type=float, default=60.0,
+                   help="bound on the SIGTERM graceful drain of the "
+                        "replica fleet; past it, replicas are killed "
+                        "and the router exits non-zero")
+    p.add_argument("--serve-arg", action="append", default=[],
+                   metavar="ARG", help="extra argument passed through to "
+                                       "every serve.py replica "
+                                       "(repeatable)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from cgnn_tpu.fleet.http import make_fleet_http_server
+    from cgnn_tpu.fleet.replica import ReplicaState
+    from cgnn_tpu.fleet.router import FleetRouter
+    from cgnn_tpu.fleet.spawn import spawn_fleet
+    from cgnn_tpu.resilience.preempt import PreemptionHandler
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    print(f"fleet: booting {args.replicas} replicas on ports "
+          f"{args.replica_base_port}.."
+          f"{args.replica_base_port + args.replicas - 1} "
+          f"(ckpt {args.ckpt_dir})")
+    try:
+        procs = spawn_fleet(
+            args.ckpt_dir, args.replicas,
+            base_port=args.replica_base_port, host=args.host,
+            log_dir=args.log_dir or None, serve_args=args.serve_arg,
+        )
+    except (RuntimeError, FileNotFoundError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    replicas = [
+        ReplicaState(p.rid, p.base_url, breaker_k=args.breaker_k,
+                     breaker_cooldown_s=args.breaker_cooldown)
+        for p in procs
+    ]
+    router = FleetRouter(
+        replicas,
+        max_attempts=args.retries + 1,
+        backoff_ms=args.backoff_ms,
+        hedge_ms=args.hedge_ms,
+        default_timeout_ms=args.timeout_ms,
+        health_interval_s=args.health_interval,
+    ).start()
+
+    httpd = make_fleet_http_server(router, host=args.host, port=args.port)
+    stop = threading.Event()
+    handler = PreemptionHandler(
+        log_fn=print,
+        action="draining the fleet (router sheds new work; replicas "
+               "drain their queues)",
+    )
+    handler.add_callback(stop.set)
+    handler.install()
+
+    listener = threading.Thread(target=httpd.serve_forever, daemon=True,
+                                name="fleet-http")
+    listener.start()
+    print(f"fleet: routing on http://{args.host}:{args.port} over "
+          f"{len(replicas)} replicas "
+          f"({router.ready_count()} ready; live plane: GET /metrics)")
+    try:
+        while not stop.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    httpd.shutdown()
+    httpd.server_close()
+    router.stop()
+    codes = [p.terminate(timeout_s=args.drain_timeout) for p in procs]
+    handler.uninstall()
+    stats = router.stats()["counts"]
+    print(f"fleet: drained — {stats['fleet_answered']} answered, "
+          f"{stats['fleet_retries']} retries, {stats['fleet_hedges']} "
+          f"hedges, {stats['fleet_shed']} shed; replica exits {codes}")
+    if any(c != 0 for c in codes):
+        print(f"fleet: replica drain failures: {codes}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

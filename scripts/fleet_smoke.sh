@@ -1,0 +1,270 @@
+#!/usr/bin/env bash
+# Fleet chaos smoke (ISSUE 14 acceptance; .github/workflows/tier1.yml):
+#
+#  0. fleet.py entrypoint end to end: boot 2 replicas + the router
+#     process, wait for fleet readiness, answer one /predict THROUGH
+#     the router (X-Fleet-Replica header present), scrape the router's
+#     /metrics (fleet_* counters + replica-labeled families), then
+#     SIGTERM -> graceful fleet drain, exit 0.
+#  1. KILL LEG: 3 replicas under open-loop load; kill -9 one replica
+#     mid-load, restart it later. The loadgen hard-asserts (exit != 0
+#     otherwise): ZERO lost accepted requests (in-flight work on the
+#     dead replica retried onto survivors), EXACTLY ONE answer per
+#     request (trace-id uniqueness + router duplicate counter 0), the
+#     router actually saw transport errors (the chaos bit), and the
+#     restarted replica was probed back in and answered again.
+#  2. PROMOTION LEG: a new checkpoint version committed mid-load rolls
+#     across the fleet via each replica's own hot-reload watcher —
+#     responses observed from BOTH versions, fleet converges
+#     version-consistent, zero drops.
+#  3. DEGRADED-REPLICA LEGS: (3a) one replica slowed by an injected
+#     per-dispatch delay — the router must HEDGE past it (hedges > 0,
+#     first success wins, straggler successes counted as waste, never
+#     delivered); (3b) one replica failing dispatches (injected
+#     exception -> typed 500) and dropping connections mid-request,
+#     hedging disabled — the SEQUENTIAL retry + backoff path alone
+#     must hold zero-lost (retries > 0, transport errors survived).
+#  4. WEDGE LEG: a single replica with an injected WEDGED flush gets
+#     SIGTERM; the bounded --drain-timeout must force-exit non-zero
+#     with the unanswered count logged (a wedged flush must not hold
+#     shutdown forever).
+#
+# Runs anywhere jax[cpu] does (synthetic data, CPU device).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+BASE="${FLEET_SMOKE_PORT:-18460}"
+
+echo "== setup: tiny synthetic checkpoint =="
+python scripts/serve_loadgen.py --make-ckpt "$WORK/ckpt"
+
+echo "== leg 0: fleet.py entrypoint (router + 2 replicas, drain) =="
+python fleet.py "$WORK/ckpt" --replicas 2 --port "$BASE" \
+  --replica-base-port "$((BASE + 1))" --log-dir "$WORK/fleet0-logs" \
+  --serve-arg=--calibrate --serve-arg=64 \
+  >"$WORK/fleet0.log" 2>&1 &
+FPID=$!
+for _ in $(seq 1 900); do
+  curl -sf "http://127.0.0.1:$BASE/healthz" >/dev/null 2>&1 && break
+  if ! kill -0 "$FPID" 2>/dev/null; then
+    echo "fleet.py died during startup" >&2
+    cat "$WORK/fleet0.log" >&2
+    exit 1
+  fi
+  sleep 0.2
+done
+python - "$BASE" <<'EOF'
+import json, sys, urllib.request
+base = f"http://127.0.0.1:{sys.argv[1]}"
+from cgnn_tpu.config import DataConfig
+from cgnn_tpu.data.dataset import load_synthetic
+g = load_synthetic(1, DataConfig(radius=6.0,
+                                 max_num_nbr=12).featurize_config(),
+                   seed=3)[0]
+body = json.dumps({"graph": {
+    "atom_fea": g.atom_fea.tolist(), "edge_fea": g.edge_fea.tolist(),
+    "centers": g.centers.tolist(), "neighbors": g.neighbors.tolist(),
+}, "timeout_ms": 30000}, allow_nan=False).encode()
+req = urllib.request.Request(base + "/predict", data=body,
+                             headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=60.0) as resp:
+    payload = json.loads(resp.read())
+    replica = resp.headers.get("X-Fleet-Replica")
+    attempts = resp.headers.get("X-Fleet-Attempts")
+assert payload.get("param_version"), payload
+assert replica is not None and attempts == "1", (replica, attempts)
+with urllib.request.urlopen(base + "/metrics", timeout=30.0) as resp:
+    text = resp.read().decode()
+from cgnn_tpu.observe.export import parse_prometheus_text
+fams = parse_prometheus_text(text)
+for prefix in ("cgnn_fleet_", "cgnn_replica_"):
+    assert any(f.startswith(prefix) for f in fams), (prefix, sorted(fams))
+with urllib.request.urlopen(base + "/healthz", timeout=10.0) as resp:
+    health = json.loads(resp.read())
+assert health["ready"] and health["replicas_ready"] == 2, health
+print("leg 0 ok: routed predict via replica", replica,
+      "-", len(fams), "metric families, fleet ready", health["versions"])
+EOF
+kill -TERM "$FPID"
+set +e; wait "$FPID"; RC=$?; set -e
+if [ "$RC" -ne 0 ]; then
+  echo "expected graceful fleet drain exit 0, got $RC" >&2
+  tail -40 "$WORK/fleet0.log" >&2
+  exit 1
+fi
+grep -q "fleet: drained" "$WORK/fleet0.log"
+echo "leg 0 drain ok"
+
+echo "== leg 1: kill -9 a live replica mid-load, restart, re-admit =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 3 --fleet-base-port "$((BASE + 10))" \
+  --fleet-log-dir "$WORK/fleet1-logs" \
+  --clients 16 --duration 20 \
+  --kill-at 0.3 --restart-at 0.5 --kill-replica 1 \
+  --expect-retries --no-scrape \
+  --report "$WORK/fleet_kill.json"
+python - "$WORK/fleet_kill.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+assert r["dropped"] == 0 and not r["client_errors"], r
+fl = r["fleet"]; rc = fl["router"]["counts"]; chaos = fl["chaos"]
+assert "killed_at_s" in chaos and chaos["restart_ready"], chaos
+assert rc["fleet_transport_errors"] >= 1, rc
+assert rc["fleet_retries"] >= 1, rc
+assert rc["fleet_duplicate_answers"] == 0, rc
+assert chaos["victim_answered_at_end"] > chaos["victim_answered_at_restart"], chaos
+t = r["tracing"]
+assert t["unique_trace_ids"] == r["answered"] and t["missing_trace_ids"] == 0, t
+print("leg 1 ok:", r["answered"], "answered @", r["throughput_rps"],
+      "rps | kill at", chaos["killed_at_s"], "s, restart at",
+      chaos["restarted_at_s"], "s | victim answered",
+      chaos["victim_answered_at_restart"], "->",
+      chaos["victim_answered_at_end"], "|", rc["fleet_retries"],
+      "retries,", rc["fleet_transport_errors"], "transport errors - 0 lost")
+EOF
+
+echo "== leg 2: rolling checkpoint promotion across the fleet =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 3 --fleet-base-port "$((BASE + 20))" \
+  --fleet-log-dir "$WORK/fleet2-logs" \
+  --clients 16 --duration 15 \
+  --promote-at 0.4 --no-scrape \
+  --report "$WORK/fleet_promote.json"
+python - "$WORK/fleet_promote.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+assert r["dropped"] == 0, r
+fl = r["fleet"]; chaos = fl["chaos"]
+assert chaos.get("promotion_consistent"), chaos
+versions = [v for v, c in r["param_versions"].items() if c > 0]
+assert len(versions) >= 2, r["param_versions"]
+final = set(chaos["final_versions"].values())
+assert len(final) == 1 and chaos["promoted_to"] in final, chaos
+print("leg 2 ok:", r["answered"], "answered across versions",
+      r["param_versions"], "- fleet converged on", chaos["promoted_to"],
+      "- 0 drops")
+EOF
+
+echo "== leg 3a: slow replica -> deadline-aware hedging =="
+# hedging is the mechanism under test here, so it also ABSORBS the
+# slow replica's failures before a sequential retry would fire — the
+# retry path gets its own leg (3b) with hedging disabled
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 3 --fleet-base-port "$((BASE + 30))" \
+  --fleet-log-dir "$WORK/fleet3a-logs" \
+  --clients 16 --duration 12 \
+  --replica-faults "slow_dispatch=150" --faulty-replica 2 \
+  --hedge-ms 120 --expect-hedges \
+  --report "$WORK/fleet_hedge.json"
+python - "$WORK/fleet_hedge.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+assert r["dropped"] == 0, r
+rc = r["fleet"]["router"]["counts"]
+assert rc["fleet_hedges"] >= 1, rc
+assert rc["fleet_duplicate_answers"] == 0, rc
+t = r["tracing"]
+assert t["unique_trace_ids"] == r["answered"], t
+scrape = r["fleet"]["metrics_scrape"]
+assert scrape["parse_ok"] and not scrape["missing_families"], scrape
+print("leg 3a ok:", r["answered"], "answered |", rc["fleet_hedges"],
+      "hedges (", rc.get("fleet_hedge_wins", 0), "wins,",
+      rc.get("fleet_hedge_waste", 0), "waste ) - 0 lost,",
+      "exactly-once held")
+EOF
+
+echo "== leg 3b: failing dispatch + dropped connections -> retries =="
+# hedging OFF so the 500s (injected dispatch exception) and transport
+# errors (every 25th connection closed mid-request) must be survived
+# by the SEQUENTIAL retry + backoff path alone
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 3 --fleet-base-port "$((BASE + 35))" \
+  --fleet-log-dir "$WORK/fleet3b-logs" \
+  --clients 16 --duration 10 \
+  --replica-faults "dispatch_exc=3;drop_conn=25" --faulty-replica 2 \
+  --hedge-ms 0 --expect-retries --no-scrape \
+  --report "$WORK/fleet_retry.json"
+python - "$WORK/fleet_retry.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+assert r["dropped"] == 0, r
+rc = r["fleet"]["router"]["counts"]
+assert rc["fleet_retries"] >= 1, rc
+assert rc["fleet_transport_errors"] >= 1, rc  # the dropped conns bit
+assert rc["fleet_duplicate_answers"] == 0, rc
+t = r["tracing"]
+assert t["unique_trace_ids"] == r["answered"], t
+print("leg 3b ok:", r["answered"], "answered |", rc["fleet_retries"],
+      "retries over", rc["fleet_transport_errors"], "transport errors",
+      "+", rc.get("fleet_upstream_500", 0), "upstream 500s - 0 lost")
+EOF
+
+echo "== leg 4: wedged flush vs bounded --drain-timeout (force exit) =="
+PORT4=$((BASE + 40))
+CGNN_TPU_FAULTS="wedge_flush=2:600" \
+python serve.py "$WORK/ckpt" --port "$PORT4" --calibrate 64 \
+  --drain-timeout 5 >"$WORK/wedge.log" 2>&1 &
+WPID=$!
+for _ in $(seq 1 600); do
+  curl -sf "http://127.0.0.1:$PORT4/healthz" >/dev/null 2>&1 && break
+  if ! kill -0 "$WPID" 2>/dev/null; then
+    echo "serve.py died during startup" >&2
+    cat "$WORK/wedge.log" >&2
+    exit 1
+  fi
+  sleep 0.2
+done
+python - "$PORT4" <<'EOF'
+import json, sys, threading, urllib.request
+base = f"http://127.0.0.1:{sys.argv[1]}"
+from cgnn_tpu.config import DataConfig
+from cgnn_tpu.data.dataset import load_synthetic
+# DISTINCT structures: identical bodies would be served from the LRU
+# result cache after the first flush and never reach the wedge point
+graphs = load_synthetic(6, DataConfig(radius=6.0,
+                                      max_num_nbr=12).featurize_config(),
+                        seed=4)
+bodies = [json.dumps({"graph": {
+    "atom_fea": g.atom_fea.tolist(), "edge_fea": g.edge_fea.tolist(),
+    "centers": g.centers.tolist(), "neighbors": g.neighbors.tolist(),
+}, "timeout_ms": 60000}, allow_nan=False).encode() for g in graphs]
+
+def post(i):
+    req = urllib.request.Request(base + "/predict", data=bodies[i],
+                                 headers={"Content-Type":
+                                          "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=8.0) as resp:
+            resp.read()
+    except Exception:
+        pass  # requests 3+ hang on the wedged flush — expected
+
+# sequential posts make each request its own flush: flushes 0 and 1
+# answer, flush 2 WEDGES the dispatch worker for 600 s, the rest queue
+threads = []
+for i in range(6):
+    t = threading.Thread(target=post, args=(i,), daemon=True)
+    t.start(); threads.append(t)
+    t.join(timeout=6.0)
+print("wedge armed: requests issued")
+EOF
+kill -TERM "$WPID"
+set +e; wait "$WPID"; RC=$?; set -e
+if [ "$RC" -eq 0 ]; then
+  echo "expected FORCED non-zero exit past --drain-timeout, got 0" >&2
+  tail -40 "$WORK/wedge.log" >&2
+  exit 1
+fi
+grep -q "drain timed out" "$WORK/wedge.log"
+grep -q "unanswered" "$WORK/wedge.log"
+grep -q "force-exiting" "$WORK/wedge.log"
+echo "leg 4 ok: wedged drain force-exited rc=$RC with unanswered count logged"
+
+echo "fleet smoke: ALL LEGS PASSED"

@@ -2,8 +2,12 @@
 """Concurrent open-loop load generator for the serving subsystem.
 
 Drives the IN-PROCESS ``InferenceServer`` (no sockets — the pure core,
-so CI and laptops measure batching/reload behavior, not TCP noise) or a
-running HTTP server (``--http URL``), and writes an SLO report JSON:
+so CI and laptops measure batching/reload behavior, not TCP noise), a
+running HTTP server (``--http URL``), or a whole REPLICA FLEET
+(``--fleet N``, ISSUE 14: N real serve.py processes behind the
+in-process FleetRouter, with kill -9/restart/rolling-promotion chaos
+legs and the zero-lost-accepted + exactly-one-answer invariants
+hard-asserted), and writes an SLO report JSON:
 latency p50/p95/p99, throughput, batch occupancy, reject counts, param
 versions observed, and the invariant checks the ISSUE pins:
 
@@ -47,6 +51,57 @@ def build_parser() -> argparse.ArgumentParser:
                    help="create a tiny synthetic checkpoint at DIR and exit")
     p.add_argument("--http", default="",
                    help="fire at a running HTTP server instead of in-process")
+    # ---- fleet chaos mode (ISSUE 14) ----
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="spawn N serve.py replica processes + the "
+                        "in-process FleetRouter and drive open-loop "
+                        "load THROUGH the router (cgnn_tpu/fleet/); "
+                        "hard-asserts zero lost accepted requests and "
+                        "exactly one answer per request — the chaos "
+                        "legs below kill/restart live replicas "
+                        "underneath the load")
+    p.add_argument("--fleet-base-port", type=int, default=18460)
+    p.add_argument("--fleet-log-dir", default="",
+                   help="per-replica log files (default: next to "
+                        "--report)")
+    p.add_argument("--kill-at", type=float, default=0.0, metavar="FRAC",
+                   help="kill -9 the victim replica at FRAC of the "
+                        "load duration (0 disables) — in-flight "
+                        "requests must be retried onto survivors, "
+                        "zero lost")
+    p.add_argument("--restart-at", type=float, default=0.0,
+                   metavar="FRAC",
+                   help="restart the killed replica at FRAC of the "
+                        "duration; the router must probe it back in "
+                        "and it must answer again (asserted)")
+    p.add_argument("--kill-replica", type=int, default=1,
+                   help="victim replica index for --kill-at")
+    p.add_argument("--promote-at", type=float, default=0.0,
+                   metavar="FRAC",
+                   help="commit a NEW checkpoint version at FRAC of "
+                        "the duration: every replica's own watcher "
+                        "rolls it in mid-load — both versions must "
+                        "answer and the fleet must converge "
+                        "version-consistent with zero drops (asserted)")
+    p.add_argument("--replica-faults", default="", metavar="SPEC",
+                   help="CGNN_TPU_FAULTS plan injected into ONE "
+                        "replica (--faulty-replica), e.g. "
+                        "'slow_dispatch=150' for the hedging leg or "
+                        "'dispatch_exc=5' for the 500-retry leg")
+    p.add_argument("--faulty-replica", type=int, default=2)
+    p.add_argument("--retries", type=int, default=3,
+                   help="fleet router max extra attempts per request")
+    p.add_argument("--hedge-ms", type=float, default=None,
+                   help="fleet hedge point in ms (default auto: 2x "
+                        "replica rolling p99; 0 disables)")
+    p.add_argument("--breaker-k", type=int, default=3)
+    p.add_argument("--breaker-cooldown", type=float, default=2.0)
+    p.add_argument("--expect-hedges", action="store_true",
+                   help="fail unless the router actually hedged (the "
+                        "slow-replica leg)")
+    p.add_argument("--expect-retries", action="store_true",
+                   help="fail unless the router actually retried (the "
+                        "kill / dispatch-exception legs)")
     p.add_argument("--clients", type=int, default=64)
     p.add_argument("--duration", type=float, default=10.0,
                    help="seconds of open-loop load")
@@ -604,6 +659,380 @@ def _run_inproc(args) -> dict:
     return report
 
 
+def _commit_new_version(ckpt_dir: str, seed: int) -> str:
+    """Commit a fresh param version into the fleet's shared checkpoint
+    directory (the rolling-promotion fixture): same configs as the
+    resident checkpoint, different init — predictions visibly change,
+    every replica's watcher rolls it in. Returns the new save name."""
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.config import DataConfig, ModelConfig, build_model
+    from cgnn_tpu.data.dataset import load_synthetic
+    from cgnn_tpu.data.graph import batch_iterator, capacities_for
+    from cgnn_tpu.train import (
+        CheckpointManager,
+        Normalizer,
+        create_train_state,
+        make_optimizer,
+    )
+
+    mgr = CheckpointManager(ckpt_dir)
+    meta = mgr.read_meta("latest")
+    model_cfg = ModelConfig.from_meta(meta["model"])
+    data_cfg = DataConfig.from_meta(meta["data"])
+    graphs = load_synthetic(64, data_cfg.featurize_config(), seed=seed)
+    nc, ec = capacities_for(graphs, 16, dense_m=model_cfg.dense_m,
+                            snug=True)
+    example = next(batch_iterator(graphs, 16, nc, ec,
+                                  dense_m=model_cfg.dense_m, in_cap=0,
+                                  snug=True))
+    model = build_model(model_cfg, data_cfg, meta.get("task", "regression"))
+    state = create_train_state(
+        model, example, make_optimizer(),
+        Normalizer.fit(np.stack([g.target for g in graphs])),
+        rng=jax.random.key(seed),
+    )
+    mgr.save(state, dict(meta, epoch=int(meta.get("epoch", 0)) + 1))
+    mgr.wait()
+    name = mgr.newest_committed()
+    mgr.close()
+    return name
+
+
+def _run_fleet(args) -> dict:
+    """The fleet chaos harness (ISSUE 14): N real serve.py replica
+    processes behind the in-process FleetRouter, open-loop load driven
+    THROUGH the router while the chaos legs kill -9 / restart replicas
+    and roll a checkpoint promotion underneath it.
+
+    The invariants hard-asserted here (main() exits non-zero):
+
+    - ZERO lost accepted requests: every dispatch resolves to exactly
+      one typed outcome — an answer or an explicit rejection — even
+      while a replica dies mid-request (retried onto survivors);
+    - EXACTLY ONE answer per request: distinct trace ids == answered
+      and the router's duplicate-answer counter stays 0, under retries
+      AND hedges (the idempotency key is the trace id every attempt
+      shares);
+    - a killed replica is probed back in after restart and answers
+      again; a rolling promotion serves BOTH versions mid-roll and
+      converges version-consistent fleet-wide."""
+    import numpy as np
+
+    from cgnn_tpu.config import DataConfig
+    from cgnn_tpu.fleet.replica import ReplicaState
+    from cgnn_tpu.fleet.router import FleetRouter
+    from cgnn_tpu.fleet.spawn import ReplicaProcess
+    from cgnn_tpu.train import CheckpointManager
+
+    n = args.fleet
+    log_dir = args.fleet_log_dir or (
+        os.path.join(os.path.dirname(os.path.abspath(args.report)) or ".",
+                     "fleet-logs"))
+    os.makedirs(log_dir, exist_ok=True)
+    serve_args = [
+        "--calibrate", "64",
+        "--batch-size", str(args.batch_size),
+        "--rungs", str(args.rungs),
+        "--max-queue", str(args.max_queue),
+        "--max-wait-ms", str(args.max_wait_ms),
+        "--poll-interval", "0.5",
+        "--drain-timeout", "30",
+    ]
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        if args.replica_faults and i == args.faulty_replica % n:
+            env["CGNN_TPU_FAULTS"] = args.replica_faults
+        procs.append(ReplicaProcess(
+            i, args.ckpt_dir, args.fleet_base_port + i,
+            log_path=os.path.join(log_dir, f"replica-{i}.log"),
+            serve_args=serve_args, env=env,
+        ).start())
+    not_ready = [p.rid for p in procs if not p.wait_ready(300.0)]
+    if not_ready:
+        for p in procs:
+            p.terminate(timeout_s=5.0)
+        raise RuntimeError(f"replicas {not_ready} never became ready "
+                           f"(logs under {log_dir})")
+
+    replicas = [ReplicaState(p.rid, p.base_url,
+                             breaker_k=args.breaker_k,
+                             breaker_cooldown_s=args.breaker_cooldown)
+                for p in procs]
+    router = FleetRouter(
+        replicas,
+        max_attempts=args.retries + 1,
+        hedge_ms=args.hedge_ms,
+        default_timeout_ms=args.timeout_ms,
+        health_interval_s=0.5,
+    ).start()
+
+    from cgnn_tpu.data.dataset import load_synthetic
+
+    meta = CheckpointManager(args.ckpt_dir).read_meta("latest")
+    data_cfg = DataConfig.from_meta(meta["data"])
+    pool = load_synthetic(min(args.structures, 64),
+                          data_cfg.featurize_config(), seed=args.seed + 1)
+    bodies = [{"graph": {
+        "atom_fea": g.atom_fea.tolist(),
+        "edge_fea": g.edge_fea.tolist(),
+        "centers": g.centers.tolist(),
+        "neighbors": g.neighbors.tolist(),
+        "id": g.cif_id,
+    }} for g in pool]
+
+    stats = _ClientStats()
+    stop = threading.Event()
+    # per-replica answered counts + resilience meta, as the CLIENTS saw
+    # them (the router's own stats ride the report separately)
+    fleet_counts = {"attempts_hist": {}, "hedged_answers": 0,
+                    "retried_answers": 0}
+
+    def client(ci: int):
+        import numpy as _np
+
+        rng = _np.random.default_rng(args.seed + ci)
+        while not stop.is_set():
+            body = bodies[int(rng.integers(len(bodies)))]
+            with stats.lock:
+                stats.submitted += 1
+            try:
+                status, payload, meta_d = router.dispatch(
+                    dict(body), timeout_ms=args.timeout_ms)
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                with stats.lock:
+                    stats.errors.append(repr(e))
+                continue
+            with stats.lock:
+                if status == 200:
+                    stats.answered += 1
+                    stats.latencies.append(float(meta_d["latency_ms"]))
+                    v = payload.get("param_version", "?")
+                    stats.versions[v] = stats.versions.get(v, 0) + 1
+                    rid = meta_d["replica"]
+                    stats.device_responses[rid] = (
+                        stats.device_responses.get(rid, 0) + 1)
+                    stats.device_versions.setdefault(rid, set()).add(v)
+                    tid = meta_d["trace_id"]
+                    if tid:
+                        stats.trace_ids.add(tid)
+                    else:
+                        stats.missing_trace += 1
+                    a = meta_d["attempts"]
+                    fleet_counts["attempts_hist"][a] = (
+                        fleet_counts["attempts_hist"].get(a, 0) + 1)
+                    if meta_d["hedges"]:
+                        fleet_counts["hedged_answers"] += 1
+                    if meta_d["retries"]:
+                        fleet_counts["retried_answers"] += 1
+                else:
+                    reason = (payload or {}).get("reason", str(status))
+                    stats.rejected[reason] = (
+                        stats.rejected.get(reason, 0) + 1)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True,
+                                name=f"loadgen-fleet-client-{i}")
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    # ---- the chaos timeline, alongside the load ----
+    chaos_done = threading.Event()
+    promote_done = threading.Event()
+    chaos_log: dict = {}
+    victim = args.kill_replica % n
+
+    def chaos():
+        try:
+            if args.kill_at > 0:
+                stop.wait(args.duration * args.kill_at)
+                procs[victim].kill9()
+                chaos_log["killed_at_s"] = round(
+                    time.monotonic() - t_start, 2)
+            if args.restart_at > 0:
+                stop.wait(max(0.0, args.duration * args.restart_at
+                              - (time.monotonic() - t_start)))
+                procs[victim].restart()
+                ready = procs[victim].wait_ready(240.0)
+                chaos_log["restarted_at_s"] = round(
+                    time.monotonic() - t_start, 2)
+                chaos_log["restart_ready"] = ready
+                # snapshot the victim's answered count the moment it is
+                # back: "serves again" = the count GROWS past this
+                chaos_log["victim_answered_at_restart"] = (
+                    replicas[victim].counts["answered"])
+        finally:
+            chaos_done.set()
+
+    def promote():
+        try:
+            if args.promote_at > 0:
+                stop.wait(args.duration * args.promote_at)
+                new_version = _commit_new_version(args.ckpt_dir,
+                                                  seed=args.seed + 777)
+                chaos_log["promoted_to"] = new_version
+                chaos_log["promoted_at_s"] = round(
+                    time.monotonic() - t_start, 2)
+                # rolling promotion: every replica's own watcher polls
+                # the shared dir — wait (bounded) until the router's
+                # health view reports the new version fleet-wide
+                deadline = time.monotonic() + 60.0
+                consistent = False
+                while time.monotonic() < deadline:
+                    vs = set(router.versions().values())
+                    if vs == {new_version}:
+                        consistent = True
+                        break
+                    time.sleep(0.25)
+                chaos_log["promotion_consistent"] = consistent
+                chaos_log["final_versions"] = {
+                    str(k): v for k, v in router.versions().items()}
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            chaos_log["promotion_error"] = repr(e)
+        finally:
+            promote_done.set()
+
+    side = [threading.Thread(target=chaos, daemon=True,
+                             name="loadgen-fleet-chaos"),
+            threading.Thread(target=promote, daemon=True,
+                             name="loadgen-fleet-promote")]
+    for t in side:
+        t.start()
+
+    # the X-Request-Id / idempotency-key contract through the router:
+    # an explicit trace id must ride every attempt and echo back
+    probe_trace = None
+    try:
+        _s, _p, probe_meta = router.dispatch(
+            dict(bodies[0]), timeout_ms=args.timeout_ms,
+            trace_id="loadgen-probe-1")
+        probe_trace = probe_meta["trace_id"] if _s == 200 else (
+            f"ERROR: status {_s}")
+        if _s == 200:
+            with stats.lock:
+                stats.submitted += 1
+                stats.answered += 1
+                stats.trace_ids.add(probe_trace)
+    except Exception as e:  # noqa: BLE001 — reported as a failure
+        probe_trace = f"ERROR: {e!r}"
+
+    # mid-load scrape of the ROUTER's /metrics plane (fleet counters +
+    # replica-labeled gauge families + latency summaries)
+    scrape: dict = {}
+
+    def mid_scrape():
+        stop.wait(args.duration * 0.6)
+        from cgnn_tpu.observe.export import parse_prometheus_text
+
+        text = router.registry.prometheus_text()
+        scrape["text_bytes"] = len(text)
+        try:
+            fams = parse_prometheus_text(text)
+            scrape["parse_ok"] = True
+            scrape["missing_families"] = [
+                p for p in ("cgnn_fleet_", "cgnn_replica_")
+                if not any(f.startswith(p) for f in fams)
+            ]
+        except ValueError as e:
+            scrape["parse_ok"] = False
+            scrape["parse_error"] = str(e)
+
+    scraper = threading.Thread(target=mid_scrape, daemon=True,
+                               name="loadgen-fleet-scrape")
+    if not args.no_scrape:
+        scraper.start()
+
+    # run until the duration elapsed AND the chaos legs finished (a
+    # restart's boot may outlast a short duration — the victim must
+    # still get post-restart traffic before the clients stop)
+    while True:
+        elapsed = time.monotonic() - t_start
+        if (elapsed >= args.duration and chaos_done.is_set()
+                and promote_done.is_set()):
+            break
+        time.sleep(0.1)
+    if chaos_log.get("restart_ready"):
+        time.sleep(3.0)  # post-restart grace: let the probed-in victim
+        #                  actually answer some of the closing traffic
+    stop.set()
+    for t in threads:
+        t.join(timeout=args.timeout_ms / 1000.0 + 60.0)
+    for t in side:
+        t.join(timeout=120.0)
+    if scraper.is_alive():
+        scraper.join(timeout=30.0)
+    wall = time.monotonic() - t_start
+    router.stop()
+    router_stats = router.stats()
+    if chaos_log.get("restart_ready"):
+        chaos_log["victim_answered_at_end"] = (
+            replicas[victim].counts["answered"])
+    exit_codes = [p.terminate(timeout_s=60.0) for p in procs]
+
+    lat = np.asarray(stats.latencies) if stats.latencies else np.zeros(1)
+    with stats.lock:
+        rejected_total = sum(stats.rejected.values())
+    lost = (stats.submitted - stats.answered - rejected_total
+            - len(stats.errors))
+    report = {
+        "mode": "fleet",
+        "clients": args.clients,
+        "replicas": n,
+        "duration_s": round(wall, 2),
+        "submitted": stats.submitted,
+        "answered": stats.answered,
+        "rejected": stats.rejected,
+        "dropped": max(lost, 0),
+        "client_errors": stats.errors[:10],
+        "throughput_rps": round(stats.answered / wall, 1),
+        "latency_ms": {
+            "p50": float(np.percentile(lat, 50)),
+            "p95": float(np.percentile(lat, 95)),
+            "p99": float(np.percentile(lat, 99)),
+            "mean": float(lat.mean()),
+        },
+        "param_versions": stats.versions,
+        "devices": {
+            "requested": str(n),
+            "engine": "fleet",
+            "count": n,
+            "responses_by_device": {
+                str(k): v
+                for k, v in sorted(stats.device_responses.items())
+            },
+            "versions_by_device": {
+                str(k): sorted(v)
+                for k, v in sorted(stats.device_versions.items())
+            },
+        },
+        "tracing": {
+            "unique_trace_ids": len(stats.trace_ids),
+            "missing_trace_ids": stats.missing_trace,
+            "flushes_observed": 0,
+            "probe_trace_id": probe_trace,
+        },
+        "fleet": {
+            "chaos": chaos_log,
+            "victim": victim,
+            "replica_faults": args.replica_faults,
+            "faulty_replica": (args.faulty_replica % n
+                               if args.replica_faults else None),
+            "attempts_hist": dict(sorted(
+                fleet_counts["attempts_hist"].items())),
+            "hedged_answers": fleet_counts["hedged_answers"],
+            "retried_answers": fleet_counts["retried_answers"],
+            "replica_exit_codes": exit_codes,
+            "router": router_stats,
+        },
+    }
+    if scrape:
+        report["fleet"]["metrics_scrape"] = scrape
+    return report
+
+
 def _run_http(args) -> dict:
     """Minimal HTTP leg (urllib threads): smoke the wire path."""
     import urllib.request
@@ -855,7 +1284,12 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    report = _run_http(args) if args.http else _run_inproc(args)
+    if args.fleet:
+        report = _run_fleet(args)
+    elif args.http:
+        report = _run_http(args)
+    else:
+        report = _run_inproc(args)
 
     failures = []
     if report.get("dropped"):
@@ -977,6 +1411,119 @@ def main(argv=None) -> int:
                 f"devices {silent} answered no responses under load "
                 f"(distribution broken: {dev['responses_by_device']})"
             )
+    if args.fleet:
+        # ---- the fleet chaos invariants (ISSUE 14), all HARD ----
+        fl = report["fleet"]
+        rc = fl["router"]["counts"]
+        chaos = fl["chaos"]
+        if report["rejected"]:
+            failures.append(
+                f"fleet rejected requests (with {args.fleet} replicas "
+                f"and retries these legs must answer everything): "
+                f"{report['rejected']}"
+            )
+        if rc.get("fleet_exhausted"):
+            failures.append(
+                f"{rc['fleet_exhausted']} requests exhausted every "
+                f"attempt (accepted-then-lost; must be 0)"
+            )
+        if rc.get("fleet_deadline_exceeded"):
+            failures.append(
+                f"{rc['fleet_deadline_exceeded']} requests blew the "
+                f"fleet deadline (must be 0 at smoke load)"
+            )
+        if rc.get("fleet_duplicate_answers"):
+            failures.append(
+                f"{rc['fleet_duplicate_answers']} duplicate answers — "
+                f"the exactly-once invariant is broken"
+            )
+        silent = [i for i in range(args.fleet)
+                  if not report["devices"]["responses_by_device"]
+                  .get(str(i))]
+        if silent:
+            failures.append(
+                f"replicas {silent} answered nothing under load: "
+                f"{report['devices']['responses_by_device']}"
+            )
+        if args.kill_at > 0:
+            if "killed_at_s" not in chaos:
+                failures.append("kill leg requested but never fired")
+            elif not rc.get("fleet_transport_errors"):
+                failures.append(
+                    "kill -9 fired but the router saw no transport "
+                    "errors — the chaos leg did not actually bite"
+                )
+        if args.restart_at > 0:
+            if not chaos.get("restart_ready"):
+                failures.append(
+                    f"restarted replica {fl['victim']} never became "
+                    f"ready again: {chaos}"
+                )
+            else:
+                before = chaos.get("victim_answered_at_restart", 0)
+                after = chaos.get("victim_answered_at_end", 0)
+                if after <= before:
+                    failures.append(
+                        f"restarted replica {fl['victim']} was never "
+                        f"probed back into rotation (answered {before} "
+                        f"-> {after})"
+                    )
+                br = (fl["router"]["replicas"]
+                      .get(str(fl["victim"]), {})
+                      .get("breaker", {}))
+                if br.get("state") != "closed":
+                    failures.append(
+                        f"victim breaker not re-closed after restart: "
+                        f"{br}"
+                    )
+        if args.promote_at > 0:
+            if "promotion_error" in chaos:
+                failures.append(
+                    f"promotion leg failed: {chaos['promotion_error']}")
+            else:
+                if not chaos.get("promotion_consistent"):
+                    failures.append(
+                        f"fleet never converged on the promoted "
+                        f"version: {chaos.get('final_versions')}"
+                    )
+                if len([v for v, c in report["param_versions"].items()
+                        if c > 0]) < 2:
+                    failures.append(
+                        f"rolling promotion should have answered from "
+                        f"BOTH versions mid-roll, saw "
+                        f"{report['param_versions']}"
+                    )
+        if args.expect_retries and not rc.get("fleet_retries"):
+            failures.append(
+                "expected router retries (--expect-retries) but none "
+                "happened"
+            )
+        if args.expect_hedges and not rc.get("fleet_hedges"):
+            failures.append(
+                "expected hedged requests (--expect-hedges) but none "
+                "fired"
+            )
+        codes = fl["replica_exit_codes"]
+        bad_exits = [
+            (i, c) for i, c in enumerate(codes)
+            if c != 0 and not (i == fl["victim"] and args.kill_at > 0
+                               and args.restart_at == 0)
+        ]
+        if bad_exits:
+            failures.append(
+                f"replica drain exits non-zero: {bad_exits} "
+                f"(graceful SIGTERM drain must exit 0)"
+            )
+        scrape_fl = fl.get("metrics_scrape")
+        if scrape_fl is not None:
+            if not scrape_fl.get("parse_ok"):
+                failures.append(
+                    f"router /metrics did not parse: {scrape_fl}")
+            elif scrape_fl.get("missing_families"):
+                failures.append(
+                    f"router /metrics missing families: "
+                    f"{scrape_fl['missing_families']}"
+                )
     # racecheck leg (CGNN_TPU_RACECHECK=1): the runtime lock-discipline
     # report rides the SLO report and fails the run like any other
     # invariant — zero lock-order inversions, zero unguarded shared-field

@@ -76,6 +76,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "DeviceSet layer (the A/B baseline)")
     p.add_argument("--poll-interval", type=float, default=2.0,
                    help="hot-reload checkpoint poll seconds (0 disables)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="bound on the SIGTERM graceful drain: past it "
+                        "the process force-exits non-zero with the "
+                        "unanswered count logged (a wedged flush must "
+                        "not hold shutdown forever)")
     p.add_argument("--calibrate", type=int, default=256,
                    help="synthetic calibration structures for shape planning")
     p.add_argument("--calibration-cache", type=str, default="",
@@ -154,13 +159,16 @@ def main(argv=None) -> int:
             engine=args.engine,
             precision=args.precision,
             watch=args.poll_interval > 0,
+            # warm AFTER the listener binds (below): /healthz answers
+            # ready=False during compilation instead of refusing
+            # connections, so a fleet router can tell warming from dead
+            warm=False,
             poll_interval_s=args.poll_interval or 2.0,
             profile_dir=profile_dir,
         )
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
-    server.start()
 
     # the live plane's two push/pull surfaces beyond HTTP: SIGUSR2 ->
     # bounded on-demand device profile; --live-metrics -> periodic
@@ -184,12 +192,23 @@ def main(argv=None) -> int:
     # and the SERVER featurizes on the pack pool when needed (ISSUE 11)
     httpd = make_http_server(server, host=args.host, port=args.port)
 
-    # SIGTERM/SIGINT -> drain the batcher, stop the listener, exit 0
+    # SIGTERM/SIGINT -> drain the batcher, stop the listener, exit
     # (resilience.preempt signal plumbing; second signal kills)
+    stop = threading.Event()
     handler = server.install_signal_handlers()
-    handler.add_callback(lambda: threading.Thread(
-        target=httpd.shutdown, daemon=True,
-        name="http-shutdown").start())
+    handler.add_callback(stop.set)
+
+    # bind + listen BEFORE warm (ISSUE 14 readiness): /healthz reports
+    # ready=False (503) while the shape set compiles, flipping to 200
+    # the moment warm() finishes — the router's admission signal
+    listener = threading.Thread(target=httpd.serve_forever, daemon=True,
+                                name="http-listener")
+    listener.start()
+    print(f"listening on http://{args.host}:{args.port} "
+          f"(warming {len(server.shape_set)} shapes; "
+          f"/healthz reports ready=false until done)")
+    server.warm(parts["template"])
+    server.start()
 
     shapes = ", ".join(
         f"({s.graph_cap}g/{s.node_cap}n/{s.edge_cap}e)"
@@ -204,11 +223,13 @@ def main(argv=None) -> int:
           + (f", POST /profile -> {profile_dir}" if profile_dir else "")
           + ")")
     try:
-        httpd.serve_forever()
+        while not stop.wait(0.5):
+            pass
     except KeyboardInterrupt:
         server.begin_drain()
+    httpd.shutdown()
     httpd.server_close()
-    clean = server.drain(timeout_s=30.0)
+    clean = server.drain(timeout_s=args.drain_timeout)
     handler.uninstall()
     if live_writer is not None:
         live_writer.stop()
@@ -219,8 +240,22 @@ def main(argv=None) -> int:
               f"p50 {lat['p50']:.1f} ms / p99 {lat['p99']:.1f} ms")
     telemetry.close()
     if not clean:
-        print("drain timed out with requests still queued", file=sys.stderr)
-        return 1
+        # the bounded-drain satellite (ISSUE 14): a wedged flush must
+        # not hold shutdown forever. Log the unanswered count, then
+        # FORCE-exit — a daemon worker blocked in a wedged dispatch can
+        # pin interpreter teardown, and the supervisor (or the chaos
+        # harness) needs this process GONE with a non-zero code.
+        c = stats["counts"]
+        rejected = sum(v for k, v in c.items() if k.startswith("reject_"))
+        unanswered = (c.get("requests", 0) - c.get("responses", 0)
+                      - c.get("cache_hits", 0) - rejected)
+        print(f"drain timed out after {args.drain_timeout:.0f} s: "
+              f"{max(unanswered, 0)} accepted request(s) unanswered, "
+              f"{stats['queue_depth']} still queued; force-exiting 3",
+              file=sys.stderr)
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os._exit(3)
     return 0
 
 

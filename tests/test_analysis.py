@@ -94,11 +94,13 @@ class TestRepoClean:
         rel = {os.path.relpath(t, REPO) for t in targets}
         for expected in (
             "cgnn_tpu/serve/server.py",
+            "cgnn_tpu/fleet/router.py",
             "cgnn_tpu/train/checkpoint.py",
             "cgnn_tpu/data/pipeline.py",
             "scripts/serve_loadgen.py",
             "train.py",
             "serve.py",
+            "fleet.py",
         ):
             assert expected in rel, f"{expected} not in the scan set"
         assert "__graft_entry__.py" not in rel

@@ -1,0 +1,386 @@
+"""Fleet-layer tests (cgnn_tpu.fleet; ISSUE 14).
+
+Everything here is host-side policy — no jax, no sockets: the router
+takes an injectable transport, the breaker an injectable clock, so the
+retry/hedge/ejection/shed behavior is pinned deterministically. The
+live-process legs (kill -9, restart, rolling promotion) run in
+scripts/fleet_smoke.sh against real serve.py replicas.
+
+The load-bearing guarantees, pinned:
+
+- breaker: K consecutive failures eject; cooldown -> ONE half-open
+  trial; trial success (or a ready health probe) re-admits, trial
+  failure re-ejects with a doubled cooldown;
+- router: transport errors and 5xx retry on a DIFFERENT replica
+  (bounded, backoff), 4xx request errors pass through unretried,
+  nothing-admittable sheds 503 with a Retry-After, a slow attempt is
+  hedged and the first success wins;
+- exactly once: every attempt of a request carries the SAME trace id
+  (the idempotency key) and the client gets exactly one answer — a
+  straggler's success is counted as waste, never delivered.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cgnn_tpu.fleet.breaker import CircuitBreaker
+from cgnn_tpu.fleet.replica import FleetTransportError, ReplicaState
+from cgnn_tpu.fleet.router import FleetRouter
+from cgnn_tpu.resilience import faultinject
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------------- breaker
+
+
+class TestCircuitBreaker:
+    def test_ejects_after_k_consecutive_failures(self):
+        clk = FakeClock()
+        b = CircuitBreaker(k=3, cooldown_s=2.0, clock=clk)
+        assert b.state == "closed" and b.would_admit()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"  # streak below K
+        b.record_success()          # success RESETS the streak
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open" and not b.would_admit()
+        assert b.opens == 1
+        assert 0.0 < b.retry_after_s() <= 2.0
+
+    def test_half_open_single_trial_then_close(self):
+        clk = FakeClock()
+        b = CircuitBreaker(k=1, cooldown_s=2.0, clock=clk)
+        b.record_failure()
+        assert b.state == "open" and not b.admit()
+        clk.advance(2.5)
+        assert b.state == "half_open"
+        assert b.admit()            # the ONE trial
+        assert not b.admit()        # concurrent caller refused
+        b.record_success()
+        assert b.state == "closed" and b.admit()
+
+    def test_failed_trial_reopens_with_doubled_cooldown(self):
+        clk = FakeClock()
+        b = CircuitBreaker(k=1, cooldown_s=2.0, max_cooldown_s=30.0,
+                           clock=clk)
+        b.record_failure()
+        clk.advance(2.5)
+        assert b.admit()
+        b.record_failure()          # trial failed
+        assert b.state == "open" and b.opens == 2
+        clk.advance(2.5)            # old cooldown is NOT enough now
+        assert b.state == "open"
+        clk.advance(2.0)            # doubled: 4 s total
+        assert b.state == "half_open"
+
+    def test_probe_readmission_from_half_open_only(self):
+        clk = FakeClock()
+        b = CircuitBreaker(k=1, cooldown_s=2.0, clock=clk)
+        b.record_failure()
+        b.record_probe_success()    # cooldown still running: stays open
+        assert b.state == "open"
+        clk.advance(2.5)
+        b.record_probe_success()    # half-open: the probe re-admits
+        assert b.state == "closed" and b.closes == 1
+
+
+# ----------------------------------------------------- replica scoring
+
+
+def _ready_replica(rid: int, **probe) -> ReplicaState:
+    r = ReplicaState(rid, f"http://127.0.0.1:{9000 + rid}")
+    r.note_probe(ready=True, **probe)
+    return r
+
+
+class TestReplicaState:
+    def test_unprobed_replica_is_not_pickable(self):
+        r = ReplicaState(0, "http://127.0.0.1:9000")
+        assert not r.pickable()
+        r.note_probe(ready=True)
+        assert r.pickable()
+
+    def test_score_prefers_idle_then_fast(self):
+        a = _ready_replica(0, queue_depth=4.0, p99_ms=10.0)
+        b = _ready_replica(1, queue_depth=0.0, p99_ms=10.0)
+        c = _ready_replica(2, queue_depth=0.0, p99_ms=50.0)
+        order = sorted([a, b, c], key=lambda r: r.score())
+        assert [r.rid for r in order] == [1, 2, 0]
+
+    def test_transport_error_marks_unready_and_feeds_breaker(self):
+        r = _ready_replica(0)
+        r.note_sent()
+        r.note_result("transport_errors")
+        assert not r.ready          # faster than the next poll round
+        assert r.breaker.stats()["consecutive_failures"] == 1
+        assert r.inflight == 0
+
+    def test_draining_replica_not_ready(self):
+        r = _ready_replica(0)
+        assert r.ready
+        r.note_probe(ready=True, draining=True)
+        assert not r.ready
+
+
+# -------------------------------------------------------------- router
+
+
+def _ok_payload(version="v1"):
+    return {"param_version": version, "prediction": [0.0],
+            "latency_ms": 1.0}
+
+
+def _router(replicas, transport, **kw):
+    kw.setdefault("backoff_ms", 1.0)
+    kw.setdefault("default_timeout_ms", 10000.0)
+    kw.setdefault("log_fn", lambda *a: None)
+    return FleetRouter(replicas, transport=transport, **kw)
+
+
+class TestFleetRouter:
+    def test_answers_first_try_on_best_replica(self):
+        seen = []
+
+        def transport(replica, body, timeout_s):
+            seen.append((replica.rid, body["trace_id"]))
+            return 200, _ok_payload()
+
+        r0, r1 = _ready_replica(0), _ready_replica(1, queue_depth=9.0)
+        router = _router([r0, r1], transport)
+        status, payload, meta = router.dispatch({"graph": {}})
+        assert status == 200
+        assert meta["attempts"] == 1 and meta["retries"] == 0
+        assert meta["replica"] == 0  # the idle one
+        assert seen[0][0] == 0
+        assert router.counts["fleet_answered"] == 1
+
+    def test_transport_error_retries_on_sibling_exactly_once_answer(self):
+        tried = []
+
+        def transport(replica, body, timeout_s):
+            tried.append((replica.rid, body["trace_id"]))
+            if replica.rid == 0:
+                raise FleetTransportError("connection refused")
+            return 200, _ok_payload()
+
+        r0, r1 = _ready_replica(0), _ready_replica(1)
+        router = _router([r0, r1], transport)
+        status, payload, meta = router.dispatch({"graph": {}},
+                                                trace_id="probe-7")
+        assert status == 200 and meta["replica"] == 1
+        assert meta["attempts"] == 2 and meta["retries"] == 1
+        # the idempotency key: every attempt carried the SAME trace id
+        assert [t for _, t in tried] == ["probe-7", "probe-7"]
+        assert router.counts["fleet_transport_errors"] == 1
+        assert router.counts["fleet_answered"] == 1
+        assert router.counts["fleet_duplicate_answers"] == 0
+        assert not r0.ready  # marked down ahead of the next probe round
+
+    def test_500_retries_and_breaker_counts_it(self):
+        def transport(replica, body, timeout_s):
+            if replica.rid == 0:
+                return 500, {"error": "boom", "reason": "dispatch_failed"}
+            return 200, _ok_payload()
+
+        r0, r1 = _ready_replica(0), _ready_replica(1)
+        router = _router([r0, r1], transport)
+        status, _, meta = router.dispatch({"graph": {}})
+        assert status == 200 and meta["retries"] == 1
+        assert r0.breaker.stats()["consecutive_failures"] == 1
+        assert r0.ready  # a 500 is a failure, not proof of death
+
+    def test_request_errors_pass_through_unretried(self):
+        calls = []
+
+        def transport(replica, body, timeout_s):
+            calls.append(replica.rid)
+            return 400, {"error": "malformed", "reason": "malformed"}
+
+        router = _router([_ready_replica(0), _ready_replica(1)],
+                         transport)
+        status, payload, meta = router.dispatch({"graph": {}})
+        assert status == 400 and payload["reason"] == "malformed"
+        assert len(calls) == 1 and meta["retries"] == 0
+        assert router.counts["fleet_passthrough_rejects"] == 1
+
+    def test_sheds_503_with_retry_after_when_nothing_admittable(self):
+        def transport(replica, body, timeout_s):  # noqa: ARG001
+            raise AssertionError("nothing should be dispatched")
+
+        r0 = ReplicaState(0, "http://127.0.0.1:9000")  # never probed
+        router = _router([r0], transport)
+        status, payload, meta = router.dispatch({"graph": {}})
+        assert status == 503 and payload["reason"] == "no_replicas"
+        assert meta["retry_after_s"] >= 1.0
+        assert router.counts["fleet_shed"] == 1
+
+    def test_repeated_failures_eject_then_shed(self):
+        def transport(replica, body, timeout_s):  # noqa: ARG001
+            return 500, {"error": "boom"}
+
+        reps = [_ready_replica(i, queue_depth=0.0) for i in range(2)]
+        for r in reps:
+            r.breaker.k = 2
+        router = _router(reps, transport, max_attempts=2)
+        s1, p1, _ = router.dispatch({"graph": {}})
+        assert s1 == 502 and p1["reason"] == "upstream_exhausted"
+        s2, _, _ = router.dispatch({"graph": {}})
+        assert s2 == 502
+        # two consecutive failures each: both breakers are now open
+        assert all(r.breaker.state == "open" for r in reps)
+        s3, p3, _ = router.dispatch({"graph": {}})
+        assert s3 == 503 and p3["reason"] == "no_replicas"
+
+    def test_hedge_races_slow_replica_first_success_wins(self):
+        release = threading.Event()
+        seen = []
+
+        def transport(replica, body, timeout_s):
+            seen.append((replica.rid, body["trace_id"]))
+            if replica.rid == 0:
+                release.wait(5.0)  # the slow primary
+                return 200, _ok_payload("v-slow")
+            return 200, _ok_payload("v-fast")
+
+        # rid 0 scores better (idle) so it is picked first
+        r0 = _ready_replica(0, queue_depth=0.0)
+        r1 = _ready_replica(1, queue_depth=1.0)
+        router = _router([r0, r1], transport, hedge_ms=40.0,
+                         max_attempts=3)
+        status, payload, meta = router.dispatch({"graph": {}})
+        assert status == 200
+        assert payload["param_version"] == "v-fast"
+        assert meta["replica"] == 1 and meta["hedges"] == 1
+        assert router.counts["fleet_hedges"] == 1
+        assert router.counts["fleet_hedge_wins"] == 1
+        # same idempotency key on both attempts
+        assert len({t for _, t in seen}) == 1
+        # let the straggler finish: its success is WASTE, never a
+        # second answer
+        release.set()
+        deadline = time.monotonic() + 5.0
+        while (router.counts.get("fleet_hedge_waste", 0) == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert router.counts["fleet_hedge_waste"] == 1
+        assert router.counts["fleet_answered"] == 1
+        assert router.counts["fleet_duplicate_answers"] == 0
+
+    def test_deadline_exceeded_returns_typed_504(self):
+        def transport(replica, body, timeout_s):  # noqa: ARG001
+            time.sleep(0.2)
+            return 200, _ok_payload()
+
+        router = _router([_ready_replica(0)], transport,
+                         default_timeout_ms=50.0, hedge_ms=0.0)
+        status, payload, _ = router.dispatch({"graph": {}})
+        assert status == 504 and payload["reason"] == "timeout"
+        assert router.counts["fleet_deadline_exceeded"] == 1
+
+    def test_probe_readmits_restarted_replica(self):
+        alive = {"up": False}
+
+        def transport(replica, body, timeout_s):  # noqa: ARG001
+            if not alive["up"]:
+                raise FleetTransportError("connection refused")
+            return 200, _ok_payload("v2")
+
+        clk_real = time.monotonic
+        r0 = ReplicaState(0, "http://127.0.0.1:9000",
+                          breaker_k=1, breaker_cooldown_s=0.05,
+                          clock=clk_real)
+        r0.note_probe(ready=True)
+        router = _router([r0], transport, max_attempts=1)
+        s1, _, _ = router.dispatch({"graph": {}})
+        assert s1 == 502  # the dead replica failed its only attempt
+        assert r0.breaker.state == "open" and not r0.ready
+        # ... replica restarts, cooldown passes, a health probe lands
+        alive["up"] = True
+        time.sleep(0.08)
+        r0.note_probe(ready=True, version="v2")
+        assert r0.breaker.state == "closed" and r0.pickable()
+        s2, payload, _ = router.dispatch({"graph": {}})
+        assert s2 == 200 and payload["param_version"] == "v2"
+
+    def test_versions_view_and_registry_families(self):
+        def transport(replica, body, timeout_s):  # noqa: ARG001
+            # answered responses refresh the version view too — return
+            # each replica's own probed version so both paths agree
+            return 200, _ok_payload(f"ckpt-0000000{replica.rid + 1}")
+
+        reps = [_ready_replica(0), _ready_replica(1)]
+        reps[0].note_probe(ready=True, version="ckpt-00000001")
+        reps[1].note_probe(ready=True, version="ckpt-00000002")
+        router = _router(reps, transport)
+        router.dispatch({"graph": {}})
+        assert router.versions() == {0: "ckpt-00000001",
+                                     1: "ckpt-00000002"}
+        from cgnn_tpu.observe.export import parse_prometheus_text
+
+        fams = parse_prometheus_text(router.registry.prometheus_text())
+        assert "cgnn_fleet_requests_total" in fams
+        # per-replica gauges fold into ONE labeled family per metric
+        assert "cgnn_replica_inflight" in fams
+        labels = [s for s, _ in fams["cgnn_replica_inflight"]["samples"]]
+        assert any('replica="0"' in s for s in labels)
+        assert any('replica="1"' in s for s in labels)
+        stats = router.stats()
+        assert stats["counts"]["fleet_answered"] == 1
+        assert set(stats["replicas"]) == {"0", "1"}
+
+
+# --------------------------------------- serve-side fault-plan parsing
+
+
+class TestServeFaultPlan:
+    def test_parse_round_trip(self):
+        p = faultinject.FaultPlan.parse(
+            "dispatch_exc=2;wedge_flush=1:0.5;slow_dispatch=50:3;"
+            "drop_conn=4"
+        )
+        assert p.dispatch_exc == 2
+        assert p.wedge_flush == 1 and p.wedge_secs == 0.5
+        assert p.slow_dispatch_ms == 50.0 and p.slow_every == 3
+        assert p.drop_conn == 4
+        d = p.describe()
+        assert "dispatch exception" in d and "wedge" in d
+        assert "drop every 4th" in d
+
+    def test_dispatch_point_fires_at_exact_ordinal(self):
+        faultinject.set_plan(faultinject.FaultPlan(dispatch_exc=2))
+        try:
+            faultinject.dispatch_point()  # flush 0
+            faultinject.dispatch_point()  # flush 1
+            with pytest.raises(faultinject.InjectedDispatchError):
+                faultinject.dispatch_point()  # flush 2
+            faultinject.dispatch_point()  # later flushes unaffected
+        finally:
+            faultinject.set_plan(None)
+
+    def test_drop_connection_every_nth(self):
+        faultinject.set_plan(faultinject.FaultPlan(drop_conn=3))
+        try:
+            hits = [faultinject.drop_connection() for _ in range(6)]
+            assert hits == [False, False, True, False, False, True]
+        finally:
+            faultinject.set_plan(None)
+
+    def test_hooks_are_noops_without_plan(self):
+        faultinject.set_plan(None)
+        assert not faultinject.drop_connection()
+        faultinject.dispatch_point()  # must not raise or count

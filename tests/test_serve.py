@@ -465,6 +465,59 @@ class TestHotReload:
         assert watcher.swaps == 0
         mgr.close()
 
+    def test_coordinator_failed_restore_retries_next_round(
+            self, model_state, tmp_path):
+        """Under a coordinator a failed restore must NOT be poisoned
+        into ``_skipped``: the peers already swapped past the shared
+        barrier, so a transient failure (fs lag on a blob) must retry
+        next round — else this host serves stale params forever while
+        reporting nothing (the PR-10 review fix, previously unpinned)."""
+        model_cfg, state = model_state
+        mgr = CheckpointManager(str(tmp_path / "ckptc"),
+                                log_fn=lambda m: None)
+        _save_state(mgr, state, model_cfg)
+        v1 = mgr.newest_committed()
+        _save_state(mgr, state, model_cfg, nudge=0.5)
+        v2 = mgr.newest_committed()
+        assert v2 != v1
+        from cgnn_tpu.serve.reload import ParamStore
+
+        store = ParamStore(state, v1)
+        calls = {"n": 0}
+        real_restore = mgr.restore_for_inference
+
+        def flaky_restore(template, name):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise IOError("transient fs lag on a blob")
+            return real_restore(template, name)
+
+        mgr.restore_for_inference = flaky_restore
+        watcher = CheckpointWatcher(
+            mgr, store, state,
+            coordinator=lambda newest: newest,  # every host agrees
+            log_fn=lambda m: None,
+        )
+        assert not watcher.poll_once()
+        assert watcher.skips == 1
+        assert v2 not in watcher._skipped  # NOT remembered as bad
+        # next coordinated round: the retry succeeds and the host
+        # converges with its peers
+        assert watcher.poll_once()
+        assert store.version == v2
+
+        # CONTRAST: the single-host watcher (no coordinator) remembers
+        # the failure and never hot-retries it
+        store2 = ParamStore(state, v1)
+        calls["n"] = 0
+        solo = CheckpointWatcher(mgr, store2, state,
+                                 log_fn=lambda m: None)
+        assert not solo.poll_once()
+        assert v2 in solo._skipped
+        assert not solo.poll_once()  # no retry
+        assert calls["n"] == 1 and store2.version == v1
+        mgr.close()
+
 
 # ----------------------------------------------------- concurrent load
 
@@ -1111,3 +1164,143 @@ class TestPrecisionServing:
         assert server.stats()["recompiles_after_warm"] == 0
         assert server.drain(timeout_s=30.0)
         mgr.close()
+
+
+# ------------------------------- readiness + back-off hints (ISSUE 14)
+
+
+def _http_get(url: str):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return resp.status, _json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, _json.loads(e.read()), dict(e.headers or {})
+
+
+def _http_post(url: str, body: dict):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=_json.dumps(body, allow_nan=False).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            return resp.status, _json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, _json.loads(e.read()), dict(e.headers or {})
+
+
+def _graph_body(g):
+    return {"graph": {
+        "atom_fea": g.atom_fea.tolist(),
+        "edge_fea": g.edge_fea.tolist(),
+        "centers": g.centers.tolist(),
+        "neighbors": g.neighbors.tolist(),
+    }, "timeout_ms": 30000}
+
+
+class TestReadinessAndBackoff:
+    """The ISSUE-14 satellites: /healthz readiness vs liveness and the
+    Retry-After back-off hints on 429/503."""
+
+    def _bind(self, server):
+        import threading as _threading
+
+        from cgnn_tpu.serve.http import make_http_server
+
+        httpd = make_http_server(server, port=0)
+        port = httpd.server_address[1]
+        t = _threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="test-http-listener")
+        t.start()
+        return httpd, f"http://127.0.0.1:{port}"
+
+    def test_healthz_ready_only_after_warm(self, graphs, shape_set,
+                                           model_state):
+        server = _make_server(model_state, shape_set, cache_size=0)
+        httpd, base = self._bind(server)
+        try:
+            # live but NOT ready: the shape set has not compiled
+            status, payload, headers = _http_get(base + "/healthz")
+            assert status == 503
+            assert payload["ok"] and not payload["ready"]
+            assert not payload["warmed"] and not payload["draining"]
+            assert int(headers["Retry-After"]) >= 1
+            # /predict refuses with the same back-off hint: admitting
+            # would eat traffic into cold-compile latency
+            status, payload, headers = _http_post(
+                base + "/predict", _graph_body(graphs[0]))
+            assert status == 503 and payload["reason"] == SHUTDOWN
+            assert "Retry-After" in headers
+            server.warm(graphs[0])
+            server.start()
+            status, payload, _ = _http_get(base + "/healthz")
+            assert status == 200 and payload["ready"] and payload["warmed"]
+            assert payload["param_version"]
+            # draining flips readiness back off (while staying alive)
+            server.begin_drain()
+            status, payload, headers = _http_get(base + "/healthz")
+            assert status == 503
+            assert payload["ok"] and payload["draining"]
+            assert not payload["ready"]
+            assert "Retry-After" in headers
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            server.drain(timeout_s=30.0)
+
+    def test_queue_full_and_draining_carry_retry_after(self, graphs,
+                                                       shape_set,
+                                                       model_state):
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              max_queue=1)
+        server.warm(graphs[0])  # worker NOT started: the queue fills
+        server.submit(graphs[0])
+        httpd, base = self._bind(server)
+        try:
+            status, payload, headers = _http_post(
+                base + "/predict", _graph_body(graphs[1]))
+            assert status == 429 and payload["reason"] == QUEUE_FULL
+            assert int(headers["Retry-After"]) >= 1
+            server.begin_drain()
+            status, payload, headers = _http_post(
+                base + "/predict", _graph_body(graphs[1]))
+            assert status == 503 and payload["reason"] == SHUTDOWN
+            assert int(headers["Retry-After"]) >= 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            server.drain(timeout_s=30.0)
+
+
+# -------------------------------- serve-side fault points (ISSUE 14)
+
+
+class TestServeFaultPoints:
+    def test_dispatch_exception_fails_flush_alone(self, graphs,
+                                                  shape_set,
+                                                  model_state):
+        """The chaos substrate: an injected dispatch exception fails
+        its flush (futures get the typed error) and the server KEEPS
+        serving — the fleet router's retry-on-500 path upstream."""
+        server = _make_server(model_state, shape_set, cache_size=0)
+        server.warm(graphs[0])
+        server.start()
+        faultinject.set_plan(faultinject.FaultPlan(dispatch_exc=0))
+        try:
+            with pytest.raises(faultinject.InjectedDispatchError):
+                server.predict(graphs[0], timeout_ms=30000)
+            # the NEXT flush is healthy: one injected failure must not
+            # wedge or poison the worker
+            res = server.predict(graphs[1], timeout_ms=30000)
+            assert res.prediction is not None
+        finally:
+            faultinject.set_plan(None)
+        assert server.drain(timeout_s=30.0)
