@@ -59,6 +59,13 @@ class CircuitBreaker:
         self._trial_inflight = False
         self.opens = 0              # lifetime trips (telemetry)
         self.closes = 0
+        self.name = name
+        # incident hook (ISSUE 15): called AFTER a closed->open /
+        # half_open->open transition, outside the lock (the flight
+        # recorder's trigger spawns a dump — IO must never run under a
+        # breaker lock the request path contends on; GC-BLOCKING).
+        # Assigned post-construction by whoever owns the recorder.
+        self.on_trip: Callable | None = None
 
     # ---- observation ----
 
@@ -137,6 +144,7 @@ class CircuitBreaker:
                 self.closes += 1
 
     def record_failure(self) -> None:
+        tripped = False
         with self._lock:
             now = self._clock()
             s = self._state_locked(now)
@@ -149,12 +157,19 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = now
                 self.opens += 1
+                tripped = True
             elif s == CLOSED and self._failures >= self.k:
                 self._state = OPEN
                 self._opened_at = now
                 self.opens += 1
+                tripped = True
             # already OPEN: stragglers from in-flight attempts land here;
             # they neither extend nor restart the cooldown
+        if tripped and self.on_trip is not None:
+            try:
+                self.on_trip(self)
+            except Exception:  # noqa: BLE001 — an incident hook must
+                pass           # never fail the request path it rides
 
     def stats(self) -> dict:
         with self._lock:

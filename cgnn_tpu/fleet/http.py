@@ -75,8 +75,48 @@ def make_fleet_handler(router: FleetRouter):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.split("?", 1)[0] in ("/trace", "/trace/joined"):
+                self._do_trace()
+            elif self.path == "/flightrec":
+                if router.flightrec is None:
+                    self._reply(501, {
+                        "error": "flight recorder not configured "
+                                 "(fleet.py --flightrec-dir)",
+                    })
+                else:
+                    self._reply(200, router.flightrec.snapshot())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _do_trace(self) -> None:
+            """`/trace` = the router's own span window; `/trace/joined`
+            = the on-demand fleet join (ISSUE 15): pull every replica's
+            `/trace` window, merge with the router's, and return ONE
+            Perfetto-openable document — a hedged request renders as
+            one tree with both attempts. `?since=<unix-s>` bounds both
+            forms to recent history."""
+            from cgnn_tpu.observe import trace_join
+
+            since, err = trace_join.parse_since_query(self.path)
+            if err:
+                self._reply(400, {"error": err})
+                return
+            window = router.trace_window(since_s=since)
+            if window is None:
+                self._reply(501, {
+                    "error": "span ring disabled (fleet.py "
+                             "--trace-ring 0)",
+                })
+                return
+            if self.path.split("?", 1)[0] == "/trace":
+                self._reply(200, window)
+                return
+            windows, errors = trace_join.collect_windows(
+                router.replica_trace_urls(), since_s=since)
+            doc = trace_join.join_windows([window, *windows])
+            if errors:
+                doc["collect_errors"] = errors
+            self._reply(200, doc)
 
         def do_POST(self):  # noqa: N802
             if self.path != "/predict":

@@ -42,10 +42,15 @@ def http_transport(replica: "ReplicaState", body: dict,
     HTTP error statuses are RETURNED (the payload carries the replica's
     typed rejection reason); only wire-level failures raise."""
     data = json.dumps(body, allow_nan=False).encode()
+    headers = {"Content-Type": "application/json",
+               "X-Request-Id": str(body.get("trace_id", ""))}
+    if body.get("trace_parent"):
+        # cross-process span nesting (ISSUE 15): the router's attempt
+        # span id rides to the replica, whose serve.request span
+        # records it as its parent — the joined-trace tree edge
+        headers["X-Trace-Parent"] = str(body["trace_parent"])
     req = urllib.request.Request(
-        replica.base_url + "/predict", data=data,
-        headers={"Content-Type": "application/json",
-                 "X-Request-Id": str(body.get("trace_id", ""))},
+        replica.base_url + "/predict", data=data, headers=headers,
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:

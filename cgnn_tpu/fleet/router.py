@@ -52,6 +52,9 @@ from cgnn_tpu.fleet.replica import (
     http_transport,
 )
 from cgnn_tpu.observe.export import MetricsRegistry, RollingSeries
+from cgnn_tpu.observe.log import bind_trace
+from cgnn_tpu.observe.spans import SpanTracer
+from cgnn_tpu.observe.tracectx import format_parent, mint_span_id
 
 # upstream statuses worth another replica (the replica is loaded,
 # draining, or failed — a sibling may well answer)
@@ -64,10 +67,13 @@ PASSTHROUGH_STATUS = frozenset((400, 404, 413, 501, 504))
 class _Call:
     """Per-request coordination: the shared trace id and the delivered
     latch attempt threads consult before posting (a straggler success
-    after delivery is wasted compute, counted, never a second answer)."""
+    after delivery is wasted compute, counted, never a second answer).
+    ``span_id`` is the request's ROOT span in the router's trace ring —
+    every attempt span parents to it (observe/tracectx.py)."""
 
-    def __init__(self, tid: str):
+    def __init__(self, tid: str, span_id: str = ""):
         self.tid = tid
+        self.span_id = span_id
         self.done = threading.Event()
 
 
@@ -85,6 +91,7 @@ class FleetRouter:
         hedge_ms: float | None = None,
         default_timeout_ms: float = 30000.0,
         health_interval_s: float = 1.0,
+        trace_ring: int = 65536,
         clock: Callable[[], float] = time.monotonic,
         rng: random.Random | None = None,
         log_fn: Callable = print,
@@ -128,6 +135,17 @@ class FleetRouter:
         self._lat_rolling = RollingSeries(window_s=60.0, clock=clock)
         self.registry = MetricsRegistry(window_s=60.0)
         self.registry.add_provider("fleet", self._registry_snapshot)
+        # the router's own span ring (ISSUE 15): one fleet.request root
+        # per dispatch, one fleet.attempt per try/hedge — the spans a
+        # joined fleet trace nests every replica's stage spans under.
+        # Bounded, always-on by default, host-side only; 0 disables
+        # (the propagation/recorder A/B baseline, PERF.md §18)
+        self.tracer = (SpanTracer(
+            process_name=f"fleet-router-{os.getpid()}",
+            max_events=int(trace_ring)) if trace_ring else None)
+        # incident flight recorder (observe/flightrec.py), attached by
+        # the entrypoint; breaker trips + 5xx bursts dump bundles
+        self.flightrec = None
 
     # ---- lifecycle ----
 
@@ -155,13 +173,27 @@ class FleetRouter:
             self.probe_all()
 
     def probe_all(self, timeout_s: float = 2.0) -> int:
-        """Probe every replica once; returns how many are ready."""
+        """Probe every replica once; returns how many are ready.
+
+        A reachable->unreachable TRANSITION (the wire died: kill -9, a
+        machine loss — not a draining/warming 503, which still answers
+        the probe) fires the flight recorder: the next poll round after
+        a replica vanishes is the deterministic moment to bundle the
+        fleet's last minutes, whether or not enough in-flight requests
+        happened to trip its breaker first."""
         ready = 0
         for r in self.replicas:
+            was_reachable = r.stats()["probe_ok"]
             try:
                 ready += bool(r.probe(timeout_s))
             except Exception as e:  # noqa: BLE001 — the poller must survive
                 self._log(f"fleet: health probe {r.name} failed: {e!r}")
+            fr = self.flightrec
+            if (fr is not None and was_reachable
+                    and not r.stats()["probe_ok"]):
+                fr.trigger("replica_unreachable",
+                           f"{r.name} ({r.base_url}) stopped answering "
+                           f"health probes")
         return ready
 
     # ---- dispatch ----
@@ -215,14 +247,24 @@ class FleetRouter:
     def _launch(self, replica: ReplicaState, body: dict, timeout_s: float,
                 q: queue.Queue, call: _Call, attempt_no: int) -> None:
         replica.note_sent()
+        span_id = ""
+        if self.tracer is not None:
+            # per-attempt span id, propagated as X-Trace-Parent so the
+            # replica's serve.request span nests under THIS attempt in
+            # the joined trace (a hedge's two attempts are two distinct
+            # parents — both subtrees render, winner and straggler)
+            span_id = mint_span_id("att")
+            body = dict(body)
+            body["trace_parent"] = format_parent(call.tid, span_id)
         threading.Thread(
             target=self._attempt,
-            args=(replica, body, timeout_s, q, call),
+            args=(replica, body, timeout_s, q, call, span_id, attempt_no),
             daemon=True, name=f"fleet-try-{call.tid[-10:]}-{attempt_no}",
         ).start()
 
     def _attempt(self, replica: ReplicaState, body: dict, timeout_s: float,
-                 q: queue.Queue, call: _Call) -> None:
+                 q: queue.Queue, call: _Call, span_id: str = "",
+                 attempt_no: int = 0) -> None:
         t0 = time.perf_counter()
         err: BaseException | None = None
         status, payload = 0, None
@@ -248,7 +290,19 @@ class FleetRouter:
             outcome = "rejections"
         replica.note_result(outcome, lat_ms if status == 200 else None,
                             version=version)
-        if call.done.is_set():
+        straggler = call.done.is_set()
+        if self.tracer is not None:
+            # one span per attempt, win or lose: the joined trace shows
+            # BOTH sides of a hedge (t0 is perf_counter — the
+            # SpanTracer.now_s clock — so this lines up with the
+            # replica-side stage spans)
+            self.tracer.complete(
+                "fleet.attempt", t0, time.perf_counter(),
+                trace_id=call.tid, span_id=span_id,
+                parent=call.span_id, replica=replica.rid,
+                attempt=attempt_no, outcome=outcome,
+                status=int(status), straggler=straggler)
+        if straggler:
             # the request was already answered by another attempt: this
             # result is wasted compute, NEVER a second answer
             if outcome == "answered":
@@ -261,9 +315,48 @@ class FleetRouter:
         """Route one request; -> (status, payload, meta).
 
         ``meta``: replica (the answering rid, or -1), attempts,
-        retries, hedges, latency_ms, trace_id, retry_after_s (shed
-        only). The payload of a 200 is the replica's own response
-        (param_version, prediction, stamps, ...) untouched."""
+        retries, hedges, latency_ms, trace_id, span_id (the root span
+        in the router's trace ring; "" with the ring off),
+        retry_after_s (shed only). The payload of a 200 is the
+        replica's own response (param_version, prediction, stamps, ...)
+        untouched.
+
+        This wrapper is the observability boundary (ISSUE 15): it
+        mints the trace id, binds it as the logging context, emits the
+        ``fleet.request`` root span, and feeds the flight recorder —
+        the policy engine underneath (``_dispatch_inner``) is unchanged
+        and its served bytes identical with the layer on or off."""
+        tid = self._mint(trace_id)
+        t0 = time.perf_counter()
+        with bind_trace(tid):
+            status, payload, meta = self._dispatch_inner(
+                body, timeout_ms=timeout_ms, trace_id=tid)
+        if self.tracer is not None:
+            self.tracer.complete(
+                "fleet.request", t0, time.perf_counter(),
+                trace_id=meta["trace_id"], span_id=meta["span_id"],
+                status=int(status), replica=meta["replica"],
+                attempts=meta["attempts"], retries=meta["retries"],
+                hedges=meta["hedges"])
+        fr = self.flightrec
+        if fr is not None:
+            fr.note_request({
+                "trace_id": meta["trace_id"], "status": int(status),
+                "replica": meta["replica"],
+                "attempts": meta["attempts"],
+                "retries": meta["retries"], "hedges": meta["hedges"],
+                "latency_ms": meta["latency_ms"],
+                "param_version": (payload or {}).get(
+                    "param_version", ""),
+                "reason": (payload or {}).get("reason", ""),
+            })
+            fr.note_status(int(status))
+        return status, payload, meta
+
+    def _dispatch_inner(self, body: dict, *,
+                        timeout_ms: float | None = None,
+                        trace_id: str | None = None
+                        ) -> tuple[int, dict, dict]:
         timeout_ms = (self.default_timeout_ms if timeout_ms is None
                       else float(timeout_ms))
         t_start = self._clock()
@@ -275,7 +368,8 @@ class FleetRouter:
         body = dict(body)
         body["trace_id"] = tid
         body.setdefault("timeout_ms", timeout_ms)
-        call = _Call(tid)
+        call = _Call(tid, mint_span_id("req")
+                     if self.tracer is not None else "")
         results: queue.Queue = queue.Queue()
         self._count("fleet_requests")
         live: dict[int, float] = {}  # rid -> launch time (hedge timer)
@@ -290,6 +384,7 @@ class FleetRouter:
             return {
                 "replica": replica_id, "attempts": launched,
                 "retries": retries, "hedges": hedges, "trace_id": tid,
+                "span_id": call.span_id,
                 "latency_ms": (self._clock() - t_start) * 1e3, **extra,
             }
 
@@ -411,6 +506,37 @@ class FleetRouter:
 
     # ---- observation ----
 
+    def trace_window(self, since_s: float | None = None) -> dict | None:
+        """The router's span ring as a joinable `/trace` window
+        (observe/trace_join.py); None with the ring off."""
+        if self.tracer is None:
+            return None
+        w = self.tracer.window(since_s=since_s)
+        w["role"] = "router"
+        return w
+
+    def replica_trace_urls(self) -> list:
+        """The fleet's `/trace`-capable endpoints (every replica's base
+        url) — what a joined trace or incident bundle pulls."""
+        return [r.base_url for r in self.replicas]
+
+    def attach_flight_recorder(self, recorder) -> None:
+        """Wire an observe.flightrec.FlightRecorder: every dispatch
+        outcome lands in its ring, statuses feed the 5xx burst trigger,
+        and every replica breaker's trip fires an incident dump — the
+        bundle then holds the joined fleet trace of the minutes that
+        led to the ejection."""
+        self.flightrec = recorder
+        for r in self.replicas:
+            r.breaker.on_trip = self._on_breaker_trip
+
+    def _on_breaker_trip(self, breaker) -> None:
+        fr = self.flightrec
+        if fr is not None:
+            fr.trigger("breaker_trip",
+                       f"{breaker.name}: open after "
+                       f"{breaker.k} consecutive failures")
+
     def versions(self) -> dict:
         """param_version per replica (the rolling-promotion view)."""
         return {r.rid: r.version for r in self.replicas}
@@ -444,7 +570,16 @@ class FleetRouter:
             "fleet_replicas_ready": float(self.ready_count()),
             "fleet_replicas_admittable": float(
                 sum(1 for r in self.replicas if r.pickable())),
+            "fleet_trace_ring": float(self.tracer is not None),
         }
+        if self.tracer is not None:
+            gauges["fleet_trace_dropped"] = float(self.tracer.dropped)
+        fr = self.flightrec
+        if fr is not None:
+            frs = fr.stats()
+            gauges["fleet_flightrec_bundles"] = float(frs["bundles"])
+            gauges["fleet_flightrec_suppressed"] = float(
+                frs["suppressed"])
         series = {}
         q = self._lat_rolling.quantiles()
         if q:

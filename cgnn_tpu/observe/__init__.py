@@ -31,6 +31,7 @@ from cgnn_tpu.observe.export import (
     RollingSeries,
     parse_prometheus_text,
 )
+from cgnn_tpu.observe.flightrec import FlightRecorder
 from cgnn_tpu.observe.gauges import (
     device_hbm_table_bytes,
     hbm_gauges,
@@ -44,12 +45,26 @@ from cgnn_tpu.observe.metrics_io import (
     profile_trace,
     read_jsonl,
 )
+from cgnn_tpu.observe.log import (
+    bind_trace,
+    current_trace_id,
+    json_log_fn,
+    setup_json_logging,
+)
 from cgnn_tpu.observe.profile import ProfileBusy, ProfileCapture, install_sigusr2
 from cgnn_tpu.observe.spans import SpanTracer
 from cgnn_tpu.observe.stream import StepStream
 from cgnn_tpu.observe.telemetry import Telemetry
+from cgnn_tpu.observe.tracectx import (
+    TRACE_PARENT_HEADER,
+    format_parent,
+    mint_span_id,
+    parse_parent,
+)
 
 __all__ = [
+    "FlightRecorder",
+    "TRACE_PARENT_HEADER",
     "LiveMetricsWriter",
     "MetricsLogger",
     "MetricsRegistry",
@@ -59,8 +74,15 @@ __all__ = [
     "SpanTracer",
     "StepStream",
     "Telemetry",
+    "bind_trace",
+    "current_trace_id",
+    "format_parent",
     "install_sigusr2",
+    "json_log_fn",
+    "mint_span_id",
+    "parse_parent",
     "parse_prometheus_text",
+    "setup_json_logging",
     "device_hbm_table_bytes",
     "enable_debug_nans",
     "hbm_gauges",

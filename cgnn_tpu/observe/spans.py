@@ -41,6 +41,11 @@ class SpanTracer:
         import collections
 
         self._t0 = time.perf_counter()
+        # the wall-clock epoch of _t0: how a fleet joiner rebases this
+        # process's relative-µs timestamps onto a timeline SHARED with
+        # other processes' rings (observe/trace_join.py). Sampled at
+        # the same instant as _t0, so abs(event) = t0_unix + ts/1e6.
+        self.t0_unix = time.time()
         self._events: collections.deque = collections.deque(
             maxlen=int(max_events))
         self._lock = threading.Lock()
@@ -129,6 +134,37 @@ class SpanTracer:
     def events(self) -> list[dict]:
         with self._lock:
             return list(self._events)
+
+    def window(self, since_s: float | None = None) -> dict:
+        """The ring as one self-describing dict — what ``GET /trace``
+        serves (the fleet-join wire format, observe/trace_join.py).
+
+        Carries everything a joiner needs to NOT silently render a
+        partial tree: ``dropped`` (ring evictions so far) plus the
+        retained window's bounds (``begin_us``/``end_us``, relative µs
+        like the event timestamps) — a chain whose root predates
+        ``begin_us`` is provably incomplete, not merely sparse.
+        ``since_s`` (unix seconds) filters to events ending at or after
+        that wall-clock instant (incremental pulls)."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped
+        end_us = self._now_us()
+        begin_us = events[0]["ts"] if events else end_us
+        if since_s is not None:
+            cut_us = (float(since_s) - self.t0_unix) * 1e6
+            events = [e for e in events
+                      if e["ts"] + e.get("dur", 0.0) >= cut_us]
+        return {
+            "process": self._process_name,
+            "pid": os.getpid(),
+            "t0_unix": self.t0_unix,
+            "dropped": dropped,
+            "max_events": self.max_events,
+            "begin_us": begin_us,
+            "end_us": end_us,
+            "events": events,
+        }
 
     def export(self, path: str) -> str:
         """Write the Chrome trace JSON; returns the path."""

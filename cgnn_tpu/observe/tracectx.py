@@ -1,0 +1,64 @@
+"""Cross-process trace context: span ids and the ``X-Trace-Parent`` wire.
+
+The PR-6/PR-12 plane already shares ONE id across a request's attempts
+(the trace id / idempotency key), but a joined fleet trace needs more:
+each hop must know which upstream span it hangs under, or a hedged
+request's two attempts render as four unrelated rows instead of one
+tree. This module is the whole contract, deliberately tiny:
+
+- a **span id** names one span instance within one process
+  (``mint_span_id``: process-unique prefix + counter — cheap enough for
+  the per-attempt hot path, no randomness per call);
+- a **trace parent** is the pair ``"<trace_id>/<span_id>"`` carried to
+  the next process as the ``X-Trace-Parent`` header (and the
+  ``trace_parent`` body field for transports that cannot set headers).
+  The receiver adopts the trace id and records ``parent=<span_id>`` on
+  its own root span, which is all the joiner (observe/trace_join.py)
+  needs to nest the replica's stage spans under the router's attempt.
+
+Host-side bookkeeping only: nothing here touches jax, and a process
+that never parses the header simply roots its own spans (the joiner
+renders them as an orphan tree rather than guessing).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+
+TRACE_PARENT_HEADER = "X-Trace-Parent"
+
+# process-unique span-id prefix + a lock-free counter: ids must be
+# distinct across the processes whose rings one joiner merges, and the
+# pid alone recycles — fold in 2 random bytes minted once per process
+_SPAN_PREFIX = f"{os.getpid():x}-{os.urandom(2).hex()}"
+_SPAN_SEQ = itertools.count(1)
+_SEQ_LOCK = threading.Lock()
+
+
+def mint_span_id(kind: str = "span") -> str:
+    """A process-unique span id, e.g. ``att-1f03-9a2c-000007``."""
+    with _SEQ_LOCK:
+        n = next(_SPAN_SEQ)
+    return f"{kind}-{_SPAN_PREFIX}-{n:06x}"
+
+
+def format_parent(trace_id: str, span_id: str) -> str:
+    """The ``X-Trace-Parent`` header value for a downstream hop."""
+    return f"{trace_id}/{span_id}"
+
+
+def parse_parent(value: str | None) -> tuple[str, str]:
+    """Header/body value -> ``(trace_id, parent_span_id)``; a missing
+    or malformed value parses to ``("", "")`` — the receiver then roots
+    its own spans instead of inventing a parent."""
+    if not value or not isinstance(value, str):
+        return "", ""
+    value = value.strip()
+    # the span id never contains '/', so split from the RIGHT: trace
+    # ids are client-controlled (X-Request-Id) and may contain '/'
+    trace_id, sep, span_id = value.rpartition("/")
+    if not sep or not trace_id or not span_id:
+        return "", ""
+    return trace_id[:128], span_id[:128]

@@ -132,6 +132,11 @@ class DivergenceMonitor:
         self.post_restore = post_restore
         self._log = log_fn
         self._base_tx = None
+        # incident hook (ISSUE 15): called with a reason string after
+        # every rollback — wire a FlightRecorder.trigger here and the
+        # postmortem bundle (metrics window + recent telemetry) dumps
+        # at the exact epoch training went off the rails
+        self.on_rollback: Callable | None = None
 
     def _is_bad(self, train_m: dict) -> tuple[bool, str]:
         loss = train_m.get("loss", float("nan"))
@@ -205,4 +210,9 @@ class DivergenceMonitor:
             f"{self.lr_scale:g} (rollback {self.rollbacks}/"
             f"{self.max_rollbacks})"
         )
+        if self.on_rollback is not None:
+            try:
+                self.on_rollback(f"epoch {epoch}: {why}")
+            except Exception:  # noqa: BLE001 — an incident hook must
+                pass           # never break the recovery it records
         return restored, True

@@ -111,6 +111,11 @@ class Request:
     # replied — the live-observability request journey
     trace_id: str = ""
     stamps: dict = dataclasses.field(default_factory=dict)
+    # inbound cross-process span parent (observe/tracectx.py): the
+    # upstream attempt span this request's serve.request span nests
+    # under in a joined fleet trace; "" when the request arrived with
+    # no X-Trace-Parent (this process roots its own tree)
+    trace_parent: str = ""
     # precision tier (serve/quantize.py TIERS), validated at admission
     # against the server's warmed set: a flush runs ONE program, so
     # co-batched requests must share a tier — the batcher cuts a flush

@@ -53,7 +53,9 @@ import numpy as np
 
 from cgnn_tpu.data.graph import CrystalGraph
 from cgnn_tpu.data.rawbatch import RawStructure
+from cgnn_tpu.observe.log import bind_trace
 from cgnn_tpu.observe.metrics_io import jsonfinite
+from cgnn_tpu.observe.tracectx import TRACE_PARENT_HEADER, parse_parent
 from cgnn_tpu.resilience import faultinject
 from cgnn_tpu.serve.batcher import (
     MALFORMED,
@@ -209,8 +211,42 @@ def make_handler(server: InferenceServer):
                     200, server.registry.prometheus_text(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif self.path.split("?", 1)[0] == "/trace":
+                # the fleet-join surface (ISSUE 15): this process's
+                # bounded span ring as a self-describing window —
+                # dropped count + retained bounds included, so the
+                # joiner can mark truncation instead of rendering a
+                # silently partial tree. ?since=<unix-s> for
+                # incremental pulls.
+                self._do_trace()
+            elif self.path == "/flightrec":
+                # what a PEER's incident dump pulls: the recent-request
+                # ring + live metrics snapshot (observe/flightrec.py)
+                if server.flightrec is None:
+                    self._reply(501, {
+                        "error": "flight recorder not configured "
+                                 "(serve.py --flightrec-dir)",
+                    })
+                else:
+                    self._reply(200, server.flightrec.snapshot())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _do_trace(self) -> None:
+            from cgnn_tpu.observe.trace_join import parse_since_query
+
+            since, err = parse_since_query(self.path)
+            if err:
+                self._reply(400, {"error": err})
+                return
+            window = server.trace_window(since_s=since)
+            if window is None:
+                self._reply(501, {
+                    "error": "span ring disabled "
+                             "(serve.py --trace-ring 0)",
+                })
+            else:
+                self._reply(200, window)
 
         def _do_profile(self, payload: dict) -> None:
             from cgnn_tpu.observe.profile import ProfileBusy
@@ -258,12 +294,20 @@ def make_handler(server: InferenceServer):
             if self.path != "/predict":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
+
+            def _preply(status: int, body_payload: dict,
+                        headers: dict | None = None) -> None:
+                # every /predict status feeds the flight recorder's
+                # 5xx burst trigger (a no-op without a recorder)
+                server.note_http_status(status)
+                self._reply(status, body_payload, headers=headers)
+
             if not server.warmed:
                 # readiness guard: admitting now would either queue the
                 # request behind the whole warmup or trace a cold
                 # program — both break the latency contract /healthz
                 # readiness promises the router
-                self._reply(503, {
+                _preply(503, {
                     "error": "server is warming (shape set compiling)",
                     "reason": SHUTDOWN,
                 }, headers={"Retry-After": str(_RETRY_AFTER_S[SHUTDOWN])})
@@ -283,38 +327,54 @@ def make_handler(server: InferenceServer):
                         "or 'structure' (positions/lattice/numbers)"
                     )
             except ValueError as e:
-                self._reply(400, {"error": str(e)})
+                _preply(400, {"error": str(e)})
                 return
             timeout_ms = payload.get("timeout_ms")
             # per-request tracing: an inbound X-Request-Id (or a body
-            # trace_id) becomes the trace id minted at admission
+            # trace_id) becomes the trace id minted at admission; an
+            # inbound X-Trace-Parent (or body trace_parent) names the
+            # upstream span — the router's attempt — this request's
+            # serve.request span nests under in a joined fleet trace
             trace_id = (self.headers.get("X-Request-Id")
                         or payload.get("trace_id"))
-            try:
-                result = server.predict(
-                    graph, timeout_ms=timeout_ms, trace_id=trace_id,
-                    precision=payload.get("precision"),
-                )
-            except ServeRejection as e:
-                headers = None
-                if e.reason in _RETRY_AFTER_S:
-                    headers = {"Retry-After": str(_RETRY_AFTER_S[e.reason])}
-                self._reply(_REJECT_STATUS.get(e.reason, 500), {
-                    "error": str(e), "reason": e.reason,
-                }, headers=headers)
-                return
-            except TimeoutError:
-                self._reply(504, {"error": "result wait timed out",
+            _, trace_parent = parse_parent(
+                self.headers.get(TRACE_PARENT_HEADER)
+                or payload.get("trace_parent"))
+            # bind the inbound trace id as this handler thread's log
+            # context: under a fleet, EVERY replica request carries the
+            # router's X-Request-Id, so --log-json lines emitted while
+            # this thread works (rejection logs, reload messages on
+            # this thread) grep by trace id. Worker-thread logs (e.g.
+            # a flush failure) are outside this scope by construction.
+            with bind_trace(trace_id or ""):
+                try:
+                    result = server.predict(
+                        graph, timeout_ms=timeout_ms, trace_id=trace_id,
+                        precision=payload.get("precision"),
+                        trace_parent=trace_parent,
+                    )
+                except ServeRejection as e:
+                    headers = None
+                    if e.reason in _RETRY_AFTER_S:
+                        headers = {
+                            "Retry-After": str(_RETRY_AFTER_S[e.reason])}
+                    _preply(_REJECT_STATUS.get(e.reason, 500), {
+                        "error": str(e), "reason": e.reason,
+                    }, headers=headers)
+                    return
+                except TimeoutError:
+                    _preply(504, {"error": "result wait timed out",
                                   "reason": TIMEOUT})
-                return
-            except Exception as e:  # noqa: BLE001 — a failed flush must
-                # surface as a TYPED 500, not a closed socket: the fleet
-                # router retries it on a sibling replica (the
-                # dispatch-exception chaos leg drives exactly this path)
-                self._reply(500, {"error": repr(e),
+                    return
+                except Exception as e:  # noqa: BLE001 — a failed flush
+                    # must surface as a TYPED 500, not a closed socket:
+                    # the fleet router retries it on a sibling replica
+                    # (the dispatch-exception chaos leg drives exactly
+                    # this path)
+                    _preply(500, {"error": repr(e),
                                   "reason": "dispatch_failed"})
-                return
-            self._reply(200, {
+                    return
+            _preply(200, {
                 "prediction": result.prediction.tolist(),
                 "param_version": result.param_version,
                 "latency_ms": result.latency_ms,

@@ -159,6 +159,7 @@ class InferenceServer:
         model=None,
         featurizer: Callable | None = None,
         raw_precheck: bool = True,
+        trace_ring: int = 65536,
         clock: Callable[[], float] = time.monotonic,
         log_fn: Callable = print,
     ):
@@ -307,9 +308,26 @@ class InferenceServer:
         self._feature_dims: tuple[int, int] | None = None
         # ---- live observability plane ----
         # trace ids are ALWAYS minted (cheap: prefix + counter); span
-        # emission additionally needs telemetry.spans (plane on)
+        # emission additionally needs telemetry.spans (plane on) OR the
+        # always-on serving span ring below
         self._trace_prefix = os.urandom(3).hex()
         self._trace_seq = itertools.count(1)
+        # the cross-process trace ring (ISSUE 15): a bounded SpanTracer
+        # that serving spans land in REGARDLESS of telemetry level, so
+        # `GET /trace` and the flight recorder can join this process
+        # into a fleet trace mid-incident. Host-side ring appends only
+        # (predictions bit-exact either way); 0 disables (the A/B
+        # baseline, PERF.md §18)
+        from cgnn_tpu.observe.spans import SpanTracer
+
+        self.tracer = (SpanTracer(
+            process_name=f"serve-{os.getpid()}",
+            max_events=int(trace_ring)) if trace_ring else None)
+        self._spans_on = (self.telemetry.spans is not None
+                          or self.tracer is not None)
+        # incident flight recorder (observe/flightrec.py), attached by
+        # the entrypoint — None keeps every hook below a no-op
+        self.flightrec = None
         from cgnn_tpu.observe.export import MetricsRegistry, RollingSeries
 
         # rolling (time-windowed) twins of the run-lifetime SLO series:
@@ -448,10 +466,45 @@ class InferenceServer:
 
     def _span(self, name: str, start_s: float, end_s: float,
               **args) -> None:
-        """Emit one retro-stamped hop span when the plane is on."""
+        """Emit one retro-stamped hop span to every live sink: the
+        telemetry tracer (trace.json at close) and/or the always-on
+        serving ring (`GET /trace` + flight-recorder bundles)."""
         spans = self.telemetry.spans
         if spans is not None:
             spans.complete(name, start_s, end_s, **args)
+        if self.tracer is not None:
+            self.tracer.complete(name, start_s, end_s, **args)
+
+    def _note_request(self, **record) -> None:
+        """Feed the flight recorder's recent-request ring (no-op until
+        one is attached; one lock + deque append when it is)."""
+        fr = self.flightrec
+        if fr is not None:
+            fr.note_request(record)
+
+    def note_http_status(self, status: int) -> None:
+        """HTTP front-end hook: response statuses feed the recorder's
+        5xx burst trigger."""
+        fr = self.flightrec
+        if fr is not None:
+            fr.note_status(int(status))
+
+    def attach_flight_recorder(self, recorder) -> None:
+        """Wire an observe.flightrec.FlightRecorder into the serving
+        path: every finished request lands in its ring, HTTP statuses
+        feed its burst trigger (serve/http.py calls note_http_status)."""
+        self.flightrec = recorder
+
+    def trace_window(self, since_s: float | None = None) -> dict | None:
+        """The `GET /trace` body: this process's span ring as a
+        joinable window (observe/trace_join.py), or None when neither
+        the serving ring nor telemetry spans exist."""
+        tracer = self.tracer or self.telemetry.spans
+        if tracer is None:
+            return None
+        w = tracer.window(since_s=since_s)
+        w["role"] = "replica"
+        return w
 
     def enable_profiling(self, out_dir: str, *,
                          default_duration_s: float = 1.0,
@@ -506,6 +559,15 @@ class InferenceServer:
         }
         for rung, occ in sorted(rung_occ.items()):
             gauges[f"ingest_rung{rung}_edge_occupancy"] = float(occ)
+        # the cross-process observability layer's own health (ISSUE 15)
+        gauges["observe_trace_ring"] = float(self.tracer is not None)
+        if self.tracer is not None:
+            gauges["observe_trace_dropped"] = float(self.tracer.dropped)
+        fr = self.flightrec
+        if fr is not None:
+            frs = fr.stats()
+            gauges["flightrec_bundles"] = float(frs["bundles"])
+            gauges["flightrec_suppressed"] = float(frs["suppressed"])
         for i, depth in enumerate(self.device_set.inflight_depths()):
             gauges[f"device{i}_inflight"] = float(depth)
         if self.profiler is not None:
@@ -690,7 +752,8 @@ class InferenceServer:
     def submit(self, graph,
                timeout_ms: float | None = None,
                trace_id: str | None = None,
-               precision: str | None = None) -> RequestFuture:
+               precision: str | None = None,
+               trace_parent: str | None = None) -> RequestFuture:
         """Admit one structure; returns its future (raises ServeRejection
         on malformed / queue-full / oversize / draining). ``graph`` is a
         featurized ``CrystalGraph`` OR a wire-form ``RawStructure``
@@ -700,7 +763,10 @@ class InferenceServer:
         on this thread, so one large structure cannot head-of-line-block
         admission. ``trace_id`` carries an inbound X-Request-Id; absent,
         one is minted here — admission is where a request's journey
-        starts. ``precision`` picks the serving tier (None = 'f32'); a
+        starts. ``trace_parent`` carries an inbound X-Trace-Parent span
+        id (observe/tracectx.py): the upstream attempt span this
+        request's serve.request span nests under in a joined fleet
+        trace. ``precision`` picks the serving tier (None = 'f32'); a
         tier the server did not warm is rejected AT ADMISSION —
         flushing it would trace a fresh program (a recompile after
         warmup)."""
@@ -787,9 +853,17 @@ class InferenceServer:
                     self._lat_rolling.add(latency_ms)
                     self.telemetry.observe_value("serve_latency_ms",
                                                  latency_ms)
-                    if self.telemetry.spans is not None:
+                    if self._spans_on:
+                        args = {"trace_id": tid, "cached": True}
+                        if trace_parent:
+                            args["parent"] = trace_parent
                         self._span("serve.request", queued, replied,
-                                   trace_id=tid, cached=True)
+                                   **args)
+                    self._note_request(
+                        trace_id=tid, status="ok", cached=True,
+                        param_version=version, precision=tier,
+                        wire="raw" if form == "raw" else "featurized",
+                        latency_ms=latency_ms)
                     return fut
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
                    else self.default_timeout)
@@ -807,6 +881,7 @@ class InferenceServer:
             stamps={"queued": queued},
             precision=tier,
             form=form,
+            trace_parent=str(trace_parent or ""),
         )
         try:
             self.batcher.offer(req)
@@ -818,10 +893,11 @@ class InferenceServer:
     def predict(self, graph: CrystalGraph,
                 timeout_ms: float | None = None,
                 trace_id: str | None = None,
-                precision: str | None = None) -> ServeResult:
+                precision: str | None = None,
+                trace_parent: str | None = None) -> ServeResult:
         """Blocking convenience: submit + wait."""
         fut = self.submit(graph, timeout_ms=timeout_ms, trace_id=trace_id,
-                          precision=precision)
+                          precision=precision, trace_parent=trace_parent)
         # wait slightly past the serving deadline: expiry is delivered by
         # the worker, not by this caller racing it
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
@@ -870,7 +946,7 @@ class InferenceServer:
             # co-batched members) and emitted as a span keyed by
             # flush_id + the member trace ids
             flush.stamps["packed"] = t1
-            if self.telemetry.spans is not None:  # skip arg-building when off
+            if self._spans_on:  # skip arg-building when off
                 self._span("serve.pack", t0, t1, flush_id=flush.flush_id,
                            n=len(flush.requests),
                            trace_ids=flush.trace_ids(),
@@ -1052,6 +1128,10 @@ class InferenceServer:
             for r in flush.requests:
                 if not r.future.done():
                     r.future.set_error(e)
+                    self._note_request(
+                        trace_id=r.trace_id, status="dispatch_failed",
+                        error=repr(e), precision=r.precision,
+                        flush_id=flush.flush_id)
         finally:
             busy = time.perf_counter() - t0
             # the shards ran CONCURRENTLY under one dispatch: each
@@ -1102,7 +1182,7 @@ class InferenceServer:
                 f"(mesh shape {sub_shape}); latency SLO was broken "
                 f"this batch"
             )
-        if self.telemetry.spans is not None:  # skip arg-building when off
+        if self._spans_on:  # skip arg-building when off
             self._span("serve.dispatch", dispatched, fetched,
                        flush_id=flush.flush_id, engine="mesh", shards=n,
                        shape=str(sub_shape), trace_ids=flush.trace_ids())
@@ -1136,15 +1216,22 @@ class InferenceServer:
                 device_id=shard, trace_id=r.trace_id, precision=tier,
                 flush_id=flush.flush_id, stamps=stamps, wire=wire,
             ))
-            if self.telemetry.spans is not None:  # skip arg-building when off
+            if self._spans_on:  # skip arg-building when off
+                args = {"trace_id": r.trace_id,
+                        "flush_id": flush.flush_id, "device": shard,
+                        "queue_ms": round(
+                            (stamps["packed"] - stamps["queued"]) * 1e3,
+                            3),
+                        "dispatch_ms": round((fetched - dispatched) * 1e3,
+                                             3)}
+                if r.trace_parent:
+                    args["parent"] = r.trace_parent
                 self._span("serve.request", stamps["queued"], replied,
-                           trace_id=r.trace_id, flush_id=flush.flush_id,
-                           device=shard,
-                           queue_ms=round(
-                               (stamps["packed"] - stamps["queued"]) * 1e3,
-                               3),
-                           dispatch_ms=round((fetched - dispatched) * 1e3,
-                                             3))
+                           **args)
+            self._note_request(
+                trace_id=r.trace_id, status="ok", param_version=version,
+                precision=tier, wire=wire, flush_id=flush.flush_id,
+                device=shard, latency_ms=latency_ms, stamps=stamps)
             self._record_latency(latency_ms)
             self._lat_rolling.add(latency_ms)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
@@ -1164,6 +1251,8 @@ class InferenceServer:
     def _fail_expired(self, flush: Flush) -> None:
         for r in flush.expired:
             self._count("reject_timeout")
+            self._note_request(trace_id=r.trace_id, status="timeout",
+                              precision=r.precision)
             r.future.set_error(ServeRejection(
                 TIMEOUT,
                 f"deadline exceeded after "
@@ -1287,6 +1376,10 @@ class InferenceServer:
             for r in flush.requests:
                 if not r.future.done():
                     r.future.set_error(e)
+                    self._note_request(
+                        trace_id=r.trace_id, status="dispatch_failed",
+                        error=repr(e), precision=r.precision,
+                        flush_id=flush.flush_id, device=device)
         finally:
             self.device_set.note_complete(device,
                                           time.perf_counter() - t0, ok=ok)
@@ -1342,7 +1435,7 @@ class InferenceServer:
             )
         # the dispatch->fetch hop (device compute + transfer), one span
         # per flush with the co-batched trace ids as the join keys
-        if self.telemetry.spans is not None:  # skip arg-building when off
+        if self._spans_on:  # skip arg-building when off
             self._span("serve.dispatch", dispatched, fetched,
                        flush_id=flush.flush_id, device=device,
                        shape=str(flush.shape), trace_ids=flush.trace_ids())
@@ -1374,15 +1467,24 @@ class InferenceServer:
             ))
             # the whole journey, one span per request: admission ->
             # reply, args carrying the flush join key and stage stamps
-            if self.telemetry.spans is not None:  # skip arg-building when off
+            # (plus the upstream attempt span when one propagated in —
+            # the cross-process nesting key)
+            if self._spans_on:  # skip arg-building when off
+                args = {"trace_id": r.trace_id,
+                        "flush_id": flush.flush_id, "device": device,
+                        "queue_ms": round(
+                            (stamps["packed"] - stamps["queued"]) * 1e3,
+                            3),
+                        "dispatch_ms": round((fetched - dispatched) * 1e3,
+                                             3)}
+                if r.trace_parent:
+                    args["parent"] = r.trace_parent
                 self._span("serve.request", stamps["queued"], replied,
-                           trace_id=r.trace_id, flush_id=flush.flush_id,
-                           device=device,
-                           queue_ms=round(
-                               (stamps["packed"] - stamps["queued"]) * 1e3,
-                               3),
-                           dispatch_ms=round((fetched - dispatched) * 1e3,
-                                             3))
+                           **args)
+            self._note_request(
+                trace_id=r.trace_id, status="ok", param_version=version,
+                precision=tier, wire=wire, flush_id=flush.flush_id,
+                device=device, latency_ms=latency_ms, stamps=stamps)
             self._record_latency(latency_ms)
             self._lat_rolling.add(latency_ms)
             # per REQUEST, not per batch: the run-summary quantiles must
@@ -1424,7 +1526,7 @@ class InferenceServer:
             graph=r.graph, enqueued=r.enqueued, deadline=r.deadline,
             future=r.future, fingerprint=None, compactable=False,
             trace_id=r.trace_id, stamps=r.stamps, precision=r.precision,
-            form="feat",
+            form="feat", trace_parent=r.trace_parent,
         )
         try:
             self.batcher.offer(fallback)
@@ -1591,6 +1693,7 @@ def load_server(
     devices: str | int = "auto",
     engine: str = "auto",
     precision: str = "f32",
+    trace_ring: int = 65536,
     watch: bool = True,
     warm: bool = True,
     poll_interval_s: float = 2.0,
@@ -1758,7 +1861,7 @@ def load_server(
         pack_workers=pack_workers, devices=device_list, engine=engine,
         precisions=precisions, model=model,
         featurizer=structure_featurizer(data_cfg),
-        raw_precheck=raw_precheck, log_fn=log_fn,
+        raw_precheck=raw_precheck, trace_ring=trace_ring, log_fn=log_fn,
     )
     # ``warm=False`` (ISSUE 14): the caller compiles later — serve.py
     # binds its HTTP listener FIRST so /healthz can report ready=False

@@ -67,6 +67,23 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="ARG", help="extra argument passed through to "
                                        "every serve.py replica "
                                        "(repeatable)")
+    p.add_argument("--trace-ring", type=int, default=65536, metavar="N",
+                   help="router span ring behind GET /trace (+ the "
+                        "on-demand fleet join GET /trace/joined); "
+                        "0 disables")
+    p.add_argument("--trace-out", default="", metavar="PATH",
+                   help="write ONE joined fleet trace (router + every "
+                        "reachable replica's /trace window) here at "
+                        "drain — open it in Perfetto")
+    p.add_argument("--flightrec-dir", default="", metavar="DIR",
+                   help="incident flight-recorder bundles (joined "
+                        "trace + per-process request rings + metrics) "
+                        "land here; triggers: replica breaker trip, "
+                        "5xx burst ('' disables)")
+    p.add_argument("--log-json", action="store_true",
+                   help="structured JSON log lines (role + pid + "
+                        "current trace id); also passed to every "
+                        "replica")
     return p
 
 
@@ -78,19 +95,25 @@ def main(argv=None) -> int:
     from cgnn_tpu.fleet.replica import ReplicaState
     from cgnn_tpu.fleet.router import FleetRouter
     from cgnn_tpu.fleet.spawn import spawn_fleet
+    from cgnn_tpu.observe import json_log_fn
     from cgnn_tpu.resilience.preempt import PreemptionHandler
+
+    log = json_log_fn("router") if args.log_json else print
 
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-    print(f"fleet: booting {args.replicas} replicas on ports "
-          f"{args.replica_base_port}.."
-          f"{args.replica_base_port + args.replicas - 1} "
-          f"(ckpt {args.ckpt_dir})")
+    log(f"fleet: booting {args.replicas} replicas on ports "
+        f"{args.replica_base_port}.."
+        f"{args.replica_base_port + args.replicas - 1} "
+        f"(ckpt {args.ckpt_dir})")
+    serve_args = list(args.serve_arg)
+    if args.log_json:
+        serve_args.append("--log-json")
     try:
         procs = spawn_fleet(
             args.ckpt_dir, args.replicas,
             base_port=args.replica_base_port, host=args.host,
-            log_dir=args.log_dir or None, serve_args=args.serve_arg,
+            log_dir=args.log_dir or None, serve_args=serve_args,
         )
     except (RuntimeError, FileNotFoundError) as e:
         print(str(e), file=sys.stderr)
@@ -108,12 +131,27 @@ def main(argv=None) -> int:
         hedge_ms=args.hedge_ms,
         default_timeout_ms=args.timeout_ms,
         health_interval_s=args.health_interval,
+        trace_ring=args.trace_ring,
+        log_fn=log,
     ).start()
+
+    if args.flightrec_dir:
+        from cgnn_tpu.observe import FlightRecorder
+
+        router.attach_flight_recorder(FlightRecorder(
+            args.flightrec_dir, role="router",
+            name=f"router:{args.port}",
+            registry=router.registry, tracer=router.tracer,
+            peers=router.replica_trace_urls(),
+            manifest={"ckpt_dir": args.ckpt_dir,
+                      "replicas": args.replicas},
+            log_fn=log,
+        ))
 
     httpd = make_fleet_http_server(router, host=args.host, port=args.port)
     stop = threading.Event()
     handler = PreemptionHandler(
-        log_fn=print,
+        log_fn=log,
         action="draining the fleet (router sheds new work; replicas "
                "drain their queues)",
     )
@@ -123,9 +161,11 @@ def main(argv=None) -> int:
     listener = threading.Thread(target=httpd.serve_forever, daemon=True,
                                 name="fleet-http")
     listener.start()
-    print(f"fleet: routing on http://{args.host}:{args.port} over "
-          f"{len(replicas)} replicas "
-          f"({router.ready_count()} ready; live plane: GET /metrics)")
+    log(f"fleet: routing on http://{args.host}:{args.port} over "
+        f"{len(replicas)} replicas "
+        f"({router.ready_count()} ready; live plane: GET /metrics"
+        + (", GET /trace/joined" if router.tracer is not None else "")
+        + ")")
     try:
         while not stop.wait(0.5):
             pass
@@ -134,12 +174,29 @@ def main(argv=None) -> int:
     httpd.shutdown()
     httpd.server_close()
     router.stop()
+    if args.trace_out and router.tracer is not None:
+        # one joined Perfetto file for the whole run: the router's ring
+        # plus every still-reachable replica's /trace window (pulled
+        # BEFORE the replicas drain away)
+        from cgnn_tpu.observe import trace_join
+
+        windows, errors = trace_join.collect_windows(
+            router.replica_trace_urls())
+        doc = trace_join.write_joined(
+            args.trace_out, [router.trace_window(), *windows])
+        log(f"fleet: joined trace -> {args.trace_out} "
+            f"({1 + len(windows)} process(es), "
+            f"{len(doc['traces'])} trace(s)"
+            + (f"; unreachable: {sorted(errors)}" if errors else "")
+            + ")")
     codes = [p.terminate(timeout_s=args.drain_timeout) for p in procs]
     handler.uninstall()
+    if router.flightrec is not None:
+        router.flightrec.wait_idle(timeout_s=15.0)
     stats = router.stats()["counts"]
-    print(f"fleet: drained — {stats['fleet_answered']} answered, "
-          f"{stats['fleet_retries']} retries, {stats['fleet_hedges']} "
-          f"hedges, {stats['fleet_shed']} shed; replica exits {codes}")
+    log(f"fleet: drained — {stats['fleet_answered']} answered, "
+        f"{stats['fleet_retries']} retries, {stats['fleet_hedges']} "
+        f"hedges, {stats['fleet_shed']} shed; replica exits {codes}")
     if any(c != 0 for c in codes):
         print(f"fleet: replica drain failures: {codes}", file=sys.stderr)
         return 1

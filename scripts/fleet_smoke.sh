@@ -13,6 +13,12 @@
 #     request (trace-id uniqueness + router duplicate counter 0), the
 #     router actually saw transport errors (the chaos bit), and the
 #     restarted replica was probed back in and answered again.
+#     TRACE-JOIN SUB-LEG (ISSUE 15, --expect-trace-join): the kill must
+#     additionally produce a flight-recorder bundle (the victim's
+#     breaker trip fires it) whose joined Chrome trace holds >= 1
+#     retried/hedged request with spans from >= 2 distinct processes —
+#     the router's fleet.attempt spans nested over the replicas'
+#     serve.request stage spans, pulled via each process's GET /trace.
 #  2. PROMOTION LEG: a new checkpoint version committed mid-load rolls
 #     across the fleet via each replica's own hot-reload watcher —
 #     responses observed from BOTH versions, fleet converges
@@ -45,6 +51,7 @@ echo "== leg 0: fleet.py entrypoint (router + 2 replicas, drain) =="
 python fleet.py "$WORK/ckpt" --replicas 2 --port "$BASE" \
   --replica-base-port "$((BASE + 1))" --log-dir "$WORK/fleet0-logs" \
   --serve-arg=--calibrate --serve-arg=64 \
+  --trace-out "$WORK/fleet0_trace.json" \
   >"$WORK/fleet0.log" 2>&1 &
 FPID=$!
 for _ in $(seq 1 900); do
@@ -85,8 +92,22 @@ for prefix in ("cgnn_fleet_", "cgnn_replica_"):
 with urllib.request.urlopen(base + "/healthz", timeout=10.0) as resp:
     health = json.loads(resp.read())
 assert health["ready"] and health["replicas_ready"] == 2, health
+# the on-demand fleet trace join (ISSUE 15): router + both replicas'
+# span rings merged live; the routed predict above must appear as a
+# trace spanning the router AND its answering replica
+with urllib.request.urlopen(base + "/trace/joined", timeout=30.0) as resp:
+    joined = json.loads(resp.read())
+assert not joined.get("collect_errors"), joined.get("collect_errors")
+pids = {e.get("pid") for e in joined["traceEvents"]
+        if e.get("ph") != "M"}
+assert len(pids) >= 2, ("joined trace covers one process", sorted(pids))
+tid = payload["trace_id"]
+assert tid in joined["traces"], (tid, sorted(joined["traces"])[:5])
+assert len(joined["traces"][tid]["pids"]) >= 2, joined["traces"][tid]
 print("leg 0 ok: routed predict via replica", replica,
-      "-", len(fams), "metric families, fleet ready", health["versions"])
+      "-", len(fams), "metric families, fleet ready", health["versions"],
+      "- joined trace:", len(joined["traces"]), "trace(s) over",
+      len(pids), "processes")
 EOF
 kill -TERM "$FPID"
 set +e; wait "$FPID"; RC=$?; set -e
@@ -96,7 +117,15 @@ if [ "$RC" -ne 0 ]; then
   exit 1
 fi
 grep -q "fleet: drained" "$WORK/fleet0.log"
-echo "leg 0 drain ok"
+# --trace-out: one joined Perfetto file written at drain
+python - "$WORK/fleet0_trace.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["traceEvents"], "empty joined trace"
+assert doc["traces"], "no per-trace index in joined trace"
+print("leg 0 drain ok: --trace-out wrote", len(doc["traces"]),
+      "trace(s)")
+EOF
 
 echo "== leg 1: kill -9 a live replica mid-load, restart, re-admit =="
 python scripts/serve_loadgen.py "$WORK/ckpt" \
@@ -104,10 +133,10 @@ python scripts/serve_loadgen.py "$WORK/ckpt" \
   --fleet-log-dir "$WORK/fleet1-logs" \
   --clients 16 --duration 20 \
   --kill-at 0.3 --restart-at 0.5 --kill-replica 1 \
-  --expect-retries --no-scrape \
+  --expect-retries --expect-trace-join --no-scrape \
   --report "$WORK/fleet_kill.json"
 python - "$WORK/fleet_kill.json" <<'EOF'
-import json, sys
+import json, os, sys
 r = json.load(open(sys.argv[1]))
 assert not r["failures"], r["failures"]
 assert r["dropped"] == 0 and not r["client_errors"], r
@@ -119,12 +148,26 @@ assert rc["fleet_duplicate_answers"] == 0, rc
 assert chaos["victim_answered_at_end"] > chaos["victim_answered_at_restart"], chaos
 t = r["tracing"]
 assert t["unique_trace_ids"] == r["answered"] and t["missing_trace_ids"] == 0, t
+# the ISSUE-15 trace-join sub-leg: joined fleet trace + incident bundle
+obs = fl["observe"]
+assert obs["windows"] >= 2, obs
+assert obs["cross_process_requests"] >= 1, obs
+assert obs["flightrec"]["bundles"] >= 1, obs
+trig = obs["flightrec"]["triggers"]
+assert ("breaker_trip" in trig or "replica_unreachable" in trig), trig
+assert obs["bundle_cross_process_requests"] >= 1, obs
+for f in ("trace.json", "requests.jsonl", "manifest.json",
+          "metrics.json"):
+    assert f in obs["bundle_files"], (f, obs["bundle_files"])
+assert os.path.exists(obs["trace_joined"]), obs
 print("leg 1 ok:", r["answered"], "answered @", r["throughput_rps"],
       "rps | kill at", chaos["killed_at_s"], "s, restart at",
       chaos["restarted_at_s"], "s | victim answered",
       chaos["victim_answered_at_restart"], "->",
       chaos["victim_answered_at_end"], "|", rc["fleet_retries"],
-      "retries,", rc["fleet_transport_errors"], "transport errors - 0 lost")
+      "retries,", rc["fleet_transport_errors"], "transport errors - 0 lost |",
+      obs["cross_process_requests"], "cross-process traces,",
+      obs["flightrec"]["bundles"], "flightrec bundle(s)")
 EOF
 
 echo "== leg 2: rolling checkpoint promotion across the fleet =="

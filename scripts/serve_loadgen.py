@@ -102,6 +102,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--expect-retries", action="store_true",
                    help="fail unless the router actually retried (the "
                         "kill / dispatch-exception legs)")
+    p.add_argument("--expect-trace-join", action="store_true",
+                   help="fleet mode (ISSUE 15): hard-assert the "
+                        "cross-process observability layer — the "
+                        "joined fleet trace must contain >= 1 "
+                        "retried/hedged request with spans from >= 2 "
+                        "processes, AND a flight-recorder bundle must "
+                        "exist whose own joined trace shows the same "
+                        "(the kill/hedge chaos legs set this)")
+    p.add_argument("--trace-ring", type=int, default=65536, metavar="N",
+                   help="span-ring size for the server/router under "
+                        "test (0 disables the cross-process trace "
+                        "layer — the PERF.md §18 A/B baseline)")
     p.add_argument("--clients", type=int, default=64)
     p.add_argument("--duration", type=float, default=10.0,
                    help="seconds of open-loop load")
@@ -350,6 +362,7 @@ def _run_inproc(args) -> dict:
                        # let most requests skip the batcher under test
         watch=args.hot_swap,
         poll_interval_s=0.2,
+        trace_ring=args.trace_ring,
     )
     if args.profile_mid:
         server.enable_profiling(tempfile.mkdtemp(prefix="loadgen-prof-"))
@@ -767,7 +780,28 @@ def _run_fleet(args) -> dict:
         hedge_ms=args.hedge_ms,
         default_timeout_ms=args.timeout_ms,
         health_interval_s=0.5,
+        trace_ring=args.trace_ring,
     ).start()
+
+    # the incident flight recorder under test (ISSUE 15): breaker trips
+    # (the kill -9 leg ejects the victim) and 5xx bursts dump a bundle
+    # holding the JOINED fleet trace + every process's request ring —
+    # asserted below when --expect-trace-join
+    from cgnn_tpu.observe import FlightRecorder
+
+    flightrec_dir = os.path.join(
+        os.path.dirname(os.path.abspath(args.report)) or ".",
+        "flightrec")
+    recorder = None
+    if args.trace_ring:
+        recorder = FlightRecorder(
+            flightrec_dir, role="router", name="loadgen-router",
+            registry=router.registry, tracer=router.tracer,
+            peers=router.replica_trace_urls(),
+            manifest={"ckpt_dir": args.ckpt_dir, "replicas": n},
+            log_fn=print,
+        )
+        router.attach_flight_recorder(recorder)
 
     from cgnn_tpu.data.dataset import load_synthetic
 
@@ -970,6 +1004,47 @@ def _run_fleet(args) -> dict:
     if chaos_log.get("restart_ready"):
         chaos_log["victim_answered_at_end"] = (
             replicas[victim].counts["answered"])
+
+    # ---- the cross-process trace join (ISSUE 15), BEFORE the
+    # replicas drain away: router ring + every reachable replica's
+    # /trace window -> one Perfetto file + the machine-checkable index
+    observe_report: dict = {}
+    if args.trace_ring:
+        from cgnn_tpu.observe import trace_join
+
+        windows, collect_errors = trace_join.collect_windows(
+            router.replica_trace_urls())
+        joined_path = os.path.splitext(os.path.abspath(args.report))[0] \
+            + "_trace.json"
+        doc = trace_join.write_joined(
+            joined_path, [router.trace_window(), *windows])
+        cross = trace_join.cross_process_traces(doc)
+        observe_report = {
+            "trace_joined": joined_path,
+            "windows": 1 + len(windows),
+            "collect_errors": collect_errors,
+            "incomplete_processes": doc["incomplete_processes"],
+            "traces_indexed": len(doc["traces"]),
+            "cross_process_requests": len(cross),
+        }
+        if recorder is not None:
+            recorder.wait_idle(timeout_s=60.0)
+            frs = recorder.stats()
+            observe_report["flightrec"] = frs
+            if frs["last_bundle"]:
+                bundle_trace = os.path.join(frs["last_bundle"],
+                                            "trace.json")
+                bundle_cross = []
+                try:
+                    with open(bundle_trace) as f:
+                        bundle_cross = trace_join.cross_process_traces(
+                            json.load(f))
+                except (OSError, ValueError) as e:
+                    observe_report["bundle_trace_error"] = repr(e)
+                observe_report["bundle_files"] = sorted(
+                    os.listdir(frs["last_bundle"]))
+                observe_report["bundle_cross_process_requests"] = len(
+                    bundle_cross)
     exit_codes = [p.terminate(timeout_s=60.0) for p in procs]
 
     lat = np.asarray(stats.latencies) if stats.latencies else np.zeros(1)
@@ -1026,6 +1101,7 @@ def _run_fleet(args) -> dict:
             "retried_answers": fleet_counts["retried_answers"],
             "replica_exit_codes": exit_codes,
             "router": router_stats,
+            "observe": observe_report,
         },
     }
     if scrape:
@@ -1524,6 +1600,49 @@ def main(argv=None) -> int:
                     f"router /metrics missing families: "
                     f"{scrape_fl['missing_families']}"
                 )
+        if args.expect_trace_join:
+            # ---- the ISSUE-15 cross-process observability asserts ----
+            obs = fl.get("observe", {})
+            if not obs:
+                failures.append(
+                    "trace join expected but the trace layer was off "
+                    "(--trace-ring 0?)"
+                )
+            else:
+                if obs.get("windows", 0) < 2:
+                    failures.append(
+                        f"joined trace covers {obs.get('windows')} "
+                        f"process window(s); need the router plus at "
+                        f"least one replica"
+                    )
+                if not obs.get("cross_process_requests"):
+                    failures.append(
+                        "joined fleet trace holds NO retried/hedged "
+                        "request with spans from >= 2 processes (the "
+                        "cross-process join is broken)"
+                    )
+                frs = obs.get("flightrec", {})
+                if not frs.get("bundles"):
+                    failures.append(
+                        f"chaos leg produced no flight-recorder bundle "
+                        f"(triggers seen: {frs.get('triggers')})"
+                    )
+                elif "trace.json" not in obs.get("bundle_files", []):
+                    failures.append(
+                        f"flight-recorder bundle is missing its joined "
+                        f"trace: {obs.get('bundle_files')}"
+                    )
+                elif not obs.get("bundle_cross_process_requests"):
+                    failures.append(
+                        "flight-recorder bundle's joined trace holds "
+                        "no retried/hedged request spanning >= 2 "
+                        "processes"
+                    )
+                elif "requests.jsonl" not in obs.get("bundle_files", []):
+                    failures.append(
+                        f"flight-recorder bundle is missing the "
+                        f"recent-request ring: {obs.get('bundle_files')}"
+                    )
     # racecheck leg (CGNN_TPU_RACECHECK=1): the runtime lock-discipline
     # report rides the SLO report and fails the run like any other
     # invariant — zero lock-order inversions, zero unguarded shared-field

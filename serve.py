@@ -102,6 +102,21 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="DIR", help="persistent XLA compile cache "
                                        "('' disables; warm restarts replay "
                                        "compiles from disk)")
+    p.add_argument("--trace-ring", type=int, default=65536, metavar="N",
+                   help="bounded always-on serving span ring behind "
+                        "GET /trace — the fleet trace-join surface "
+                        "(ISSUE 15); 0 disables (the PERF.md §18 A/B "
+                        "baseline)")
+    p.add_argument("--flightrec-dir", type=str, default="auto",
+                   help="incident flight-recorder bundles land here "
+                        "('auto' = the telemetry dir when set, else "
+                        "CKPT_DIR/flightrec; '' disables). Triggers: "
+                        "5xx burst, drain force-exit, racecheck "
+                        "watchdog")
+    p.add_argument("--log-json", action="store_true",
+                   help="structured JSON log lines (role + pid + "
+                        "current trace id per line) instead of plain "
+                        "prints — bundle logs then grep by trace id")
     return p
 
 
@@ -123,9 +138,13 @@ def main(argv=None) -> int:
         except Exception as e:  # noqa: BLE001 — cache is best-effort
             print(f"compilation cache unavailable: {e}", file=sys.stderr)
 
-    from cgnn_tpu.observe import Telemetry
+    from cgnn_tpu.observe import Telemetry, json_log_fn
     from cgnn_tpu.serve.http import make_http_server
     from cgnn_tpu.serve.server import load_server
+
+    # one logging sink for everything this process prints: JSON lines
+    # (role/pid/trace id) under --log-json, plain print otherwise
+    log = json_log_fn("replica") if args.log_json else print
 
     telemetry = (
         Telemetry(level="epoch", log_dir=args.telemetry_dir)
@@ -165,10 +184,39 @@ def main(argv=None) -> int:
             warm=False,
             poll_interval_s=args.poll_interval or 2.0,
             profile_dir=profile_dir,
+            trace_ring=args.trace_ring,
+            log_fn=log,
         )
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
+
+    # incident flight recorder (ISSUE 15; observe/flightrec.py): the
+    # always-cheap per-request ring + metrics/trace bundle dumps on
+    # trigger — 5xx bursts (fed by the HTTP layer), the bounded-drain
+    # force exit below, and the racecheck watchdog when that gate is on
+    recorder = None
+    flightrec_dir = args.flightrec_dir
+    if flightrec_dir == "auto":
+        flightrec_dir = args.telemetry_dir or os.path.join(
+            args.ckpt_dir, "flightrec")
+    if flightrec_dir:
+        from cgnn_tpu.observe import FlightRecorder
+
+        recorder = FlightRecorder(
+            flightrec_dir, role="replica",
+            name=f"replica:{args.port}",
+            registry=server.registry, tracer=server.tracer,
+            manifest={
+                "ckpt_dir": args.ckpt_dir,
+                "param_version": server.param_store.version,
+                "port": args.port,
+                "engine": server.engine,
+                "precisions": list(server.precisions),
+            },
+            log_fn=log,
+        )
+        server.attach_flight_recorder(recorder)
 
     # the live plane's two push/pull surfaces beyond HTTP: SIGUSR2 ->
     # bounded on-demand device profile; --live-metrics -> periodic
@@ -176,7 +224,7 @@ def main(argv=None) -> int:
     if server.profiler is not None:
         from cgnn_tpu.observe import install_sigusr2
 
-        install_sigusr2(server.profiler, log_fn=print)
+        install_sigusr2(server.profiler, log_fn=log)
     live_writer = None
     if args.live_metrics > 0:
         from cgnn_tpu.observe import LiveMetricsWriter
@@ -204,24 +252,39 @@ def main(argv=None) -> int:
     listener = threading.Thread(target=httpd.serve_forever, daemon=True,
                                 name="http-listener")
     listener.start()
-    print(f"listening on http://{args.host}:{args.port} "
-          f"(warming {len(server.shape_set)} shapes; "
-          f"/healthz reports ready=false until done)")
+    log(f"listening on http://{args.host}:{args.port} "
+        f"(warming {len(server.shape_set)} shapes; "
+        f"/healthz reports ready=false until done)")
     server.warm(parts["template"])
     server.start()
+    if recorder is not None:
+        from cgnn_tpu.analysis import racecheck
+
+        if racecheck.enabled():
+            # a deadlock-watchdog dump is exactly the incident the
+            # recorder exists for: re-arm the singleton's log hook so
+            # the stall report also dumps a bundle (server.start()
+            # armed it with the plain server log a moment ago)
+            def _watchdog_log(msg):
+                log(msg)
+                recorder.trigger("watchdog", str(msg))
+
+            racecheck.start_watchdog(bound_s=30.0, log_fn=_watchdog_log)
 
     shapes = ", ".join(
         f"({s.graph_cap}g/{s.node_cap}n/{s.edge_cap}e)"
         for s in server.shape_set
     )
-    print(f"serving on http://{args.host}:{args.port} "
-          f"(params {server.param_store.version}; shapes {shapes}; "
-          f"{len(server.device_set)} device(s), {server.engine} engine; "
-          f"wire: "
-          f"{'raw+featurized' if server.shape_set.raw is not None else 'featurized'}; "
-          f"live plane: GET /metrics"
-          + (f", POST /profile -> {profile_dir}" if profile_dir else "")
-          + ")")
+    log(f"serving on http://{args.host}:{args.port} "
+        f"(params {server.param_store.version}; shapes {shapes}; "
+        f"{len(server.device_set)} device(s), {server.engine} engine; "
+        f"wire: "
+        f"{'raw+featurized' if server.shape_set.raw is not None else 'featurized'}; "
+        f"live plane: GET /metrics"
+        + (", GET /trace" if server.tracer is not None else "")
+        + (f", flightrec -> {flightrec_dir}" if recorder else "")
+        + (f", POST /profile -> {profile_dir}" if profile_dir else "")
+        + ")")
     try:
         while not stop.wait(0.5):
             pass
@@ -236,8 +299,8 @@ def main(argv=None) -> int:
     stats = server.stats()
     lat = stats["latency_ms"]
     if lat:
-        print(f"drained: {stats['counts']['responses']} responses, "
-              f"p50 {lat['p50']:.1f} ms / p99 {lat['p99']:.1f} ms")
+        log(f"drained: {stats['counts']['responses']} responses, "
+            f"p50 {lat['p50']:.1f} ms / p99 {lat['p99']:.1f} ms")
     telemetry.close()
     if not clean:
         # the bounded-drain satellite (ISSUE 14): a wedged flush must
@@ -249,6 +312,19 @@ def main(argv=None) -> int:
         rejected = sum(v for k, v in c.items() if k.startswith("reject_"))
         unanswered = (c.get("requests", 0) - c.get("responses", 0)
                       - c.get("cache_hits", 0) - rejected)
+        if recorder is not None:
+            # the flight-recorder trigger for exactly this incident:
+            # dump the ring + metrics + trace BEFORE the hard exit.
+            # wait=True: os._exit would otherwise race the dump thread
+            # and truncate the bundle. force=True: the wedge that
+            # caused this drain typically ALSO fired a 5xx/timeout
+            # burst moments earlier, and the final bundle must not be
+            # rate-limited away by its own symptom.
+            recorder.trigger(
+                "drain_force_exit",
+                f"{max(unanswered, 0)} unanswered after "
+                f"{args.drain_timeout:.0f} s drain",
+                wait=True, force=True)
         print(f"drain timed out after {args.drain_timeout:.0f} s: "
               f"{max(unanswered, 0)} accepted request(s) unanswered, "
               f"{stats['queue_depth']} still queued; force-exiting 3",
@@ -256,6 +332,8 @@ def main(argv=None) -> int:
         sys.stderr.flush()
         sys.stdout.flush()
         os._exit(3)
+    if recorder is not None:
+        recorder.wait_idle(timeout_s=10.0)
     return 0
 
 

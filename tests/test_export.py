@@ -201,6 +201,51 @@ class TestMetricsRegistry:
         assert "cgnn_serve_latency_ms_count" in names
         t.close()
 
+    def test_replica_family_round_trip(self):
+        """The PR-12 ``replica{i}_*`` gauge folding, parsed back: one
+        labeled family per metric, every per-replica value recoverable
+        from the exposition text by the SAME parser the fleet poller
+        uses — emitter and validator cannot drift apart (ISSUE 15
+        satellite; fleet/replica.py scrapes exactly this way)."""
+        r = MetricsRegistry()
+        r.add_provider("fleet", lambda: {
+            "counters": {"fleet_requests": 12},
+            "gauges": {
+                "replica0_inflight": 2.0,
+                "replica0_queue_depth": 5.0,
+                "replica1_inflight": 0.0,
+                "replica1_queue_depth": 1.5,
+                "replica10_inflight": 7.0,  # multi-digit rid
+                "fleet_replicas_ready": 3.0,
+            },
+            "series": {
+                "replica0_latency_ms": {"p50": 4.0, "p95": 9.0,
+                                        "p99": 12.5, "mean": 5.0,
+                                        "count": 8},
+            },
+        })
+        fams = parse_prometheus_text(r.prometheus_text())
+        inflight = fams["cgnn_replica_inflight"]
+        assert inflight["type"] == "gauge"
+        assert sorted(inflight["samples"]) == [
+            ('cgnn_replica_inflight{replica="0"}', 2.0),
+            ('cgnn_replica_inflight{replica="1"}', 0.0),
+            ('cgnn_replica_inflight{replica="10"}', 7.0),
+        ]
+        depth = dict(fams["cgnn_replica_queue_depth"]["samples"])
+        assert depth['cgnn_replica_queue_depth{replica="1"}'] == 1.5
+        # the un-indexed fleet gauge stays a plain family
+        assert fams["cgnn_fleet_replicas_ready"]["samples"] == [
+            ("cgnn_fleet_replicas_ready", 3.0)]
+        # per-replica latency summaries keep their quantile labels AND
+        # the provider-series count fallback (no lifetime totals)
+        lat = fams["cgnn_replica0_latency_ms"]
+        assert lat["type"] == "summary"
+        samples = dict(lat["samples"])
+        assert samples[
+            'cgnn_replica0_latency_ms{quantile="0.99"}'] == 12.5
+        assert samples["cgnn_replica0_latency_ms_count"] == 8.0
+
     def test_broken_provider_cannot_kill_scrape(self, tmp_path):
         t, r = self._registry(tmp_path)
         r.add_provider("broken", lambda: 1 / 0)

@@ -344,6 +344,126 @@ class TestFleetRouter:
         assert set(stats["replicas"]) == {"0", "1"}
 
 
+# -------------------------- cross-process trace propagation (ISSUE 15)
+
+
+class TestRouterTracePropagation:
+    def test_attempts_carry_distinct_parents_same_trace(self):
+        """Every attempt of one request propagates the SAME trace id
+        but its OWN attempt span id in trace_parent — the replica-side
+        serve.request spans then nest under the right attempt in the
+        joined trace (a hedge's two subtrees stay distinguishable)."""
+        seen = []
+
+        def transport(replica, body, timeout_s):
+            seen.append((replica.rid, body["trace_id"],
+                         body.get("trace_parent", "")))
+            if replica.rid == 0:
+                raise FleetTransportError("connection refused")
+            return 200, _ok_payload()
+
+        router = _router([_ready_replica(0), _ready_replica(1)],
+                         transport)
+        status, _, meta = router.dispatch({"graph": {}},
+                                          trace_id="probe-9")
+        assert status == 200 and meta["attempts"] == 2
+        assert meta["span_id"].startswith("req-")
+        from cgnn_tpu.observe.tracectx import parse_parent
+
+        parents = [parse_parent(tp) for _, _, tp in seen]
+        # same trace id on every attempt, a DISTINCT span id per attempt
+        assert [t for t, _ in parents] == ["probe-9", "probe-9"]
+        sids = [s for _, s in parents]
+        assert len(set(sids)) == 2 and all(s.startswith("att-")
+                                           for s in sids)
+
+    def test_router_ring_holds_request_and_attempt_spans(self):
+        def transport(replica, body, timeout_s):
+            if replica.rid == 0:
+                raise FleetTransportError("refused")
+            return 200, _ok_payload()
+
+        router = _router([_ready_replica(0), _ready_replica(1)],
+                         transport)
+        router.dispatch({"graph": {}}, trace_id="probe-10")
+        events = router.tracer.events
+        reqs = [e for e in events if e["name"] == "fleet.request"]
+        atts = [e for e in events if e["name"] == "fleet.attempt"]
+        assert len(reqs) == 1 and len(atts) == 2
+        root = reqs[0]["args"]
+        assert root["trace_id"] == "probe-10" and root["status"] == 200
+        # both attempts parent to the root span; outcomes name the
+        # failure AND the win
+        assert {a["args"]["parent"] for a in atts} == {root["span_id"]}
+        assert {a["args"]["outcome"] for a in atts} == {
+            "transport_errors", "answered"}
+        # the router's window is a joinable /trace payload
+        w = router.trace_window()
+        assert w["role"] == "router" and w["dropped"] == 0
+
+    def test_trace_ring_off_disables_cleanly(self):
+        def transport(replica, body, timeout_s):
+            assert "trace_parent" not in body  # nothing propagates
+            return 200, _ok_payload()
+
+        router = _router([_ready_replica(0)], transport, trace_ring=0)
+        status, _, meta = router.dispatch({"graph": {}})
+        assert status == 200 and meta["span_id"] == ""
+        assert router.tracer is None and router.trace_window() is None
+
+    def test_breaker_trip_fires_flight_recorder(self, tmp_path):
+        from cgnn_tpu.observe import FlightRecorder
+
+        def transport(replica, body, timeout_s):
+            # typed 500s: the replica stays READY (it answered), so the
+            # retry loop keeps feeding the same breaker until it trips
+            return 500, {"error": "boom", "reason": "dispatch_failed"}
+
+        r0 = _ready_replica(0)
+        router = _router([r0], transport, max_attempts=4)
+        recorder = FlightRecorder(str(tmp_path / "fr"), role="router",
+                                  min_interval_s=0.0,
+                                  tracer=router.tracer,
+                                  log_fn=lambda *a, **k: None)
+        router.attach_flight_recorder(recorder)
+        status, _, _ = router.dispatch({"graph": {}})
+        assert status in (502, 503)
+        recorder.wait_idle()
+        s = recorder.stats()
+        # K=3 consecutive 500s tripped the breaker -> one bundle; the
+        # dispatch outcome also landed in the recent-request ring
+        assert s["triggers"].get("breaker_trip", 0) >= 1
+        assert s["bundles"] >= 1
+        import os as _os
+
+        assert _os.path.isdir(s["last_bundle"])
+        assert recorder.recent_requests()[-1]["status"] in (502, 503)
+
+    def test_vanished_replica_fires_recorder_on_probe(self, tmp_path):
+        """The kill -9 case, made deterministic: the victim's breaker
+        may or may not accumulate K in-flight failures before the
+        router stops picking it, but the NEXT health-probe round always
+        sees reachable -> unreachable and bundles the incident."""
+        from cgnn_tpu.observe import FlightRecorder
+
+        r0 = _ready_replica(0)  # nothing listens on its port
+        router = _router([r0], lambda *a: (200, _ok_payload()))
+        recorder = FlightRecorder(str(tmp_path / "fr"), role="router",
+                                  min_interval_s=0.0,
+                                  tracer=router.tracer,
+                                  log_fn=lambda *a, **k: None)
+        router.attach_flight_recorder(recorder)
+        assert r0.stats()["probe_ok"]  # the fixture probed it ready
+        router.probe_all(timeout_s=0.2)  # real probe: connection refused
+        recorder.wait_idle()
+        s = recorder.stats()
+        assert s["triggers"].get("replica_unreachable", 0) == 1
+        # still-unreachable on later rounds: no transition, no re-fire
+        router.probe_all(timeout_s=0.2)
+        recorder.wait_idle()
+        assert recorder.stats()["triggers"]["replica_unreachable"] == 1
+
+
 # --------------------------------------- serve-side fault-plan parsing
 
 

@@ -1,0 +1,354 @@
+"""Cross-process observability layer tests (ISSUE 15).
+
+The load-bearing guarantees, pinned host-side and deterministically —
+the live-fleet legs (kill -9 -> breaker trip -> bundle with a joined
+cross-process trace) run in scripts/fleet_smoke.sh:
+
+- trace context: span ids are process-unique, the X-Trace-Parent wire
+  format round-trips, malformed values parse to empty (never raise on
+  the request path);
+- span windows: `/trace` payloads carry the drop count and retained
+  bounds, so a joiner can mark truncation instead of silently
+  rendering a partial tree;
+- the joiner: N process windows -> ONE Chrome-trace doc with per-
+  process metadata, rebased timestamps, flow arrows on span parents,
+  and a per-trace index that marks cross-process + incomplete chains;
+- the flight recorder: bounded ring, rate-limited triggers, a bundle
+  dir holding manifest/requests/metrics/trace, the 5xx burst trigger
+  firing once per plateau;
+- JSON logging: one parseable line per call carrying role + pid + the
+  contextvar-bound trace id.
+"""
+
+import json
+import os
+import threading
+import time
+
+from cgnn_tpu.observe import flightrec, log, trace_join, tracectx
+from cgnn_tpu.observe.export import MetricsRegistry
+from cgnn_tpu.observe.spans import SpanTracer
+
+# ------------------------------------------------------------ tracectx
+
+
+class TestTraceContext:
+    def test_span_ids_unique(self):
+        ids = {tracectx.mint_span_id("att") for _ in range(1000)}
+        assert len(ids) == 1000
+
+    def test_parent_round_trip(self):
+        sid = tracectx.mint_span_id("att")
+        header = tracectx.format_parent("flt-ab-000001", sid)
+        assert tracectx.parse_parent(header) == ("flt-ab-000001", sid)
+
+    def test_trace_id_with_slashes_survives(self):
+        # trace ids are client-controlled (X-Request-Id); the span id
+        # owns the LAST '/' so a slashed trace id still round-trips
+        header = tracectx.format_parent("client/run/7", "att-1-2")
+        assert tracectx.parse_parent(header) == ("client/run/7", "att-1-2")
+
+    def test_malformed_parses_empty_never_raises(self):
+        for bad in (None, "", "/", "no-separator", 42, "a/" , "/b"):
+            assert tracectx.parse_parent(bad) == ("", "")
+
+
+# ---------------------------------------------------------- span window
+
+
+class TestSpanWindow:
+    def test_window_carries_drop_count_and_bounds(self):
+        tr = SpanTracer(process_name="w", max_events=4)
+        for i in range(7):  # 3 evictions
+            tr.complete(f"s{i}", 0.0, 0.001)
+        w = tr.window()
+        assert w["dropped"] == 3 and w["max_events"] == 4
+        assert len(w["events"]) == 4
+        assert w["begin_us"] <= w["end_us"]
+        assert w["pid"] == os.getpid() and w["t0_unix"] > 0
+
+    def test_since_filters_by_wall_clock(self):
+        tr = SpanTracer(process_name="w")
+        t0 = SpanTracer.now_s()
+        tr.complete("old", t0 - 10.0, t0 - 9.0)
+        tr.complete("new", t0, t0 + 0.001)
+        w = tr.window(since_s=time.time() - 5.0)
+        names = [e["name"] for e in w["events"]]
+        assert names == ["new"]
+        # no filter -> both retained
+        assert len(tr.window()["events"]) == 2
+
+
+# -------------------------------------------------------------- joiner
+
+
+def _fleet_windows(drop_replica=False):
+    """A router ring + one replica ring holding a retried request:
+    two fleet.attempt spans (replica 0 failed, replica 1 answered)
+    and the replica-side serve.request nested under attempt 2."""
+    router = SpanTracer(process_name="router")
+    replica = SpanTracer(process_name="replica1")
+    t = SpanTracer.now_s()
+    root = tracectx.mint_span_id("req")
+    att1 = tracectx.mint_span_id("att")
+    att2 = tracectx.mint_span_id("att")
+    router.complete("fleet.attempt", t, t + 0.01, trace_id="tid-1",
+                    span_id=att1, parent=root, replica=0,
+                    outcome="transport_errors", status=0)
+    router.complete("fleet.attempt", t + 0.02, t + 0.05,
+                    trace_id="tid-1", span_id=att2, parent=root,
+                    replica=1, outcome="answered", status=200)
+    router.complete("fleet.request", t, t + 0.05, trace_id="tid-1",
+                    span_id=root, status=200, attempts=2)
+    replica.complete("serve.request", t + 0.025, t + 0.045,
+                     trace_id="tid-1", parent=att2, flush_id="f-1")
+    replica.complete("serve.dispatch", t + 0.03, t + 0.04,
+                     flush_id="f-1", trace_ids=["tid-1"])
+    wr = router.window()
+    wr["role"] = "router"
+    wp = replica.window()
+    wp["role"] = "replica"
+    wp["pid"] = os.getpid() + 1  # two tracers, one test process: give
+    #                              the replica window its own pid
+    if drop_replica:
+        wp["dropped"] = 5
+    return wr, wp
+
+
+class TestTraceJoin:
+    def test_joined_doc_is_one_cross_process_tree(self):
+        doc = trace_join.join_windows(list(_fleet_windows()))
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert {"fleet.request", "fleet.attempt", "serve.request",
+                "process_name"} <= names
+        # two processes, metadata naming both roles
+        meta = [e for e in doc["traceEvents"]
+                if e.get("name") == "process_name"]
+        labels = {e["args"]["name"] for e in meta}
+        assert any("router" in x for x in labels)
+        assert any("replica" in x for x in labels)
+        # the per-trace index: one request spanning BOTH pids, rooted,
+        # complete (no ring dropped anything)
+        t = doc["traces"]["tid-1"]
+        assert len(t["pids"]) == 2
+        assert t["rooted"] and t["complete"]
+        # flow arrows connect the attempt span to the replica's
+        # serve.request (the parent edge the propagation carried)
+        flows = [e for e in doc["traceEvents"] if e.get("ph") in "sf"]
+        assert any(e["ph"] == "s" for e in flows)
+        assert any(e["ph"] == "f" for e in flows)
+        assert doc["incomplete_processes"] == []
+
+    def test_cross_process_index_finds_retried_request(self):
+        doc = trace_join.join_windows(list(_fleet_windows()))
+        assert trace_join.cross_process_traces(doc) == ["tid-1"]
+        # a stricter bar than the data holds -> empty, not a crash
+        assert trace_join.cross_process_traces(doc, min_spans=3) == []
+
+    def test_truncated_ring_marks_chains_incomplete(self):
+        doc = trace_join.join_windows(list(
+            _fleet_windows(drop_replica=True)))
+        assert len(doc["incomplete_processes"]) == 1
+        t = doc["traces"]["tid-1"]
+        assert t["rooted"] and not t["complete"]
+
+    def test_timestamps_rebase_onto_shared_anchor(self):
+        wr, wp = _fleet_windows()
+        wp["t0_unix"] = wr["t0_unix"] + 3.0  # replica booted 3 s later
+        doc = trace_join.join_windows([wr, wp])
+        by_pid = {}
+        for e in doc["traceEvents"]:
+            if e.get("ph") == "X":
+                by_pid.setdefault(e["pid"], []).append(e["ts"])
+        a, b = sorted(by_pid)
+        # the later process's events land ~3e6 us after the anchor
+        assert min(by_pid[b]) - min(by_pid[a]) > 2.5e6
+        assert doc["t0_unix"] == wr["t0_unix"]
+
+    def test_empty_and_missing_windows_degrade(self):
+        doc = trace_join.join_windows([])
+        assert doc["traceEvents"] == [] and doc["traces"] == {}
+        doc = trace_join.join_windows([None, {"events": [],
+                                              "t0_unix": 1.0}])
+        assert doc["traces"] == {}
+
+    def test_write_joined_is_loadable_json(self, tmp_path):
+        path = str(tmp_path / "joined" / "trace.json")
+        doc = trace_join.write_joined(path, list(_fleet_windows()))
+        on_disk = json.load(open(path))
+        assert on_disk["traces"].keys() == doc["traces"].keys()
+        assert any(e.get("name") == "serve.request"
+                   for e in on_disk["traceEvents"])
+
+
+# ----------------------------------------------------- flight recorder
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("role", "replica")
+    kw.setdefault("min_interval_s", 0.0)
+    kw.setdefault("log_fn", lambda *a, **k: None)
+    return flightrec.FlightRecorder(str(tmp_path / "flightrec"), **kw)
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self, tmp_path):
+        fr = _recorder(tmp_path, ring=8)
+        for i in range(20):
+            fr.note_request({"trace_id": f"t{i}", "status": "ok"})
+        ring = fr.recent_requests()
+        assert len(ring) == 8
+        assert ring[-1]["trace_id"] == "t19"  # newest retained
+
+    def test_trigger_writes_correlated_bundle(self, tmp_path):
+        tracer = SpanTracer(process_name="replica")
+        tracer.complete("serve.request", 0.0, 0.01, trace_id="t1")
+        registry = MetricsRegistry()
+        registry.add_provider("serve", lambda: {
+            "counters": {"serve_requests": 3.0}})
+        fr = _recorder(tmp_path, registry=registry, tracer=tracer,
+                       manifest={"param_version": "ckpt-7"})
+        fr.note_request({"trace_id": "t1", "status": "ok",
+                         "param_version": "ckpt-7"})
+        bundle = fr.trigger("breaker_trip", "replica1 ejected",
+                            wait=True)
+        assert bundle and os.path.isdir(bundle)
+        # pid in the dir name: replicas sharing one flightrec dir and
+        # firing in the same second must land in DISTINCT bundles
+        assert f"-p{os.getpid()}-" in os.path.basename(bundle)
+        files = set(os.listdir(bundle))
+        assert {"manifest.json", "requests.jsonl", "metrics.json",
+                "trace.json"} <= files
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert manifest["reason"] == "breaker_trip"
+        assert manifest["param_version"] == "ckpt-7"
+        assert manifest["triggers"] == {"breaker_trip": 1}
+        rows = [json.loads(ln) for ln in
+                open(os.path.join(bundle, "requests.jsonl"))]
+        assert rows[0]["trace_id"] == "t1"
+        metrics = json.load(open(os.path.join(bundle, "metrics.json")))
+        assert metrics["counters"]["serve_requests"] == 3.0
+        doc = json.load(open(os.path.join(bundle, "trace.json")))
+        assert "t1" in doc["traces"]
+
+    def test_rate_limit_and_bundle_cap(self, tmp_path):
+        clk = [0.0]
+        fr = _recorder(tmp_path, min_interval_s=30.0, max_bundles=2,
+                       clock=lambda: clk[0])
+        assert fr.trigger("a", wait=True) is not None
+        # inside the quiet interval: counted, not dumped
+        assert fr.trigger("a", wait=True) is None
+        clk[0] += 31.0
+        assert fr.trigger("b", wait=True) is not None
+        clk[0] += 31.0
+        # bundle budget spent: an incident storm cannot fill the disk
+        assert fr.trigger("c", wait=True) is None
+        s = fr.stats()
+        assert s["bundles"] == 2 and s["suppressed"] == 2
+        assert s["triggers"] == {"a": 2, "b": 1, "c": 1}
+
+    def test_force_trigger_bypasses_rate_limit_and_cap(self, tmp_path):
+        """The drain-force-exit contract: the process is about to
+        os._exit, and the final bundle must not be suppressed because
+        the wedge's own 5xx burst dumped moments earlier."""
+        clk = [0.0]
+        fr = _recorder(tmp_path, min_interval_s=30.0, max_bundles=1,
+                       clock=lambda: clk[0])
+        assert fr.trigger("5xx_burst", wait=True) is not None
+        # an ordinary trigger inside the quiet window: suppressed
+        assert fr.trigger("breaker_trip", wait=True) is None
+        b = fr.trigger("drain_force_exit", wait=True, force=True)
+        assert b is not None and os.path.isdir(b)
+        assert os.path.exists(os.path.join(b, "manifest.json"))
+        assert fr.stats()["bundles"] == 2  # cap of 1 bypassed too
+
+    def test_5xx_burst_fires_once_per_plateau(self, tmp_path):
+        clk = [0.0]
+        fr = _recorder(tmp_path, burst_threshold=5, burst_window_s=10.0,
+                       min_interval_s=0.0, clock=lambda: clk[0])
+        for _ in range(4):
+            fr.note_status(500)
+        assert fr.stats()["triggers"] == {}  # below threshold
+        fr.note_status(502)
+        fr.wait_idle()
+        assert fr.stats()["triggers"] == {"5xx_burst": 1}
+        # the plateau continues: no re-fire while armed
+        for _ in range(10):
+            fr.note_status(500)
+        fr.wait_idle()
+        assert fr.stats()["triggers"]["5xx_burst"] == 1
+        # window drains + a fresh burst -> re-arms and fires again
+        clk[0] += 20.0
+        fr.note_status(500)  # evicts the stale window, re-arms
+        for _ in range(5):
+            fr.note_status(500)
+        fr.wait_idle()
+        assert fr.stats()["triggers"]["5xx_burst"] == 2
+
+    def test_2xx_and_4xx_never_feed_the_burst(self, tmp_path):
+        fr = _recorder(tmp_path, burst_threshold=2)
+        for s in (200, 200, 404, 429, 413):
+            fr.note_status(s)
+        assert fr.stats()["triggers"] == {}
+
+    def test_snapshot_is_the_peer_pull_surface(self, tmp_path):
+        fr = _recorder(tmp_path, manifest={"port": 8441})
+        fr.note_request({"trace_id": "t9", "status": "ok"})
+        snap = fr.snapshot()
+        assert snap["role"] == "replica" and snap["pid"] == os.getpid()
+        assert snap["requests"][0]["trace_id"] == "t9"
+        assert snap["manifest"]["port"] == 8441
+        json.dumps(snap)  # wire-serializable as-is
+
+
+# -------------------------------------------------------- JSON logging
+
+
+class TestJsonLogging:
+    def test_line_schema_and_trace_binding(self):
+        import io
+
+        buf = io.StringIO()
+        jlog = log.json_log_fn("router", stream=buf)
+        jlog("fleet: routing on", "http://x:1")
+        with log.bind_trace("flt-1-000001"):
+            jlog("retrying on replica2")
+        jlog("drained")
+        lines = [json.loads(ln) for ln in
+                 buf.getvalue().strip().splitlines()]
+        assert [ln["trace_id"] for ln in lines] == ["", "flt-1-000001",
+                                                    ""]
+        assert lines[0]["msg"] == "fleet: routing on http://x:1"
+        assert all(ln["role"] == "router" and ln["pid"] == os.getpid()
+                   for ln in lines)
+
+    def test_binding_is_per_context_not_global(self):
+        seen = {}
+
+        def worker():
+            with log.bind_trace("other-thread"):
+                time.sleep(0.05)
+                seen["worker"] = log.current_trace_id()
+
+        t = threading.Thread(target=worker, name="log-bind-test")
+        with log.bind_trace("main-thread"):
+            t.start()
+            time.sleep(0.01)
+            seen["main"] = log.current_trace_id()
+        t.join()
+        assert seen == {"main": "main-thread", "worker": "other-thread"}
+
+    def test_stdlib_handler_idempotent(self):
+        import io
+        import logging
+
+        buf = io.StringIO()
+        log.setup_json_logging("trainer", stream=buf)
+        logger = log.setup_json_logging("trainer", stream=buf)
+        logger.info("epoch 3 done")
+        lines = buf.getvalue().strip().splitlines()
+        assert len(lines) == 1  # re-setup did NOT stack a second handler
+        rec = json.loads(lines[0])
+        assert rec["role"] == "trainer" and rec["level"] == "info"
+        assert rec["msg"] == "epoch 3 done"
+        logging.getLogger("cgnn_tpu").handlers.clear()

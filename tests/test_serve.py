@@ -1030,6 +1030,102 @@ class TestRequestTracing:
         assert server.stats()["recompiles_after_warm"] == 0
 
 
+# ------------------------- cross-process observability layer (ISSUE 15)
+
+
+class TestCrossProcessTraceLayer:
+    """The fleet-facing half of the plane: the always-on span ring
+    behind GET /trace, inbound X-Trace-Parent adoption, and the flight
+    recorder's request ring — all host-side. Pinned: served numbers are
+    BIT-EXACT and the post-warmup compile count stays zero with the
+    whole layer on vs fully off (the ISSUE-15 acceptance pin)."""
+
+    def test_bit_exact_and_zero_recompiles_with_layer_on(
+            self, graphs, shape_set, model_state, tmp_path):
+        from cgnn_tpu.observe import FlightRecorder
+
+        off = _make_server(model_state, shape_set, cache_size=0,
+                           trace_ring=0)
+        on = _make_server(model_state, shape_set, cache_size=0,
+                          trace_ring=4096)
+        on.attach_flight_recorder(FlightRecorder(
+            str(tmp_path / "fr"), role="replica", registry=on.registry,
+            tracer=on.tracer, log_fn=lambda *a, **k: None))
+        for server in (off, on):
+            server.warm(graphs[0])
+            server.start()
+        assert off.tracer is None and on.tracer is not None
+        for i, g in enumerate(graphs[:6]):
+            a = off.predict(g, timeout_ms=30000)
+            b = on.predict(g, timeout_ms=30000,
+                           trace_parent=f"att-pin-{i:06x}")
+            np.testing.assert_array_equal(a.prediction, b.prediction)
+        assert off.drain(timeout_s=30.0) and on.drain(timeout_s=30.0)
+        assert off.stats()["recompiles_after_warm"] == 0
+        assert on.stats()["recompiles_after_warm"] == 0
+        # the layer actually recorded what it promised while staying
+        # out of the compute: spans in the ring, requests in the ring
+        assert len(on.flightrec.recent_requests()) == 6
+        assert all(r["status"] == "ok"
+                   for r in on.flightrec.recent_requests())
+
+    def test_trace_window_adopts_inbound_parent(self, graphs,
+                                                shape_set, model_state):
+        server = _make_server(model_state, shape_set, cache_size=16,
+                              trace_ring=4096)
+        server.warm(graphs[0])
+        server.start()
+        server.predict(graphs[1], timeout_ms=30000,
+                       trace_id="joined-1",
+                       trace_parent="att-up-000001")
+        # the cache-hit fast path must carry the parent too (a hedged
+        # retry answered from cache still nests under its attempt)
+        hit = server.predict(graphs[1], timeout_ms=30000,
+                             trace_id="joined-2",
+                             trace_parent="att-up-000002")
+        orphan = server.predict(graphs[2], timeout_ms=30000)
+        assert hit.cached
+        assert server.drain(timeout_s=30.0)
+        w = server.trace_window()
+        assert w["role"] == "replica" and w["dropped"] == 0
+        reqs = {e["args"].get("trace_id"): e["args"]
+                for e in w["events"] if e["name"] == "serve.request"}
+        assert reqs["joined-1"]["parent"] == "att-up-000001"
+        assert reqs["joined-2"]["parent"] == "att-up-000002"
+        # no inbound context -> the span roots its own tree (no
+        # invented parent key at all)
+        assert "parent" not in reqs[orphan.trace_id]
+        # flush-level hops landed in the SAME ring (the joiner nests
+        # them by flush_id/trace_ids)
+        assert any(e["name"] == "serve.dispatch" for e in w["events"])
+
+    def test_window_since_and_telemetry_coexistence(
+            self, graphs, shape_set, model_state, tmp_path):
+        # both sinks on: the telemetry tracer (trace.json at close) AND
+        # the serving ring must each hold the request span
+        telemetry = Telemetry(level="epoch", log_dir=str(tmp_path),
+                              use_clu=False)
+        server = _make_server(model_state, shape_set, cache_size=0,
+                              telemetry=telemetry, trace_ring=4096)
+        server.warm(graphs[0])
+        server.start()
+        server.predict(graphs[0], timeout_ms=30000, trace_id="both-1")
+        assert server.drain(timeout_s=30.0)
+        ring_ids = {e["args"].get("trace_id")
+                    for e in server.trace_window()["events"]
+                    if e["name"] == "serve.request"}
+        tel_ids = {e["args"].get("trace_id")
+                   for e in telemetry.spans.events
+                   if e["name"] == "serve.request"}
+        assert "both-1" in ring_ids and "both-1" in tel_ids
+        telemetry.close()
+        # a since cut in the future filters everything out
+        import time as _time
+
+        assert server.trace_window(
+            since_s=_time.time() + 60.0)["events"] == []
+
+
 # ------------------------------------------------- precision tiers (ISSUE 9)
 
 
