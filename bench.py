@@ -232,7 +232,7 @@ def _bench_force_workload(graphs, batch_size, *, dense_m=None, n_timed=16,
 # artifact reports PAIRED per-round ratios, which is what kills the
 # bench-link noise that muddied the r3->r5 trajectory.
 AB_FLAGS = ("cgconv", "fused-epilogue", "transpose", "compact", "precision",
-            "engine", "wire", "observe")
+            "engine", "wire", "observe", "slo")
 
 
 def _ab_train_variants(flag: str, graphs, batch_size, buckets):
@@ -349,6 +349,8 @@ def _run_ab(flag: str, *, n: int, batch_size: int, buckets: int,
         return _run_ab_wire(graphs, batch_size, rounds, cfg)
     if flag == "observe":
         return _run_ab_observe(graphs, batch_size, rounds)
+    if flag == "slo":
+        return _run_ab_slo(graphs, batch_size, rounds)
     variants = _ab_train_variants(flag, graphs, batch_size, buckets)
 
     def set_transpose(v):
@@ -660,6 +662,106 @@ def _run_ab_observe(graphs, batch_size, rounds) -> dict:
                     f"recorder + parent propagation on vs off)",
         "median_p99_ms": {n: round(float(np.median(v)), 3)
                           for n, v in p99s.items() if v},
+        "device": str(jax.devices()[0].device_kind),
+    })
+
+
+def _run_ab_slo(graphs, batch_size, rounds) -> dict:
+    """Serving-path A/B of the metrics-truth layer (ISSUE 16):
+    mergeable histograms + SLO engine + embedded tsdb collector ON vs
+    fully OFF, e2e rps/p99 through the in-process InferenceServer —
+    the same interleaved same-process protocol as the observe A/B
+    (§6b/§8). Both variants serve the SAME requests through the same
+    warmed programs; the delta is pure host bookkeeping (three
+    histogram observes + one SLO window record per request, plus one
+    registry-snapshot heartbeat thread). The trace ring is OFF in both
+    so the delta isolates this layer alone."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.serve.server import InferenceServer
+    from cgnn_tpu.serve.shapes import plan_shape_set
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.step import make_predict_step
+
+    batch_size = min(batch_size, 64)
+    model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
+                                dense_m=12)
+    ladder = plan_shape_set(graphs, batch_size, rungs=3, dense_m=12)
+    state = create_train_state(
+        model, ladder.pack_full([graphs[0]]),
+        make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10**9]),
+        Normalizer.fit(np.stack([np.array(g.target) for g in graphs])),
+    )
+    pstep = jax.jit(make_predict_step())
+    pool = [g for g in graphs if ladder.admits(g)][:512]
+
+    def build(on: bool) -> InferenceServer:
+        server = InferenceServer(
+            state, ladder, predict_step=pstep, cache_size=0,
+            max_queue=8192, pack_workers=0, trace_ring=0,
+            slo_layer=on, tsdb_interval_s=1.0,
+            log_fn=lambda *a, **k: None,
+        )
+        server.warm(pool[0])
+        server.start()
+        return server
+
+    servers = {"off": build(False), "slo-on": build(True)}
+    n_req, n_threads = 2048, 8
+
+    def drive(server: InferenceServer):
+        lat: list = []
+        lock = threading.Lock()
+
+        def client(ci: int) -> None:
+            vals = []
+            for i in range(n_req // n_threads):
+                g = pool[(ci * 997 + i) % len(pool)]
+                res = server.predict(g, timeout_ms=120000.0)
+                vals.append(res.latency_ms)
+            with lock:
+                lat.extend(vals)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"ab-slo-client-{i}")
+                   for i in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        return len(lat) / dt, float(np.percentile(np.asarray(lat), 99))
+
+    names = list(servers)
+    rows: list = []
+    p99s: dict = {n: [] for n in names}
+    for r in range(-1, rounds):  # round -1 = discarded burn-in
+        order = names[r % len(names):] + names[: r % len(names)]
+        for name in order:
+            rate, p99 = drive(servers[name])
+            if r >= 0:
+                rows.append({"round": r, "variant": name,
+                             "structs_per_sec": round(rate, 1),
+                             "p99_ms": round(p99, 3)})
+                p99s[name].append(p99)
+    hist_count = int(servers["slo-on"].hists[
+        "serve_latency_ms_hist"].count)
+    for s in servers.values():
+        s.drain(timeout_s=30.0)
+    return _ab_report("slo", names, rows, extra={
+        "workload": f"closed-loop serving, {n_req} requests x "
+                    f"{n_threads} client threads per round, in-process "
+                    f"InferenceServer batch={batch_size} (histograms + "
+                    f"SLO engine + tsdb heartbeat on vs off; trace "
+                    f"ring off in both)",
+        "median_p99_ms": {n: round(float(np.median(v)), 3)
+                          for n, v in p99s.items() if v},
+        "slo_on_hist_count": hist_count,
         "device": str(jax.devices()[0].device_kind),
     })
 

@@ -15,6 +15,14 @@ is admittable, 503 + Retry-After otherwise — same ready-vs-live split
 the replicas expose). ``GET /stats`` and ``GET /metrics`` expose the
 router's own counters, per-replica gauges, and rolling latency — the
 fleet-level twin of the replica plane.
+
+The metrics-truth surfaces (ISSUE 16): ``GET /metrics/fleet`` scrapes
+every replica's ``/metrics`` and merges the mergeable ``*_hist``
+histogram families into ONE fleet-wide exposition (bucket counts add
+associatively; labels preserved) — the cross-process latency truth
+per-replica quantile summaries cannot provide. ``GET /timeseries``
+serves the router's embedded multi-resolution history
+(``?name=&res=``), same shape as the replica endpoint.
 """
 
 from __future__ import annotations
@@ -67,14 +75,14 @@ def make_fleet_handler(router: FleetRouter):
             elif self.path == "/stats":
                 self._reply(200, router.stats())
             elif self.path == "/metrics":
-                body = router.registry.prometheus_text().encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type",
-                    "text/plain; version=0.0.4; charset=utf-8")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._reply_text(router.registry.prometheus_text())
+            elif self.path == "/metrics/fleet":
+                # scrape-and-merge (ISSUE 16): one fleet-wide histogram
+                # exposition, bit-identical in counts to pooling every
+                # replica's raw observations
+                self._reply_text(router.fleet_metrics_text())
+            elif self.path.split("?", 1)[0] == "/timeseries":
+                self._do_timeseries()
             elif self.path.split("?", 1)[0] in ("/trace", "/trace/joined"):
                 self._do_trace()
             elif self.path == "/flightrec":
@@ -87,6 +95,43 @@ def make_fleet_handler(router: FleetRouter):
                     self._reply(200, router.flightrec.snapshot())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _reply_text(self, text: str) -> None:
+            body = text.encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _do_timeseries(self) -> None:
+            from urllib.parse import parse_qs, urlsplit
+
+            if router.tsdb is None:
+                self._reply(501, {
+                    "error": "time-series store disabled "
+                             "(fleet.py --no-slo)",
+                })
+                return
+            q = parse_qs(urlsplit(self.path).query)
+            name = (q.get("name") or [""])[0]
+            res = (q.get("res") or ["10s"])[0]
+            if not name:
+                self._reply(200, {
+                    "names": router.tsdb.names(),
+                    "resolutions": router.tsdb.resolutions(),
+                    "stats": router.tsdb.stats(),
+                })
+                return
+            try:
+                points = router.tsdb.query(name, res)
+            except KeyError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            self._reply(200, {"name": name, "res": res,
+                              "points": points})
 
         def _do_trace(self) -> None:
             """`/trace` = the router's own span window; `/trace/joined`

@@ -92,6 +92,10 @@ class FleetRouter:
         default_timeout_ms: float = 30000.0,
         health_interval_s: float = 1.0,
         trace_ring: int = 65536,
+        slo_layer: bool = True,
+        slo_objectives=None,
+        slo_rules=None,
+        tsdb_interval_s: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
         rng: random.Random | None = None,
         log_fn: Callable = print,
@@ -146,6 +150,42 @@ class FleetRouter:
         # incident flight recorder (observe/flightrec.py), attached by
         # the entrypoint; breaker trips + 5xx bursts dump bundles
         self.flightrec = None
+        # ---- fleet SLO engine + metrics truth (ISSUE 16) ----
+        # the router's latency histogram is MERGEABLE (observe/hist.py)
+        # where the rolling quantiles above are local color; the SLO
+        # ledger is fed at ATTEMPT level (_attempt) — retries hide
+        # errors from clients, and they must NOT hide them from the
+        # error budget, or a fleet silently burning capacity on retried
+        # 500s looks healthy right up to exhaustion
+        from cgnn_tpu.observe.hist import LATENCY_MS_BOUNDS, Histogram
+        from cgnn_tpu.observe.slo import SLOEngine, SLOObjective
+        from cgnn_tpu.observe.tsdb import TimeSeriesStore, TsdbCollector
+
+        self.hists: dict[str, Histogram] = {}
+        self.slo = None
+        self.tsdb = None
+        self._tsdb_collector = None
+        if slo_layer:
+            self.hists = {
+                "fleet_latency_ms_hist": Histogram(LATENCY_MS_BOUNDS),
+                "fleet_attempt_latency_ms_hist": Histogram(
+                    LATENCY_MS_BOUNDS),
+            }
+            objectives = (tuple(slo_objectives) if slo_objectives else (
+                SLOObjective("fleet_availability", target=0.999,
+                             window_s=300.0),
+                SLOObjective("fleet_latency", target=0.95,
+                             latency_threshold_ms=2000.0, window_s=300.0),
+            ))
+            self.slo = SLOEngine(
+                objectives, rules=slo_rules, clock=clock,
+                on_fire=self._on_slo_fire, on_resolve=self._on_slo_resolve,
+            )
+            self.tsdb = TimeSeriesStore()
+            self._tsdb_collector = TsdbCollector(
+                self.registry, self.tsdb, interval_s=tsdb_interval_s,
+            )
+            self._tsdb_collector.add_on_tick(self._slo_tick)
 
     # ---- lifecycle ----
 
@@ -160,12 +200,62 @@ class FleetRouter:
                 target=self._health_loop, daemon=True, name="fleet-health"
             )
             self._health_thread.start()
+        if self._tsdb_collector is not None:
+            self._tsdb_collector.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         if self._health_thread is not None:
             self._health_thread.join(timeout=10.0)
+        if self._tsdb_collector is not None:
+            self._tsdb_collector.stop()
+
+    # ---- fleet SLO hooks (ISSUE 16) ----
+
+    def _slo_tick(self) -> None:
+        """Collector heartbeat: advance the alert state machines so
+        firing/resolved transitions happen on the clock, not only when
+        traffic arrives."""
+        if self.slo is not None:
+            self.slo.evaluate()
+
+    def _note_slo_attempt(self, ok: bool, lat_ms: float) -> None:
+        """One ATTEMPT into the error budget + the attempt histogram.
+        Attempt level is deliberate: the retry/hedge machinery above
+        turns upstream 500s into client 200s, and an error budget fed
+        at client level would sleep through exactly the incidents it
+        exists to catch."""
+        if self.slo is not None:
+            self.slo.record(ok, lat_ms)
+        h = self.hists.get("fleet_attempt_latency_ms_hist")
+        if h is not None:
+            h.observe(lat_ms)
+
+    def _on_slo_fire(self, tr: dict) -> None:
+        """Fleet burn-rate alert FIRING -> incident bundle whose
+        manifest names the alert (``slo_burn_<objective>``) — the
+        fleet_smoke pin."""
+        self._log(
+            f"fleet: SLO ALERT firing: objective={tr['objective']} "
+            f"rule={tr['rule']} burn_fast={tr['burn_fast']:.2f} "
+            f"burn_slow={tr['burn_slow']:.2f} (factor {tr['factor']:g})"
+        )
+        fr = self.flightrec
+        if fr is not None:
+            fr.trigger(
+                f"slo_burn_{tr['objective']}",
+                detail=(f"rule={tr['rule']} "
+                        f"burn_fast={tr['burn_fast']:.3f} "
+                        f"burn_slow={tr['burn_slow']:.3f} "
+                        f"factor={tr['factor']:g}"),
+            )
+
+    def _on_slo_resolve(self, tr: dict) -> None:
+        self._log(
+            f"fleet: SLO alert resolved: objective={tr['objective']} "
+            f"rule={tr['rule']}"
+        )
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_interval_s):
@@ -290,6 +380,10 @@ class FleetRouter:
             outcome = "rejections"
         replica.note_result(outcome, lat_ms if status == 200 else None,
                             version=version)
+        # attempt-level SLO feed (ISSUE 16): transport failures and 5xx
+        # burn budget; 4xx/429 are the request's fault or backpressure.
+        # Stragglers count too — the replica really did the work.
+        self._note_slo_attempt(err is None and status < 500, lat_ms)
         straggler = call.done.is_set()
         if self.tracer is not None:
             # one span per attempt, win or lose: the joined trace shows
@@ -472,6 +566,12 @@ class FleetRouter:
                     self._count("fleet_hedge_wins")
                 total_ms = (self._clock() - t_start) * 1e3
                 self._lat_rolling.add(total_ms)
+                h = self.hists.get("fleet_latency_ms_hist")
+                if h is not None:
+                    # client-perceived end-to-end latency (retries and
+                    # hedges folded in) — the mergeable twin of the
+                    # rolling quantiles above
+                    h.observe(total_ms)
                 return 200, payload, meta(rid)
             if err is None and status in PASSTHROUGH_STATUS:
                 # about the request, not the replica: hand it back
@@ -550,13 +650,19 @@ class FleetRouter:
     def stats(self) -> dict:
         with self._lock:
             counts = dict(self.counts)
-        return {
+        out = {
             "counts": counts,
             "replicas": {str(r.rid): r.stats() for r in self.replicas},
             "versions": {str(k): v for k, v in self.versions().items()},
             "ready": self.ready_count(),
             "rolling_latency_ms": self._lat_rolling.quantiles(),
         }
+        # fleet SLO + embedded tsdb health (ISSUE 16)
+        if self.slo is not None:
+            out["slo"] = self.slo.state()
+        if self.tsdb is not None:
+            out["tsdb"] = self.tsdb.stats()
+        return out
 
     def _registry_snapshot(self) -> dict:
         """The fleet provider behind GET /metrics: router counters,
@@ -600,4 +706,65 @@ class FleetRouter:
             rq = r.rolling.quantiles()
             if rq:
                 series[f"replica{i}_latency_ms"] = rq
-        return {"counters": counters, "gauges": gauges, "series": series}
+        out = {"counters": counters, "gauges": gauges, "series": series}
+        # the metrics-truth layer (ISSUE 16): mergeable histograms under
+        # distinct `_hist` names + SLO/tsdb health gauges
+        if self.hists:
+            out["histograms"] = {
+                name: h.snapshot() for name, h in self.hists.items()
+            }
+        if self.slo is not None:
+            gauges.update(self.slo.gauges())
+        if self.tsdb is not None:
+            ts = self.tsdb.stats()
+            gauges["tsdb_series"] = float(ts["series"])
+            gauges["tsdb_points"] = float(ts["points"])
+            gauges["tsdb_dropped_series"] = float(ts["dropped_series"])
+        return out
+
+    def fleet_metrics_text(self, timeout_s: float = 2.0) -> str:
+        """``GET /metrics/fleet``: scrape every replica's ``/metrics``,
+        merge the histogram families label-set by label-set, and render
+        ONE fleet-wide exposition.
+
+        This is the payoff of mergeable histograms (observe/hist.py):
+        per-replica quantile summaries cannot be combined, but bucket
+        counts add — the merged family here is BIT-IDENTICAL in counts
+        to a histogram of the pooled raw observations (pinned by
+        tests/test_slo.py). Labels are preserved through the merge;
+        scrape failures degrade (the family merges what answered, the
+        ``cgnn_fleet_scrape_errors`` gauge says so) rather than 500ing
+        the fleet view."""
+        from cgnn_tpu.fleet.replica import http_get_text
+        from cgnn_tpu.observe import hist as _hist
+        from cgnn_tpu.observe.export import parse_prometheus_text
+
+        per_family: dict = {}  # fullname -> [ {label_key: snapshot} ]
+        scraped = errors = 0
+        for r in self.replicas:
+            try:
+                text = http_get_text(r.base_url + "/metrics", timeout_s)
+                fams = parse_prometheus_text(text)
+            except Exception as e:  # noqa: BLE001 — degrade, don't 500
+                errors += 1
+                self._log(f"fleet: /metrics scrape {r.name} "
+                          f"failed: {e!r}")
+                continue
+            scraped += 1
+            for fname, fam in fams.items():
+                hmap = fam.get("histogram")
+                if fam.get("type") == "histogram" and hmap:
+                    per_family.setdefault(fname, []).append(hmap)
+        lines = [
+            "# TYPE cgnn_fleet_scrape_replicas gauge",
+            f"cgnn_fleet_scrape_replicas {float(scraped)}",
+            "# TYPE cgnn_fleet_scrape_errors gauge",
+            f"cgnn_fleet_scrape_errors {float(errors)}",
+        ]
+        for fname in sorted(per_family):
+            merged = _hist.merge_snapshot_maps(per_family[fname])
+            lines.append(f"# TYPE {fname} histogram")
+            for key in sorted(merged):
+                lines.extend(_hist.snapshot_exposition_lines(
+                    fname, merged[key], _hist.parse_labels(key)))
+        return "\n".join(lines) + "\n"

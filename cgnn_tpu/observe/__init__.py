@@ -32,6 +32,16 @@ from cgnn_tpu.observe.export import (
     parse_prometheus_text,
 )
 from cgnn_tpu.observe.flightrec import FlightRecorder
+from cgnn_tpu.observe.hist import (
+    LATENCY_MS_BOUNDS,
+    OCCUPANCY_BOUNDS,
+    QUEUE_WAIT_MS_BOUNDS,
+    Histogram,
+    log_bounds,
+    merge_snapshot_maps,
+    quantile_from_snapshot,
+    snapshots_from_family,
+)
 from cgnn_tpu.observe.gauges import (
     device_hbm_table_bytes,
     hbm_gauges,
@@ -52,9 +62,16 @@ from cgnn_tpu.observe.log import (
     setup_json_logging,
 )
 from cgnn_tpu.observe.profile import ProfileBusy, ProfileCapture, install_sigusr2
+from cgnn_tpu.observe.slo import (
+    BurnRateRule,
+    SLOEngine,
+    SLOObjective,
+    default_rules,
+)
 from cgnn_tpu.observe.spans import SpanTracer
 from cgnn_tpu.observe.stream import StepStream
 from cgnn_tpu.observe.telemetry import Telemetry
+from cgnn_tpu.observe.tsdb import TimeSeriesStore, TsdbCollector
 from cgnn_tpu.observe.tracectx import (
     TRACE_PARENT_HEADER,
     format_parent,
@@ -63,7 +80,12 @@ from cgnn_tpu.observe.tracectx import (
 )
 
 __all__ = [
+    "BurnRateRule",
     "FlightRecorder",
+    "Histogram",
+    "LATENCY_MS_BOUNDS",
+    "OCCUPANCY_BOUNDS",
+    "QUEUE_WAIT_MS_BOUNDS",
     "TRACE_PARENT_HEADER",
     "LiveMetricsWriter",
     "MetricsLogger",
@@ -71,12 +93,21 @@ __all__ = [
     "ProfileBusy",
     "ProfileCapture",
     "RollingSeries",
+    "SLOEngine",
+    "SLOObjective",
     "SpanTracer",
     "StepStream",
     "Telemetry",
+    "TimeSeriesStore",
+    "TsdbCollector",
     "bind_trace",
     "current_trace_id",
+    "default_rules",
     "format_parent",
+    "log_bounds",
+    "merge_snapshot_maps",
+    "quantile_from_snapshot",
+    "snapshots_from_family",
     "install_sigusr2",
     "json_log_fn",
     "mint_span_id",

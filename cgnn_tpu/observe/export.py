@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Callable
 
+from cgnn_tpu.observe import hist as _hist
 from cgnn_tpu.observe.metrics_io import jsonfinite
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -166,11 +167,15 @@ class MetricsRegistry:
     """The scrape point: telemetry buffers + provider callbacks, merged.
 
     Providers are zero-arg callables returning any of
-    ``{"counters": {...}, "gauges": {...}, "series": {name: quantiles}}``
-    — evaluated at snapshot time, so every scrape sees live values. A
-    provider that raises is skipped for that scrape (a broken gauge must
-    not take down ``/metrics``); the error is remembered in
-    ``last_provider_errors``.
+    ``{"counters": {...}, "gauges": {...}, "series": {name: quantiles},
+    "histograms": {name: snapshot}}`` — evaluated at snapshot time, so
+    every scrape sees live values. Histogram snapshots are
+    ``observe.hist.Histogram.snapshot()`` dicts and render as Prometheus
+    histogram families (cumulative ``_bucket``/``le`` + ``_sum`` +
+    ``_count``) — the MERGEABLE cross-process complement to the
+    per-process summary quantiles. A provider that raises is skipped for
+    that scrape (a broken gauge must not take down ``/metrics``); the
+    error is remembered in ``last_provider_errors``.
     """
 
     def __init__(self, namespace: str = "cgnn",
@@ -203,7 +208,7 @@ class MetricsRegistry:
         """
         window_s = self.window_s if window_s is None else window_s
         out = {"time": time.time(), "counters": {}, "gauges": {},
-               "series": {}}
+               "series": {}, "histograms": {}}
         t = self._telemetry
         if t is not None and getattr(t, "enabled", False):
             out["counters"].update(t.counters())
@@ -224,6 +229,7 @@ class MetricsRegistry:
             out["counters"].update(part.get("counters", {}))
             out["gauges"].update(part.get("gauges", {}))
             out["series"].update(part.get("series", {}))
+            out["histograms"].update(part.get("histograms", {}))
         return out
 
     # ---- Prometheus exposition ----
@@ -288,6 +294,21 @@ class MetricsRegistry:
                     lines.append(f"{full}_count {int(q['count'])}")
                 if "mean" in q and "count" in q:
                     lines.append(f"{full}_sum {q['mean'] * q['count']:g}")
+
+        # mergeable histogram families (observe/hist.py): cumulative
+        # _bucket/le + _sum/_count, bounds and sums rendered at full
+        # round-trip precision — the cross-process truth the fleet
+        # merge and the SLO engine consume
+        for name, hsnap in sorted(snap["histograms"].items()):
+            full = f"{ns}_{sanitize_metric_name(name)}"
+            try:
+                body = _hist.snapshot_exposition_lines(full, hsnap)
+            except Exception as e:  # noqa: BLE001 — a malformed provider
+                # snapshot must not take down the whole scrape
+                self.last_provider_errors[f"histogram:{name}"] = repr(e)
+                continue
+            lines.append(f"# TYPE {full} histogram")
+            lines.extend(body)
         return "\n".join(lines) + "\n"
 
 
@@ -299,6 +320,15 @@ def parse_prometheus_text(text: str) -> dict:
     Returns {family: {"type": str, "samples": [(labels, value), ...]}}.
     Raises ValueError on a line that is neither a comment, blank, nor a
     ``name[{labels}] value`` sample, or on an unparseable value.
+
+    Histogram families round-trip STRUCTURALLY: every declared-histogram
+    family is validated on parse (each ``_bucket`` carries ``le``,
+    cumulative counts are monotone non-decreasing in le order, ``+Inf``
+    equals ``_count``) and its reconstructed per-label-set snapshots —
+    ``observe.hist.Histogram.from_snapshot``-ready — land under the
+    family's ``"histogram"`` key. The fleet merge, the loadgen
+    distribution assert, and CI all consume THIS parser, so emitter and
+    validators cannot drift.
     """
     fams: dict[str, dict] = {}
     sample_re = re.compile(
@@ -334,6 +364,14 @@ def parse_prometheus_text(text: str) -> dict:
             base, {"type": declared_type.get(base, "untyped"), "samples": []}
         )
         fam["samples"].append((name + labels, fval))
+    for fname, fam in fams.items():
+        if fam["type"] == "histogram":
+            try:
+                fam["histogram"] = _hist.snapshots_from_family(fam)
+            except ValueError as e:
+                raise ValueError(
+                    f"invalid histogram family {fname!r}: {e}"
+                ) from None
     return fams
 
 

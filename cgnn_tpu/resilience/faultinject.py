@@ -21,10 +21,12 @@ provokes is reproducible:
 Serve-side fault points (ISSUE 14 — the chaos substrate the fleet
 harness drives; all counted over the SERVING dispatch stream):
 
-- ``dispatch_exc=N``       — raise ``InjectedDispatchError`` at the
-  N-th (0-based) flush dispatch: the flush fails alone, its futures get
-  the error, HTTP clients see a typed 500 — the fleet router's
-  retry-on-5xx path;
+- ``dispatch_exc=N[:COUNT]`` — raise ``InjectedDispatchError`` at the
+  N-th (0-based) flush dispatch, and with ``:COUNT`` at every dispatch
+  in ``[N, N+COUNT)`` (a sustained burst): each flush fails alone, its
+  futures get the error, HTTP clients see a typed 500 — the fleet
+  router's retry-on-5xx path, and (burst form) the error plateau that
+  drives the SLO burn-rate alert end to end (ISSUE 16);
 - ``wedge_flush=N[:SECS]`` — stall the N-th flush dispatch for SECS
   (default 600) seconds: the wedged-worker case the bounded
   ``--drain-timeout`` force-exit exists for;
@@ -82,8 +84,11 @@ class FaultPlan:
     crash_hit: int = 1
     crash_exit: bool = False
     loader_exc: int | None = None
-    # serve-side faults (ISSUE 14)
+    # serve-side faults (ISSUE 14); dispatch_exc_count > 1 turns the
+    # one-shot exception into a burst over [dispatch_exc,
+    # dispatch_exc + count) — the SLO-alert driver (ISSUE 16)
     dispatch_exc: int | None = None
+    dispatch_exc_count: int = 1
     wedge_flush: int | None = None
     wedge_secs: float = 600.0
     slow_dispatch_ms: float | None = None
@@ -114,7 +119,10 @@ class FaultPlan:
                     plan.crash_hit = int(fields[1])
                 plan.crash_exit = len(fields) > 2 and fields[2] == "exit"
             elif key == "dispatch_exc":
-                plan.dispatch_exc = int(value)
+                fields = value.split(":")
+                plan.dispatch_exc = int(fields[0])
+                if len(fields) > 1 and fields[1]:
+                    plan.dispatch_exc_count = max(1, int(fields[1]))
             elif key == "wedge_flush":
                 fields = value.split(":")
                 plan.wedge_flush = int(fields[0])
@@ -147,7 +155,14 @@ class FaultPlan:
         if self.loader_exc is not None:
             parts.append(f"loader exception @batch {self.loader_exc}")
         if self.dispatch_exc is not None:
-            parts.append(f"dispatch exception @flush {self.dispatch_exc}")
+            if self.dispatch_exc_count > 1:
+                parts.append(
+                    f"dispatch exceptions @flushes {self.dispatch_exc}.."
+                    f"{self.dispatch_exc + self.dispatch_exc_count - 1}"
+                )
+            else:
+                parts.append(
+                    f"dispatch exception @flush {self.dispatch_exc}")
         if self.wedge_flush is not None:
             parts.append(
                 f"wedge @flush {self.wedge_flush} ({self.wedge_secs:g} s)"
@@ -273,7 +288,8 @@ def dispatch_point() -> None:
         time.sleep(p.slow_dispatch_ms / 1e3)
     if p.wedge_flush is not None and i == p.wedge_flush:
         time.sleep(p.wedge_secs)
-    if p.dispatch_exc is not None and i == p.dispatch_exc:
+    if (p.dispatch_exc is not None
+            and p.dispatch_exc <= i < p.dispatch_exc + p.dispatch_exc_count):
         raise InjectedDispatchError(
             f"injected dispatch failure at flush {i}"
         )

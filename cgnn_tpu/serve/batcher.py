@@ -168,6 +168,7 @@ class MicroBatcher:
         max_queue: int = 256,
         max_wait_ms: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
+        queue_wait_hist=None,
     ):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -175,6 +176,11 @@ class MicroBatcher:
         self.max_queue = max_queue
         self.max_wait = max_wait_ms / 1000.0
         self._clock = clock
+        # mergeable queue-wait histogram (observe/hist.py, ISSUE 16):
+        # each fired request's enqueue->flush wait lands here at the
+        # flush decision, the queueing truth independent of pack/dispatch
+        # time downstream. None keeps the hot path untouched.
+        self.queue_wait_hist = queue_wait_hist
         self._queue: list[Request] = []
         # a plain Condition normally; instrumented (lock-order + held-by
         # tracking) under CGNN_TPU_RACECHECK=1 — racecheck.make_condition
@@ -288,6 +294,9 @@ class MicroBatcher:
                     sum(r.nodes for r in fired),
                     sum(r.edges for r in fired),
                 )
+            if self.queue_wait_hist is not None:
+                for r in fired:
+                    self.queue_wait_hist.observe((now - r.enqueued) * 1e3)
             self._flush_seq += 1
             return Flush(fired, shape, expired, reason,
                          flush_id=f"flush-{self._flush_seq:06d}",

@@ -30,7 +30,15 @@ lives in server.py; this module only translates wire <-> core:
 - ``GET /metrics``   Prometheus text exposition from the server's
   export registry (observe/export.py): serve_* counters, device
   gauges (one ``device`` label per chip), pipeline_* counters, and
-  rolling-window latency/occupancy summaries — scrape mid-load.
+  rolling-window latency/occupancy summaries — scrape mid-load. With
+  the SLO layer on (ISSUE 16) the scrape additionally carries the
+  MERGEABLE ``*_hist`` histogram families (latency, queue wait, flush
+  occupancy) the router's ``/metrics/fleet`` pools, plus ``slo_*``
+  error-budget gauges.
+- ``GET /timeseries`` the embedded multi-resolution history
+  (observe/tsdb.py): ``?name=<series>&res=<10s|1m|10m>`` returns the
+  bounded ring of ``{t, count, sum, min, max, last, mean}`` buckets;
+  no ``name`` returns the queryable index.
 - ``POST /profile``  bounded on-demand ``jax.profiler`` capture (body
   ``{"duration_ms": 500}``); 409 while one is running (captures are
   rejected, never stacked), 501 when no profile dir was configured.
@@ -211,6 +219,12 @@ def make_handler(server: InferenceServer):
                     200, server.registry.prometheus_text(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif self.path.split("?", 1)[0] == "/timeseries":
+                # the embedded time-series store (observe/tsdb.py,
+                # ISSUE 16): bounded multi-resolution history of every
+                # registry scalar — "what was the p99 ten minutes ago"
+                # without an external scraper
+                self._do_timeseries()
             elif self.path.split("?", 1)[0] == "/trace":
                 # the fleet-join surface (ISSUE 15): this process's
                 # bounded span ring as a self-describing window —
@@ -231,6 +245,36 @@ def make_handler(server: InferenceServer):
                     self._reply(200, server.flightrec.snapshot())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _do_timeseries(self) -> None:
+            from urllib.parse import parse_qs, urlsplit
+
+            if server.tsdb is None:
+                self._reply(501, {
+                    "error": "time-series store disabled "
+                             "(serve.py --no-slo)",
+                })
+                return
+            q = parse_qs(urlsplit(self.path).query)
+            name = (q.get("name") or [""])[0]
+            res = (q.get("res") or ["10s"])[0]
+            if not name:
+                # no name = the index: what can be queried, at which
+                # resolutions, and the store's own bounds/health
+                self._reply(200, {
+                    "names": server.tsdb.names(),
+                    "resolutions": server.tsdb.resolutions(),
+                    "stats": server.tsdb.stats(),
+                })
+                return
+            try:
+                points = server.tsdb.query(name, res)
+            except KeyError as e:
+                # a typo'd resolution must 400, not silently return []
+                self._reply(400, {"error": str(e)})
+                return
+            self._reply(200, {"name": name, "res": res,
+                              "points": points})
 
         def _do_trace(self) -> None:
             from cgnn_tpu.observe.trace_join import parse_since_query

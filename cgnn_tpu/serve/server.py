@@ -160,6 +160,10 @@ class InferenceServer:
         featurizer: Callable | None = None,
         raw_precheck: bool = True,
         trace_ring: int = 65536,
+        slo_layer: bool = True,
+        slo_objectives=None,
+        slo_rules=None,
+        tsdb_interval_s: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
         log_fn: Callable = print,
     ):
@@ -270,9 +274,53 @@ class InferenceServer:
         # current one packs and runs; 0 restores the in-line pack
         self._pack_workers = max(0, int(pack_workers))
         self.telemetry = telemetry or Telemetry.disabled()
+        # ---- metrics-truth layer (ISSUE 16) ----
+        # mergeable log-bucket histograms beside the rolling quantiles:
+        # per-process quantiles are local color — they CANNOT be merged
+        # across replicas — while integer bucket counts add associatively
+        # and commutatively, so the `_hist` families are what the
+        # router's /metrics/fleet pools into one fleet-wide truth. The
+        # SLO burn-rate engine and the embedded time-series ring ride
+        # the same switch (`slo_layer=False` is the A/B baseline,
+        # bench.py --ab slo). Pure host-side bookkeeping: served numbers
+        # are bit-exact either way and nothing is staged into jitted
+        # code.
+        from cgnn_tpu.observe.hist import (
+            LATENCY_MS_BOUNDS,
+            OCCUPANCY_BOUNDS,
+            QUEUE_WAIT_MS_BOUNDS,
+            Histogram,
+        )
+        from cgnn_tpu.observe.slo import SLOEngine, SLOObjective
+        from cgnn_tpu.observe.tsdb import TimeSeriesStore, TsdbCollector
+
+        self.hists: dict[str, Histogram] = {}
+        self.slo = None
+        self.tsdb = None
+        self._tsdb_collector = None
+        if slo_layer:
+            self.hists = {
+                "serve_latency_ms_hist": Histogram(LATENCY_MS_BOUNDS),
+                "serve_queue_wait_ms_hist": Histogram(QUEUE_WAIT_MS_BOUNDS),
+                "serve_flush_occupancy_hist": Histogram(OCCUPANCY_BOUNDS),
+            }
+            objectives = (tuple(slo_objectives) if slo_objectives else (
+                SLOObjective("availability", target=0.999, window_s=300.0),
+                SLOObjective("latency", target=0.95,
+                             latency_threshold_ms=1000.0, window_s=300.0),
+            ))
+            # clock matches the server's (injectable for tests); the
+            # fire hook reads self.flightrec at fire time, so attaching
+            # a recorder later still routes alerts into bundles
+            self.slo = SLOEngine(
+                objectives, rules=slo_rules, clock=clock,
+                on_fire=self._on_slo_fire, on_resolve=self._on_slo_resolve,
+            )
+            self.tsdb = TimeSeriesStore()
         self.batcher = MicroBatcher(
             shape_set, max_queue=max_queue, max_wait_ms=max_wait_ms,
             clock=clock,
+            queue_wait_hist=self.hists.get("serve_queue_wait_ms_hist"),
         )
         self.default_timeout = (
             None if default_timeout_ms is None else default_timeout_ms / 1000.0
@@ -339,6 +387,14 @@ class InferenceServer:
         self.registry = MetricsRegistry(window_s=self.rolling_window_s)
         self.registry.attach_telemetry(self.telemetry)
         self.registry.add_provider("serve", self._registry_snapshot)
+        if self.tsdb is not None:
+            # one heartbeat for the whole quantitative plane: registry
+            # snapshots -> tsdb rings, and the SLO state machines advance
+            # on the same tick (alerts resolve even with zero traffic)
+            self._tsdb_collector = TsdbCollector(
+                self.registry, self.tsdb, interval_s=tsdb_interval_s,
+            )
+            self._tsdb_collector.add_on_tick(self._slo_tick)
         # on-demand device profiling (observe/profile.py); wired by
         # enable_profiling — None until an output dir is chosen
         self.profiler = None
@@ -495,6 +551,59 @@ class InferenceServer:
         feed its burst trigger (serve/http.py calls note_http_status)."""
         self.flightrec = recorder
 
+    # ---- metrics-truth feeds (ISSUE 16) ----
+
+    def _observe_served(self, latency_ms: float) -> None:
+        """One answered request into the mergeable latency histogram +
+        the SLO good/bad ledger. Cache hits count: a client got an
+        answer either way, and the fleet-merged histogram must describe
+        the same population clients measure."""
+        h = self.hists.get("serve_latency_ms_hist")
+        if h is not None:
+            h.observe(latency_ms)
+        if self.slo is not None:
+            self.slo.record(True, latency_ms)
+
+    def _record_slo_bad(self) -> None:
+        """One failed request (dispatch failure / deadline expiry) into
+        the error-budget ledger. Admission rejections (queue-full,
+        oversize, malformed) are NOT budget burn — they are the server
+        protecting itself or the client's fault (the 429/400 class)."""
+        if self.slo is not None:
+            self.slo.record(False, 0.0)
+
+    def _slo_tick(self) -> None:
+        """Collector heartbeat: advance the alert state machines so
+        pending->firing (for_s held) and firing->resolved happen on the
+        clock, not only when traffic arrives."""
+        if self.slo is not None:
+            self.slo.evaluate()
+
+    def _on_slo_fire(self, tr: dict) -> None:
+        """Burn-rate alert FIRING -> incident capture: the reason names
+        the objective (``slo_burn_<objective>``) so the flight-recorder
+        bundle manifest identifies the alert — the fleet_smoke pin."""
+        self._log(
+            f"serve: SLO ALERT firing: objective={tr['objective']} "
+            f"rule={tr['rule']} burn_fast={tr['burn_fast']:.2f} "
+            f"burn_slow={tr['burn_slow']:.2f} (factor {tr['factor']:g})"
+        )
+        fr = self.flightrec
+        if fr is not None:
+            fr.trigger(
+                f"slo_burn_{tr['objective']}",
+                detail=(f"rule={tr['rule']} "
+                        f"burn_fast={tr['burn_fast']:.3f} "
+                        f"burn_slow={tr['burn_slow']:.3f} "
+                        f"factor={tr['factor']:g}"),
+            )
+
+    def _on_slo_resolve(self, tr: dict) -> None:
+        self._log(
+            f"serve: SLO alert resolved: objective={tr['objective']} "
+            f"rule={tr['rule']}"
+        )
+
     def trace_window(self, since_s: float | None = None) -> dict | None:
         """The `GET /trace` body: this process's span ring as a
         joinable window (observe/trace_join.py), or None when neither
@@ -579,7 +688,23 @@ class InferenceServer:
             q = roll.quantiles()
             if q:
                 series[name] = q
-        return {"counters": counters, "gauges": gauges, "series": series}
+        out = {"counters": counters, "gauges": gauges, "series": series}
+        # the metrics-truth layer (ISSUE 16): mergeable histogram
+        # snapshots under distinct `_hist` names — the summary families
+        # above keep their names (one TYPE per family), the histogram
+        # families are what /metrics/fleet pools across replicas
+        if self.hists:
+            out["histograms"] = {
+                name: h.snapshot() for name, h in self.hists.items()
+            }
+        if self.slo is not None:
+            gauges.update(self.slo.gauges())
+        if self.tsdb is not None:
+            ts = self.tsdb.stats()
+            gauges["tsdb_series"] = float(ts["series"])
+            gauges["tsdb_points"] = float(ts["points"])
+            gauges["tsdb_dropped_series"] = float(ts["dropped_series"])
+        return out
 
     # ---- lifecycle ----
 
@@ -595,6 +720,8 @@ class InferenceServer:
             self._worker.start()
         if self._watcher is not None:
             self._watcher.start()
+        if self._tsdb_collector is not None:
+            self._tsdb_collector.start()
         return self
 
     def attach_watcher(self, manager, poll_interval_s: float = 2.0,
@@ -646,6 +773,8 @@ class InferenceServer:
         self.begin_drain()
         if self._watcher is not None:
             self._watcher.stop()
+        if self._tsdb_collector is not None:
+            self._tsdb_collector.stop()
         if self._worker is not None:
             self._worker.join(timeout=timeout_s)
             done = not self._worker.is_alive()
@@ -851,6 +980,7 @@ class InferenceServer:
                     # different populations under a warm cache
                     self._record_latency(latency_ms)
                     self._lat_rolling.add(latency_ms)
+                    self._observe_served(latency_ms)
                     self.telemetry.observe_value("serve_latency_ms",
                                                  latency_ms)
                     if self._spans_on:
@@ -1128,6 +1258,7 @@ class InferenceServer:
             for r in flush.requests:
                 if not r.future.done():
                     r.future.set_error(e)
+                    self._record_slo_bad()
                     self._note_request(
                         trace_id=r.trace_id, status="dispatch_failed",
                         error=repr(e), precision=r.precision,
@@ -1234,6 +1365,7 @@ class InferenceServer:
                 device=shard, latency_ms=latency_ms, stamps=stamps)
             self._record_latency(latency_ms)
             self._lat_rolling.add(latency_ms)
+            self._observe_served(latency_ms)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
             self._count("responses")
             if wire == "raw":
@@ -1245,11 +1377,15 @@ class InferenceServer:
             self._occupancies.append(occupancy)
             del self._occupancies[:-4096]
         self._occ_rolling.add(occupancy)
+        oh = self.hists.get("serve_flush_occupancy_hist")
+        if oh is not None:
+            oh.observe(occupancy)
         self.telemetry.observe_value("serve_batch_occupancy", occupancy)
         self.telemetry.set_gauge("serve_queue_depth", self.batcher.depth)
 
     def _fail_expired(self, flush: Flush) -> None:
         for r in flush.expired:
+            self._record_slo_bad()
             self._count("reject_timeout")
             self._note_request(trace_id=r.trace_id, status="timeout",
                               precision=r.precision)
@@ -1376,6 +1512,7 @@ class InferenceServer:
             for r in flush.requests:
                 if not r.future.done():
                     r.future.set_error(e)
+                    self._record_slo_bad()
                     self._note_request(
                         trace_id=r.trace_id, status="dispatch_failed",
                         error=repr(e), precision=r.precision,
@@ -1487,6 +1624,7 @@ class InferenceServer:
                 device=device, latency_ms=latency_ms, stamps=stamps)
             self._record_latency(latency_ms)
             self._lat_rolling.add(latency_ms)
+            self._observe_served(latency_ms)
             # per REQUEST, not per batch: the run-summary quantiles must
             # describe the same distribution stats() does (PERF.md §10)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
@@ -1500,6 +1638,9 @@ class InferenceServer:
             self._occupancies.append(occupancy)
             del self._occupancies[:-4096]
         self._occ_rolling.add(occupancy)
+        oh = self.hists.get("serve_flush_occupancy_hist")
+        if oh is not None:
+            oh.observe(occupancy)
         self.telemetry.observe_value("serve_batch_occupancy", occupancy)
         self.telemetry.set_gauge("serve_queue_depth", self.batcher.depth)
 
@@ -1639,6 +1780,12 @@ class InferenceServer:
         if self._watcher is not None:
             out["reload"] = {"swaps": self._watcher.swaps,
                              "skips": self._watcher.skips}
+        # the metrics-truth layer (ISSUE 16): error-budget accounting +
+        # alert states, and the embedded time-series store's own health
+        if self.slo is not None:
+            out["slo"] = self.slo.state()
+        if self.tsdb is not None:
+            out["tsdb"] = self.tsdb.stats()
         return out
 
 
@@ -1694,6 +1841,9 @@ def load_server(
     engine: str = "auto",
     precision: str = "f32",
     trace_ring: int = 65536,
+    slo_layer: bool = True,
+    slo_objectives=None,
+    slo_rules=None,
     watch: bool = True,
     warm: bool = True,
     poll_interval_s: float = 2.0,
@@ -1861,7 +2011,9 @@ def load_server(
         pack_workers=pack_workers, devices=device_list, engine=engine,
         precisions=precisions, model=model,
         featurizer=structure_featurizer(data_cfg),
-        raw_precheck=raw_precheck, trace_ring=trace_ring, log_fn=log_fn,
+        raw_precheck=raw_precheck, trace_ring=trace_ring,
+        slo_layer=slo_layer, slo_objectives=slo_objectives,
+        slo_rules=slo_rules, log_fn=log_fn,
     )
     # ``warm=False`` (ISSUE 14): the caller compiles later — serve.py
     # binds its HTTP listener FIRST so /healthz can report ready=False

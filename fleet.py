@@ -6,8 +6,11 @@ directory, fronts them with a health-routed resilient router (bounded
 retries + backoff, deadline-aware hedging, per-replica circuit
 breakers, 503 + Retry-After load shedding), and serves the same
 ``POST /predict`` wire protocol a single replica does — plus
-``GET /healthz`` (fleet readiness), ``GET /stats``, and
-``GET /metrics`` (router counters + per-replica gauges/series).
+``GET /healthz`` (fleet readiness), ``GET /stats``, ``GET /metrics``
+(router counters + per-replica gauges/series), ``GET /metrics/fleet``
+(replica histogram families scraped and MERGED into one fleet-wide
+exposition — ISSUE 16), and ``GET /timeseries`` (the router's embedded
+multi-resolution history).
 
 The replicas share the checkpoint directory, so a rolling promotion is
 just the trainer committing a new save: every replica's own hot-reload
@@ -84,6 +87,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="structured JSON log lines (role + pid + "
                         "current trace id); also passed to every "
                         "replica")
+    # ---- fleet SLO engine (ISSUE 16) ----
+    p.add_argument("--no-slo", action="store_true",
+                   help="disable the fleet SLO engine, the mergeable "
+                        "histogram families, and the embedded "
+                        "time-series store (the A/B baseline)")
+    p.add_argument("--slo-target", type=float, default=0.999,
+                   help="fleet availability objective (fraction of "
+                        "attempts that must succeed)")
+    p.add_argument("--slo-latency-ms", type=float, default=2000.0,
+                   help="latency objective threshold: 95%% of answered "
+                        "attempts must land under this")
+    p.add_argument("--slo-window", type=float, default=300.0,
+                   help="error-budget accounting window (seconds)")
+    p.add_argument("--slo-fast-s", type=float, default=None,
+                   help="burn-rate rule override: fast window seconds "
+                        "(default: the two standard pairs scaled to "
+                        "--slo-window; set BOTH --slo-fast-s and "
+                        "--slo-slow-s to override)")
+    p.add_argument("--slo-slow-s", type=float, default=None,
+                   help="burn-rate rule override: slow window seconds")
+    p.add_argument("--slo-factor", type=float, default=6.0,
+                   help="burn-rate rule override: burn factor both "
+                        "windows must exceed")
+    p.add_argument("--slo-for-s", type=float, default=0.0,
+                   help="burn-rate rule override: hold time before "
+                        "pending becomes firing")
     return p
 
 
@@ -124,6 +153,24 @@ def main(argv=None) -> int:
                      breaker_cooldown_s=args.breaker_cooldown)
         for p in procs
     ]
+    # fleet SLO engine (ISSUE 16): objectives from the flags; burn-rate
+    # rules default to the standard pairs scaled to the window, with a
+    # single-rule override for second-scale windows (the smoke legs)
+    slo_objectives = slo_rules = None
+    if not args.no_slo:
+        from cgnn_tpu.observe.slo import BurnRateRule, SLOObjective
+
+        slo_objectives = (
+            SLOObjective("fleet_availability", target=args.slo_target,
+                         window_s=args.slo_window),
+            SLOObjective("fleet_latency", target=0.95,
+                         latency_threshold_ms=args.slo_latency_ms,
+                         window_s=args.slo_window),
+        )
+        if args.slo_fast_s is not None and args.slo_slow_s is not None:
+            slo_rules = (BurnRateRule(
+                fast_s=args.slo_fast_s, slow_s=args.slo_slow_s,
+                factor=args.slo_factor, for_s=args.slo_for_s),)
     router = FleetRouter(
         replicas,
         max_attempts=args.retries + 1,
@@ -132,6 +179,9 @@ def main(argv=None) -> int:
         default_timeout_ms=args.timeout_ms,
         health_interval_s=args.health_interval,
         trace_ring=args.trace_ring,
+        slo_layer=not args.no_slo,
+        slo_objectives=slo_objectives,
+        slo_rules=slo_rules,
         log_fn=log,
     ).start()
 

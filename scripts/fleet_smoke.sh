@@ -34,6 +34,16 @@
 #     SIGTERM; the bounded --drain-timeout must force-exit non-zero
 #     with the unanswered count logged (a wedged flush must not hold
 #     shutdown forever).
+#  5. SLO LEG (ISSUE 16, --slo-report): an injected 5xx burst on one
+#     replica (breaker effectively off so the burst is not quenched)
+#     must walk the router's burn-rate alert inactive -> pending ->
+#     firing -> resolved on second-scale rule windows AND dump a
+#     flight-recorder bundle whose MANIFEST names the alert
+#     (slo_burn_fleet_availability); plus the metrics-truth pins: the
+#     router's /metrics/fleet histogram merge bit-identical to pooling
+#     every replica's own scrape, the router's fleet latency histogram
+#     count EXACTLY equal to answered requests, and its median in
+#     agreement with the client-measured p50 within bucket resolution.
 #
 # Runs anywhere jax[cpu] does (synthetic data, CPU device).
 set -euo pipefail
@@ -309,5 +319,41 @@ grep -q "drain timed out" "$WORK/wedge.log"
 grep -q "unanswered" "$WORK/wedge.log"
 grep -q "force-exiting" "$WORK/wedge.log"
 echo "leg 4 ok: wedged drain force-exited rc=$RC with unanswered count logged"
+
+echo "== leg 5: 5xx burst -> burn-rate alert -> evidence bundle =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 3 --fleet-base-port "$((BASE + 45))" \
+  --fleet-log-dir "$WORK/fleet5-logs" \
+  --clients 16 --duration 25 \
+  --replica-faults "dispatch_exc=15:150" --faulty-replica 2 \
+  --breaker-k 999 --hedge-ms 0 --expect-retries --no-scrape \
+  --slo-report \
+  --report "$WORK/fleet_slo.json"
+python - "$WORK/fleet_slo.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+slo = r["fleet"]["slo"]
+assert slo["merge_bitexact"], slo["merge_mismatches"]
+assert "cgnn_serve_latency_ms_hist" in slo["hist_families"], slo
+lt = slo["latency_truth"]
+assert lt["count_exact"] and lt["count_covers_answered"], lt
+assert lt["p50_agree"], lt
+alert = slo["alert"]
+assert "fired_at_s" in alert and "resolved_at_s" in alert, alert
+assert alert["resolved_at_s"] > alert["fired_at_s"], alert
+# the firing transition dumped an evidence bundle whose MANIFEST names
+# the alert as its trigger reason — the ISSUE-16 page-as-bundle pin
+assert slo["slo_bundles"], slo
+b = slo["slo_bundles"][0]
+assert b["reason"] == "slo_burn_fleet_availability", b
+assert "burn_fast" in b["detail"], b
+print("leg 5 ok:", r["answered"], "answered | alert fired",
+      alert["fired_at_s"], "s, resolved", alert["resolved_at_s"],
+      "s | fleet merge bit-exact over", len(slo["hist_families"]),
+      "histogram families | router hist count", lt["hist_count"],
+      "== answered, p50", lt["hist_p50_ms"], "~", lt["measured_p50_ms"],
+      "ms | bundle:", b["bundle"])
+EOF
 
 echo "fleet smoke: ALL LEGS PASSED"

@@ -114,6 +114,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="span-ring size for the server/router under "
                         "test (0 disables the cross-process trace "
                         "layer — the PERF.md §18 A/B baseline)")
+    p.add_argument("--slo-report", action="store_true",
+                   help="fleet mode (ISSUE 16): run the metrics-truth "
+                        "leg — second-scale burn-rate rules on the "
+                        "router's SLO engine; an injected 5xx burst "
+                        "(--replica-faults 'dispatch_exc=START:COUNT', "
+                        "pair with a high --breaker-k so the burst is "
+                        "not breaker-quenched) must walk the alert "
+                        "inactive -> pending -> firing -> resolved AND "
+                        "dump a flight-recorder bundle whose manifest "
+                        "names the alert; plus the fleet histogram "
+                        "truth check: the router's /metrics/fleet "
+                        "merge must be bit-identical to merging every "
+                        "replica's own scrape, cover every answered "
+                        "request, and agree with the client-measured "
+                        "latency distribution (all hard-asserted)")
     p.add_argument("--clients", type=int, default=64)
     p.add_argument("--duration", type=float, default=10.0,
                    help="seconds of open-loop load")
@@ -324,6 +339,143 @@ def _scrape_check(text: str, scraped_p99: float,
     out["tolerance_ms"] = round(tol, 2)
     out["agree"] = abs(scraped_p99 - measured_p99) <= tol
     return out
+
+
+def _fleet_hist_check(router, procs, stats) -> dict:
+    """The metrics-truth pin (ISSUE 16), run AFTER the load quiesces so
+    the replica histograms are static: scrape every replica's /metrics
+    directly over real HTTP, merge the mergeable ``*_hist`` families
+    locally, and compare against the router's own ``/metrics/fleet``
+    scrape-and-merge — bucket counts AND sums must be bit-identical
+    (integer counts add associatively; the exposition round-trips
+    floats via repr). Then the merged latency histogram is checked
+    against the clients' OWN measurements: its total count must cover
+    every answered request (hedge stragglers and retried serves may add
+    more, never fewer) and its median must agree with the measured p50
+    within bucket resolution (x10^(1/6) ~ 1.47) plus a router/HTTP
+    overhead margin."""
+    import urllib.request
+
+    import numpy as np
+
+    from cgnn_tpu.observe.export import parse_prometheus_text
+    from cgnn_tpu.observe.hist import (
+        merge_snapshot_maps,
+        quantile_from_snapshot,
+    )
+
+    out: dict = {"replicas_scraped": 0}
+    fam_maps: dict[str, list] = {}
+    for p in procs:
+        try:
+            with urllib.request.urlopen(p.base_url + "/metrics",
+                                        timeout=10.0) as resp:
+                text = resp.read().decode()
+            fams = parse_prometheus_text(text)
+        except Exception as e:  # noqa: BLE001 — reported as a failure
+            out.setdefault("scrape_errors", []).append(repr(e))
+            continue
+        out["replicas_scraped"] += 1
+        for name, fam in fams.items():
+            if "histogram" in fam:
+                fam_maps.setdefault(name, []).append(fam["histogram"])
+    pooled = {name: merge_snapshot_maps(maps)
+              for name, maps in fam_maps.items()}
+
+    mismatches = []
+    try:
+        fleet_fams = parse_prometheus_text(router.fleet_metrics_text())
+    except ValueError as e:
+        fleet_fams = {}
+        mismatches.append(f"/metrics/fleet did not parse: {e}")
+    for name, merged in pooled.items():
+        fhist = fleet_fams.get(name, {}).get("histogram")
+        if fhist is None:
+            mismatches.append(f"{name}: missing from /metrics/fleet")
+            continue
+        for key, snap in merged.items():
+            fsnap = fhist.get(key)
+            if fsnap is None:
+                mismatches.append(f"{name}{{{key}}}: label set missing "
+                                  f"from the fleet merge")
+            elif (fsnap["counts"] != snap["counts"]
+                  or fsnap["count"] != snap["count"]
+                  or fsnap["sum"] != snap["sum"]):
+                mismatches.append(
+                    f"{name}{{{key}}}: fleet merge != pooled replica "
+                    f"scrapes (count {fsnap['count']} vs "
+                    f"{snap['count']}, sum {fsnap['sum']} vs "
+                    f"{snap['sum']})")
+    out["hist_families"] = sorted(pooled)
+    out["merge_mismatches"] = mismatches
+    out["merge_bitexact"] = not mismatches and bool(pooled)
+
+    # the distribution truth is checked against the ROUTER's own fleet
+    # latency histogram: it observes the same per-request total_ms the
+    # clients record, so the count must match EXACTLY and the median
+    # must agree within bucket resolution. The replica-side serve
+    # histogram measures a different quantity (serve-core latency —
+    # sub-ms on a cache hit) so it only gets a coverage bound.
+    with stats.lock:
+        lats = list(stats.latencies)
+        answered = stats.answered
+    fleet_lat = None
+    try:
+        router_fams = parse_prometheus_text(
+            router.registry.prometheus_text())
+        fleet_lat = router_fams.get(
+            "cgnn_fleet_latency_ms_hist", {}).get("histogram", {}).get("")
+    except ValueError as e:
+        out["router_scrape_error"] = str(e)
+    serve_snap = pooled.get("cgnn_serve_latency_ms_hist", {}).get("")
+    if fleet_lat is not None and lats:
+        hist_p50 = quantile_from_snapshot(fleet_lat, 0.5)
+        measured_p50 = float(np.percentile(np.asarray(lats), 50))
+        # one log-spaced bucket of slack (x10^(1/6) ~ 1.47, padded to
+        # 1.6) plus a small absolute floor for sub-ms medians
+        lo = hist_p50 / 1.6 - 5.0
+        hi = hist_p50 * 1.6 + 5.0
+        out["latency_truth"] = {
+            "hist_count": fleet_lat["count"],
+            "answered": answered,
+            "count_exact": fleet_lat["count"] == answered,
+            "hist_p50_ms": round(hist_p50, 3),
+            "measured_p50_ms": round(measured_p50, 3),
+            "p50_agree": lo <= measured_p50 <= hi,
+            "replica_hist_count": (serve_snap or {}).get("count"),
+            "count_covers_answered": (
+                serve_snap is not None
+                and serve_snap["count"] >= answered),
+        }
+    else:
+        out["latency_truth"] = {
+            "error": "no cgnn_fleet_latency_ms_hist on the router",
+            "count_exact": False,
+            "count_covers_answered": False,
+            "p50_agree": False,
+        }
+    return out
+
+
+def _slo_bundle_manifests(flightrec_dir: str) -> list:
+    """Flight-recorder bundles whose MANIFEST names an SLO alert as the
+    trigger reason — the ISSUE-16 page-as-evidence-bundle contract."""
+    found = []
+    try:
+        names = sorted(os.listdir(flightrec_dir))
+    except OSError:
+        return found
+    for d in names:
+        mpath = os.path.join(flightrec_dir, d, "manifest.json")
+        try:
+            with open(mpath) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if str(m.get("reason", "")).startswith("slo_burn_"):
+            found.append({"bundle": d, "reason": m["reason"],
+                          "detail": m.get("detail", "")})
+    return found
 
 
 def _run_inproc(args) -> dict:
@@ -774,6 +926,22 @@ def _run_fleet(args) -> dict:
                              breaker_k=args.breaker_k,
                              breaker_cooldown_s=args.breaker_cooldown)
                 for p in procs]
+    slo_kw: dict = {}
+    if args.slo_report:
+        # second-scale burn-rate rules (ISSUE 16) so the injected 5xx
+        # burst walks the full inactive -> pending -> firing ->
+        # resolved arc inside one smoke run: fire when BOTH the 2 s and
+        # 8 s windows burn >2x the 99.9% budget for 0.5 s; resolve
+        # within ~8 s of the burst ending (the router's tsdb heartbeat
+        # keeps evaluating with zero traffic)
+        from cgnn_tpu.observe.slo import BurnRateRule, SLOObjective
+
+        slo_kw = {
+            "slo_objectives": (SLOObjective(
+                "fleet_availability", target=0.999, window_s=60.0),),
+            "slo_rules": (BurnRateRule(fast_s=2.0, slow_s=8.0,
+                                       factor=2.0, for_s=0.5),),
+        }
     router = FleetRouter(
         replicas,
         max_attempts=args.retries + 1,
@@ -781,6 +949,7 @@ def _run_fleet(args) -> dict:
         default_timeout_ms=args.timeout_ms,
         health_interval_s=0.5,
         trace_ring=args.trace_ring,
+        **slo_kw,
     ).start()
 
     # the incident flight recorder under test (ISSUE 15): breaker trips
@@ -800,6 +969,12 @@ def _run_fleet(args) -> dict:
             peers=router.replica_trace_urls(),
             manifest={"ckpt_dir": args.ckpt_dir, "replicas": n},
             log_fn=print,
+            # short quiet window: the chaos legs WANT each distinct
+            # trigger captured — a kill's breaker_trip must not
+            # rate-limit away the replica_unreachable bundle one probe
+            # round (0.5 s) later, which is the one whose joined trace
+            # provably holds the completed retries
+            min_interval_s=0.25,
         )
         router.attach_flight_recorder(recorder)
 
@@ -936,6 +1111,34 @@ def _run_fleet(args) -> dict:
     for t in side:
         t.start()
 
+    # ---- the SLO alert watcher (ISSUE 16, --slo-report) ----
+    slo_thread = None
+    slo_timeline: dict = {}
+    if args.slo_report and router.slo is not None:
+
+        def slo_watch():
+            # record the alert state machine live: the first firing and
+            # the resolution that must follow once the burst's bad
+            # events age out of the slow window
+            deadline = time.monotonic() + args.duration + 75.0
+            while time.monotonic() < deadline:
+                firing = router.slo.firing()
+                now_s = round(time.monotonic() - t_start, 2)
+                if firing and "fired_at_s" not in slo_timeline:
+                    slo_timeline["fired_at_s"] = now_s
+                    slo_timeline["fired"] = [
+                        {"objective": f["objective"], "rule": f["rule"],
+                         "fire_count": f["fire_count"]}
+                        for f in firing]
+                if not firing and "fired_at_s" in slo_timeline:
+                    slo_timeline["resolved_at_s"] = now_s
+                    return
+                time.sleep(0.2)
+
+        slo_thread = threading.Thread(target=slo_watch, daemon=True,
+                                      name="loadgen-slo-watch")
+        slo_thread.start()
+
     # the X-Request-Id / idempotency-key contract through the router:
     # an explicit trace id must ride every attempt and echo back
     probe_trace = None
@@ -999,6 +1202,22 @@ def _run_fleet(args) -> dict:
     if scraper.is_alive():
         scraper.join(timeout=30.0)
     wall = time.monotonic() - t_start
+    slo_report: dict = {}
+    if slo_thread is not None:
+        # the resolve leg may land AFTER the load ends (the router's
+        # tsdb heartbeat keeps evaluating with zero traffic), so wait
+        # for the watcher BEFORE stopping the router; the quiesced
+        # histogram truth check also needs the replicas still serving
+        # their /metrics plane
+        slo_thread.join(timeout=90.0)
+        slo_report["alert"] = dict(slo_timeline)
+        slo_report["engine"] = router.slo.state()
+        slo_report.update(_fleet_hist_check(router, procs, stats))
+        if recorder is not None:
+            recorder.wait_idle(timeout_s=60.0)
+            slo_report["flightrec"] = recorder.stats()
+            slo_report["slo_bundles"] = _slo_bundle_manifests(
+                flightrec_dir)
     router.stop()
     router_stats = router.stats()
     if chaos_log.get("restart_ready"):
@@ -1032,19 +1251,32 @@ def _run_fleet(args) -> dict:
             frs = recorder.stats()
             observe_report["flightrec"] = frs
             if frs["last_bundle"]:
-                bundle_trace = os.path.join(frs["last_bundle"],
-                                            "trace.json")
-                bundle_cross = []
+                # scan EVERY bundle's joined trace, not just the last:
+                # the kill-instant breaker_trip bundle can legitimately
+                # predate the first completed retry (its join then holds
+                # no cross-process request yet); the ~0.5 s-later
+                # replica_unreachable bundle is the deterministic one
+                bundle_cross_max = 0
                 try:
-                    with open(bundle_trace) as f:
-                        bundle_cross = trace_join.cross_process_traces(
-                            json.load(f))
-                except (OSError, ValueError) as e:
-                    observe_report["bundle_trace_error"] = repr(e)
+                    bundle_dirs = sorted(
+                        os.path.join(flightrec_dir, d)
+                        for d in os.listdir(flightrec_dir)
+                        if d.startswith("bundle-"))
+                except OSError:
+                    bundle_dirs = [frs["last_bundle"]]
+                for bdir in bundle_dirs:
+                    try:
+                        with open(os.path.join(bdir, "trace.json")) as f:
+                            bundle_cross_max = max(
+                                bundle_cross_max,
+                                len(trace_join.cross_process_traces(
+                                    json.load(f))))
+                    except (OSError, ValueError) as e:
+                        observe_report["bundle_trace_error"] = repr(e)
                 observe_report["bundle_files"] = sorted(
                     os.listdir(frs["last_bundle"]))
-                observe_report["bundle_cross_process_requests"] = len(
-                    bundle_cross)
+                observe_report["bundle_cross_process_requests"] = (
+                    bundle_cross_max)
     exit_codes = [p.terminate(timeout_s=60.0) for p in procs]
 
     lat = np.asarray(stats.latencies) if stats.latencies else np.zeros(1)
@@ -1106,6 +1338,8 @@ def _run_fleet(args) -> dict:
     }
     if scrape:
         report["fleet"]["metrics_scrape"] = scrape
+    if slo_report:
+        report["fleet"]["slo"] = slo_report
     return report
 
 
@@ -1643,6 +1877,61 @@ def main(argv=None) -> int:
                         f"flight-recorder bundle is missing the "
                         f"recent-request ring: {obs.get('bundle_files')}"
                     )
+        if args.slo_report:
+            # ---- the ISSUE-16 metrics-truth asserts, all HARD ----
+            slo = fl.get("slo", {})
+            if not slo:
+                failures.append(
+                    "--slo-report set but the SLO layer never ran "
+                    "(router built without it?)"
+                )
+            else:
+                if not slo.get("merge_bitexact"):
+                    failures.append(
+                        f"fleet-merged histograms are not bit-identical "
+                        f"to pooling every replica's own scrape: "
+                        f"{slo.get('merge_mismatches')}"
+                    )
+                lt = slo.get("latency_truth", {})
+                if not lt.get("count_exact"):
+                    failures.append(
+                        f"router fleet latency histogram count != "
+                        f"answered requests: {lt}"
+                    )
+                if not lt.get("count_covers_answered"):
+                    failures.append(
+                        f"merged replica latency histogram does not "
+                        f"cover every answered request: {lt}"
+                    )
+                if not lt.get("p50_agree"):
+                    failures.append(
+                        f"merged-histogram median disagrees with the "
+                        f"client-measured p50 beyond bucket resolution "
+                        f"+ overhead margin: {lt}"
+                    )
+                alert = slo.get("alert", {})
+                if "fired_at_s" not in alert:
+                    failures.append(
+                        "burn-rate alert never fired under the "
+                        "injected 5xx burst"
+                    )
+                elif "resolved_at_s" not in alert:
+                    failures.append(
+                        f"burn-rate alert fired at "
+                        f"{alert['fired_at_s']} s but never resolved"
+                    )
+                if "flightrec" in slo:
+                    trig = slo["flightrec"].get("triggers", {})
+                    if not any(k.startswith("slo_burn_") for k in trig):
+                        failures.append(
+                            f"firing SLO alert never triggered a "
+                            f"flight-recorder dump (triggers: {trig})"
+                        )
+                    elif not slo.get("slo_bundles"):
+                        failures.append(
+                            "no flight-recorder bundle manifest names "
+                            "an slo_burn_* trigger reason"
+                        )
     # racecheck leg (CGNN_TPU_RACECHECK=1): the runtime lock-discipline
     # report rides the SLO report and fails the run like any other
     # invariant — zero lock-order inversions, zero unguarded shared-field

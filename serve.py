@@ -117,6 +117,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="structured JSON log lines (role + pid + "
                         "current trace id per line) instead of plain "
                         "prints — bundle logs then grep by trace id")
+    # ---- SLO engine + metrics truth (ISSUE 16) ----
+    p.add_argument("--no-slo", action="store_true",
+                   help="disable the SLO engine, the mergeable "
+                        "histogram families, and the embedded "
+                        "time-series store (the A/B baseline)")
+    p.add_argument("--slo-target", type=float, default=0.999,
+                   help="availability objective (fraction of requests "
+                        "that must be answered)")
+    p.add_argument("--slo-latency-ms", type=float, default=1000.0,
+                   help="latency objective threshold: 95%% of answers "
+                        "must land under this")
+    p.add_argument("--slo-window", type=float, default=300.0,
+                   help="error-budget accounting window (seconds)")
+    p.add_argument("--slo-fast-s", type=float, default=None,
+                   help="burn-rate rule override: fast window seconds "
+                        "(set BOTH --slo-fast-s and --slo-slow-s; "
+                        "default: the standard pairs scaled to "
+                        "--slo-window)")
+    p.add_argument("--slo-slow-s", type=float, default=None,
+                   help="burn-rate rule override: slow window seconds")
+    p.add_argument("--slo-factor", type=float, default=6.0,
+                   help="burn-rate rule override: burn factor")
+    p.add_argument("--slo-for-s", type=float, default=0.0,
+                   help="burn-rate rule override: hold time before "
+                        "pending becomes firing")
     return p
 
 
@@ -159,6 +184,24 @@ def main(argv=None) -> int:
     if profile_dir == "auto":
         profile_dir = args.telemetry_dir or os.path.join(
             args.ckpt_dir, "profiles")
+    # SLO engine (ISSUE 16): objectives from the flags; rules default to
+    # the standard pairs scaled to the window unless both --slo-fast-s
+    # and --slo-slow-s override (second-scale windows for smoke tests)
+    slo_objectives = slo_rules = None
+    if not args.no_slo:
+        from cgnn_tpu.observe.slo import BurnRateRule, SLOObjective
+
+        slo_objectives = (
+            SLOObjective("availability", target=args.slo_target,
+                         window_s=args.slo_window),
+            SLOObjective("latency", target=0.95,
+                         latency_threshold_ms=args.slo_latency_ms,
+                         window_s=args.slo_window),
+        )
+        if args.slo_fast_s is not None and args.slo_slow_s is not None:
+            slo_rules = (BurnRateRule(
+                fast_s=args.slo_fast_s, slow_s=args.slo_slow_s,
+                factor=args.slo_factor, for_s=args.slo_for_s),)
     try:
         server, parts = load_server(
             args.ckpt_dir,
@@ -185,6 +228,9 @@ def main(argv=None) -> int:
             poll_interval_s=args.poll_interval or 2.0,
             profile_dir=profile_dir,
             trace_ring=args.trace_ring,
+            slo_layer=not args.no_slo,
+            slo_objectives=slo_objectives,
+            slo_rules=slo_rules,
             log_fn=log,
         )
     except FileNotFoundError as e:

@@ -1,0 +1,374 @@
+"""Metrics-truth layer tests (ISSUE 16).
+
+The load-bearing guarantees, pinned:
+
+- mergeable histograms: merge is associative AND commutative on the
+  integer bucket counts, refuses mismatched bucket layouts, and —
+  the fleet identity — merging N per-process scrapes through a REAL
+  registry render -> parse cycle is bit-identical to one histogram
+  fed the pooled raw observations;
+- the shared exposition parser round-trips histogram families and
+  REJECTS invalid ones (non-monotone cumulative counts, missing +Inf);
+- the embedded time-series store: a coarse tier is exactly the fold of
+  its fine-tier buckets, and memory is bounded by construction
+  (per-tier ring eviction + series-cap dropping, both observable);
+- the SLO engine: error-budget accounting, the multi-window burn-rate
+  condition, and the alert state machine inactive -> pending ->
+  firing -> resolved, including the pending clear on a blip and the
+  RE-ARM (a second burst fires again, fire_count increments), with
+  fire/resolve hooks invoked outside the lock.
+"""
+
+import math
+
+import pytest
+
+from cgnn_tpu.observe.export import MetricsRegistry, parse_prometheus_text
+from cgnn_tpu.observe.hist import (
+    LATENCY_MS_BOUNDS,
+    Histogram,
+    log_bounds,
+    merge_snapshot_maps,
+    quantile_from_snapshot,
+    snapshot_exposition_lines,
+    snapshots_from_family,
+)
+from cgnn_tpu.observe.slo import (
+    BurnRateRule,
+    SLOEngine,
+    SLOObjective,
+    default_rules,
+)
+from cgnn_tpu.observe.tsdb import TimeSeriesStore, TsdbCollector
+
+# dyadic values: float sums are EXACT in any addition order, so the
+# associativity/commutativity asserts below can demand bit equality
+# on sums, not just counts
+_DYADIC = [0.25, 0.5, 1.5, 2.0, 12.0, 100.5, 7000.0, 1.0e9]
+
+
+def _hist_of(values, bounds=LATENCY_MS_BOUNDS) -> Histogram:
+    h = Histogram(bounds)
+    for v in values:
+        h.observe(v)
+    return h
+
+
+class TestHistogramMerge:
+    def test_merge_commutative(self):
+        a = _hist_of(_DYADIC[:4])
+        b = _hist_of(_DYADIC[4:])
+        assert a.merge(b).snapshot() == b.merge(a).snapshot()
+
+    def test_merge_associative(self):
+        a = _hist_of(_DYADIC[:3])
+        b = _hist_of(_DYADIC[3:6])
+        c = _hist_of(_DYADIC[6:])
+        left = a.merge(b).merge(c)
+        right = a.merge(b.merge(c))
+        assert left.snapshot() == right.snapshot()
+
+    def test_merge_equals_pooled(self):
+        parts = [_hist_of(_DYADIC[i::3]) for i in range(3)]
+        merged = Histogram.merge_all(parts)
+        pooled = _hist_of(_DYADIC)
+        assert merged.snapshot() == pooled.snapshot()
+
+    def test_merge_refuses_mismatched_bounds(self):
+        a = Histogram(log_bounds(0.1, 100.0, 6))
+        b = Histogram(log_bounds(0.1, 100.0, 3))
+        with pytest.raises(ValueError, match="different bounds"):
+            a.merge(b)
+
+    def test_inputs_untouched(self):
+        a = _hist_of([1.0])
+        b = _hist_of([2.0])
+        a.merge(b)
+        assert a.count == 1 and b.count == 1
+
+    def test_fleet_merge_via_real_render_parse_cycle(self):
+        """The ISSUE-16 acceptance identity, host-side: N per-process
+        registries render REAL expositions, the shared parser
+        reconstructs each scrape, the fleet merge folds them — and the
+        result is bit-identical (counts AND sums) to one histogram fed
+        every raw observation."""
+        per_replica = [_DYADIC[i::3] for i in range(3)]
+        scraped_maps = []
+        for values in per_replica:
+            reg = MetricsRegistry(namespace="cgnn")
+            h = _hist_of(values)
+            reg.add_provider(
+                "serve",
+                lambda h=h: {"histograms": {"lat_ms_hist": h.snapshot()}})
+            fams = parse_prometheus_text(reg.prometheus_text())
+            assert fams["cgnn_lat_ms_hist"]["type"] == "histogram"
+            scraped_maps.append(fams["cgnn_lat_ms_hist"]["histogram"])
+        merged = merge_snapshot_maps(scraped_maps)
+        pooled = _hist_of(_DYADIC).snapshot()
+        assert merged[""] == pooled
+
+    def test_labels_preserved_through_merge(self):
+        maps = [
+            {'{rung="0"}': _hist_of([1.0]).snapshot(),
+             '{rung="1"}': _hist_of([8.0]).snapshot()},
+            {'{rung="0"}': _hist_of([2.0]).snapshot()},
+        ]
+        merged = merge_snapshot_maps(maps)
+        assert merged['{rung="0"}']["count"] == 2
+        assert merged['{rung="1"}']["count"] == 1  # never cross-rung
+
+
+class TestExpositionRoundTrip:
+    def test_snapshot_exposition_round_trip_exact(self):
+        snap = _hist_of(_DYADIC).snapshot()
+        lines = ["# TYPE lat_ms_hist histogram"]
+        lines += snapshot_exposition_lines("lat_ms_hist", snap)
+        fams = parse_prometheus_text("\n".join(lines) + "\n")
+        back = fams["lat_ms_hist"]["histogram"][""]
+        assert back == snap  # bounds, counts, count, AND float sum
+
+    def test_monotonicity_violation_rejected(self):
+        text = (
+            "# TYPE bad_hist histogram\n"
+            'bad_hist_bucket{le="1.0"} 5\n'
+            'bad_hist_bucket{le="2.0"} 3\n'
+            'bad_hist_bucket{le="+Inf"} 3\n'
+            "bad_hist_sum 4.0\n"
+            "bad_hist_count 3\n"
+        )
+        with pytest.raises(ValueError, match="decrease"):
+            parse_prometheus_text(text)
+
+    def test_missing_inf_bucket_rejected(self):
+        fam = {"samples": [('h_bucket{le="1.0"}', 2.0), ("h_count", 2.0),
+                           ("h_sum", 1.0)]}
+        with pytest.raises(ValueError, match=r"\+Inf"):
+            snapshots_from_family(fam)
+
+    def test_inf_bucket_count_mismatch_rejected(self):
+        fam = {"samples": [('h_bucket{le="1.0"}', 2.0),
+                           ('h_bucket{le="+Inf"}', 2.0),
+                           ("h_count", 5.0), ("h_sum", 1.0)]}
+        with pytest.raises(ValueError, match="_count"):
+            snapshots_from_family(fam)
+
+    def test_quantile_from_snapshot(self):
+        h = Histogram(log_bounds(1.0, 1000.0, 3))
+        for _ in range(100):
+            h.observe(50.0)
+        p50 = quantile_from_snapshot(h.snapshot(), 0.5)
+        # bucket resolution: within one log-spaced bucket of the truth
+        assert 50.0 / (10 ** (1 / 3)) <= p50 <= 50.0 * (10 ** (1 / 3))
+        assert math.isnan(quantile_from_snapshot(
+            Histogram(log_bounds(1.0, 10.0, 2)).snapshot(), 0.5))
+
+
+class TestTimeSeriesStore:
+    RES = (("10s", 10.0), ("1m", 60.0))
+
+    def test_coarse_tier_is_fold_of_fine_tier(self):
+        store = TimeSeriesStore(self.RES, clock=lambda: 0.0)
+        for i in range(12):  # two 1m buckets, twelve 10s buckets
+            store.observe("lat", float(i + 1), now=i * 10.0)
+        fine = store.query("lat", "10s")
+        coarse = store.query("lat", "1m")
+        assert len(fine) == 12 and len(coarse) == 2
+        for cb in coarse:
+            members = [b for b in fine
+                       if cb["t"] <= b["t"] < cb["t"] + 60.0]
+            assert cb["count"] == sum(b["count"] for b in members)
+            assert cb["sum"] == sum(b["sum"] for b in members)
+            assert cb["min"] == min(b["min"] for b in members)
+            assert cb["max"] == max(b["max"] for b in members)
+
+    def test_ring_bound_evicts_oldest(self):
+        store = TimeSeriesStore(self.RES, points_per_tier=5)
+        for i in range(20):
+            store.observe("x", 1.0, now=i * 10.0)
+        ring = store.query("x", "10s")
+        assert len(ring) == 5
+        assert ring[0]["t"] == 150.0  # 0..140 evicted, newest kept
+
+    def test_series_cap_drops_novel_names(self):
+        store = TimeSeriesStore(self.RES, max_series=2)
+        store.observe("a", 1.0, now=0.0)
+        store.observe("b", 1.0, now=0.0)
+        store.observe("c", 1.0, now=0.0)  # past the cap: dropped
+        store.observe("a", 2.0, now=1.0)  # existing names still fold
+        assert store.query("c", "10s") == []
+        assert store.stats()["dropped_series"] == 1
+        assert store.query("a", "10s")[0]["count"] == 2
+
+    def test_unknown_resolution_raises_unknown_name_empty(self):
+        store = TimeSeriesStore(self.RES)
+        with pytest.raises(KeyError, match="unknown resolution"):
+            store.query("x", "5m")
+        assert store.query("never-seen", "10s") == []
+
+    def test_nan_points_skipped(self):
+        store = TimeSeriesStore(self.RES)
+        store.observe("x", float("nan"), now=0.0)
+        assert store.query("x", "10s") == []
+
+    def test_append_snapshot_fans_out(self):
+        store = TimeSeriesStore(self.RES)
+        h = _hist_of([1.0, 2.0, 4.0])
+        n = store.append_snapshot({
+            "counters": {"served_total": 7},
+            "gauges": {"queue_depth": 3.0},
+            "series": {"lat_ms": {"p50": 1.0, "p95": 2.0, "p99": 4.0}},
+            "histograms": {"lat_ms_hist": h.snapshot()},
+        }, now=0.0)
+        names = store.names()
+        assert {"served_total", "queue_depth", "lat_ms_p50", "lat_ms_p99",
+                "lat_ms_hist_count", "lat_ms_hist_sum",
+                "lat_ms_hist_p99"} <= set(names)
+        assert n == 8  # 1 counter + 1 gauge + 3 quantiles + 3 hist
+        assert store.query("lat_ms_hist_count", "10s")[0]["last"] == 3.0
+
+    def test_collector_tick_and_broken_hook_survival(self):
+        reg = MetricsRegistry()
+        reg.add_provider("p", lambda: {"gauges": {"g": 1.0}})
+        store = TimeSeriesStore(self.RES)
+        collector = TsdbCollector(reg, store, interval_s=0.1)
+        calls = []
+        collector.add_on_tick(lambda: calls.append(1))
+
+        def broken():
+            raise RuntimeError("hook down")
+
+        collector.add_on_tick(broken)
+        assert collector.tick_once() >= 1
+        assert collector.tick_once() >= 1  # broken hook swallowed
+        assert len(calls) == 2 and collector.ticks == 2
+        assert "g" in store.names()
+
+    def test_bad_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            TimeSeriesStore(self.RES, points_per_tier=0)
+        with pytest.raises(ValueError):
+            TimeSeriesStore((("10s", 10.0), ("10s", 20.0)))
+
+
+class TestSLOEngine:
+    OBJ = SLOObjective("avail", target=0.9, window_s=60.0)
+    RULE = BurnRateRule(fast_s=2.0, slow_s=8.0, factor=2.0, for_s=1.0)
+
+    def _engine(self, **kw):
+        fired, resolved = [], []
+        eng = SLOEngine((self.OBJ,), rules=(self.RULE,),
+                        on_fire=fired.append, on_resolve=resolved.append,
+                        **kw)
+        return eng, fired, resolved
+
+    def test_lifecycle_fire_resolve_and_rearm(self):
+        eng, fired, resolved = self._engine()
+        for t in range(30):  # clean baseline
+            eng.record(True, 1.0, now=float(t))
+        assert eng.evaluate(now=30.0) == []
+        for t in range(31, 36):  # the burst: all-bad seconds
+            for _ in range(5):
+                eng.record(False, 0.0, now=float(t))
+        made = eng.evaluate(now=33.0)
+        assert [m["to"] for m in made] == ["pending"]
+        assert not fired  # for_s hold not yet served
+        made = eng.evaluate(now=34.5)
+        assert [m["to"] for m in made] == ["firing"]
+        assert len(fired) == 1 and fired[0]["objective"] == "avail"
+        assert fired[0]["burn_fast"] > 2.0
+        assert eng.firing()[0]["fire_count"] == 1
+        # recovery: clean traffic ages the burst out of both windows
+        for t in range(40, 60):
+            eng.record(True, 1.0, now=float(t))
+        made = eng.evaluate(now=55.0)
+        assert [m["to"] for m in made] == ["resolved"]
+        assert len(resolved) == 1 and not eng.firing()
+        # RE-ARM: a second burst walks resolved -> pending -> firing
+        for t in range(60, 65):
+            for _ in range(5):
+                eng.record(False, 0.0, now=float(t))
+        eng.evaluate(now=62.0)
+        made = eng.evaluate(now=63.5)
+        assert [m["to"] for m in made] == ["firing"]
+        assert len(fired) == 2
+        assert eng.firing()[0]["fire_count"] == 2
+
+    def test_pending_clears_on_blip(self):
+        eng, fired, _ = self._engine()
+        for _ in range(5):
+            eng.record(False, 0.0, now=10.0)
+        made = eng.evaluate(now=10.5)
+        assert [m["to"] for m in made] == ["pending"]
+        for t in range(11, 25):  # blip over before the for_s hold fires
+            for _ in range(20):
+                eng.record(True, 1.0, now=float(t))
+        made = eng.evaluate(now=24.0)
+        assert [m["to"] for m in made] == ["inactive"]
+        assert not fired
+
+    def test_multiwindow_condition_needs_both(self):
+        # a spike too short for the SLOW window must not fire: 2 bad
+        # in a long-good history exceeds the fast burn only
+        eng, fired, _ = self._engine()
+        for t in range(50):
+            for _ in range(10):
+                eng.record(True, 1.0, now=float(t))
+        for _ in range(4):
+            eng.record(False, 0.0, now=50.0)
+        made = eng.evaluate(now=50.5)
+        burn_fast = eng.burn_rate("avail", 2.0, now=50.5)
+        burn_slow = eng.burn_rate("avail", 8.0, now=50.5)
+        assert burn_fast > 2.0 > burn_slow
+        assert made == [] and not fired
+
+    def test_budget_accounting(self):
+        eng, _, _ = self._engine()
+        for i in range(100):
+            eng.record(i >= 5, 1.0, now=30.0)  # 5 bad of 100
+        b = eng.budget("avail", now=30.0)
+        assert b["total"] == 100 and b["bad"] == 5
+        assert b["allowed"] == pytest.approx(10.0)
+        assert b["remaining_frac"] == pytest.approx(0.5)
+
+    def test_note_status_5xx_burns(self):
+        eng, _, _ = self._engine()
+        eng.note_status(500, now=10.0)
+        eng.note_status(503, now=10.0)
+        eng.note_status(429, now=10.0)  # shedding is NOT budget burn
+        eng.note_status(200, now=10.0)
+        b = eng.budget("avail", now=10.0)
+        assert b["total"] == 4 and b["bad"] == 2
+
+    def test_latency_objective(self):
+        obj = SLOObjective("lat", target=0.9, latency_threshold_ms=100.0)
+        assert obj.good(True, 50.0)
+        assert not obj.good(True, 150.0)  # slow success burns
+        assert not obj.good(False, 50.0)
+        assert not obj.good(True, None)
+
+    def test_gauges_and_state_views(self):
+        eng, _, _ = self._engine(clock=lambda: 30.0)
+        eng.record(True, 1.0, now=29.0)
+        g = eng.gauges()
+        assert g["slo_alerts_firing"] == 0.0
+        assert g["slo_avail_budget_remaining"] == 1.0
+        st = eng.state(now=30.0)
+        assert st["events"] == 1
+        assert self.RULE.key in st["objectives"]["avail"]["rules"]
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="at least one"):
+            SLOEngine(())
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEngine((self.OBJ, self.OBJ))
+        with pytest.raises(ValueError, match="target"):
+            SLOObjective("x", target=1.0)
+        with pytest.raises(ValueError, match="fast_s"):
+            BurnRateRule(fast_s=8.0, slow_s=2.0, factor=2.0)
+
+    def test_default_rules_scale_with_window(self):
+        rules = default_rules(3600.0)
+        assert len(rules) == 2
+        assert rules[0].fast_s == pytest.approx(300.0)
+        assert rules[0].slow_s == rules[1].slow_s == 3600.0
+        assert rules[0].factor > rules[1].factor
