@@ -17,24 +17,54 @@ compose into a system that stays up when a replica dies:
   degradation (503 + Retry-After) when nothing is admittable;
 - :mod:`http`     — the stdlib HTTP front-end over the router;
 - :mod:`spawn`    — replica subprocess lifecycle (the serve.py boot),
-  incl. the kill -9 / restart legs the chaos harness drives.
+  incl. the kill -9 / restart legs the chaos harness drives, plus the
+  crash-loop-guarded supervised boot (``boot_with_retries``);
+- :mod:`autoscale` — the self-driving control loop (ISSUE 17): an
+  SLO-signal-driven decision core grows/shrinks the routed set with
+  hysteresis, a warm pool hides warmup latency, and drained exits are
+  scale events, never incidents;
+- :mod:`remediate` — flight-recorder-driven auto-remediation:
+  replace-and-drain on wedge evidence, every action journaled with
+  the bundle that justified it, rate-limited against respawn storms.
 """
 
+from cgnn_tpu.fleet.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    ScaleDecision,
+    ScaleSignals,
+    signals_from_router,
+)
 from cgnn_tpu.fleet.breaker import CircuitBreaker
+from cgnn_tpu.fleet.remediate import RemediationPolicy, Remediator
 from cgnn_tpu.fleet.replica import (
     FleetTransportError,
     ReplicaState,
     http_transport,
 )
 from cgnn_tpu.fleet.router import FleetRouter
-from cgnn_tpu.fleet.spawn import ReplicaProcess, spawn_fleet
+from cgnn_tpu.fleet.spawn import (
+    ReplicaProcess,
+    RestartBackoff,
+    boot_with_retries,
+    spawn_fleet,
+)
 
 __all__ = [
+    "Autoscaler",
+    "AutoscalePolicy",
     "CircuitBreaker",
     "FleetRouter",
     "FleetTransportError",
+    "RemediationPolicy",
+    "Remediator",
     "ReplicaProcess",
     "ReplicaState",
+    "RestartBackoff",
+    "ScaleDecision",
+    "ScaleSignals",
+    "boot_with_retries",
     "http_transport",
+    "signals_from_router",
     "spawn_fleet",
 ]

@@ -1,0 +1,513 @@
+"""SLO-driven elastic autoscaling over the replica fleet (ISSUE 17).
+
+The control loop ROADMAP item 5 asks for: the router's scraped signal
+plane (fleet queue depth, rolling p99 vs the SLO objective, error-budget
+burn rates, shed rate) already says when the fleet is too small or too
+big — this module closes the loop and grows/shrinks the routed replica
+set through ``fleet/spawn.py``.
+
+Two layers, split exactly like the batcher (serve/batcher.py):
+
+- :class:`AutoscalePolicy` — the PURE decision core. ``poll(now,
+  signals)`` takes an explicit clock value and a :class:`ScaleSignals`
+  snapshot and returns a :class:`ScaleDecision` (or None), with
+  hysteresis built in: separate up/down thresholds (queue depth per
+  ready replica must exceed ``up_queue_per_replica`` to grow but fall
+  below the LOWER ``down_queue_per_replica`` to shrink), a cooldown
+  between actions, a sustain window before any scale-down, and hard
+  min/max bounds. A shed is the strongest signal there is — capacity
+  was REFUSED — so a shed-rate increase bypasses the up-cooldown: the
+  autoscaler must never sit out a cooldown while requests bounce.
+  Deterministic and lock-free; tests drive it with a fake clock.
+
+- :class:`Autoscaler` — the runtime. Owns the replica processes, keeps
+  a **warm pool** of ``warm_target`` spares booted and warm()-compiled
+  but NOT routed (serve.py binds its listener before warming, so a
+  pool replica is fully compiled and /healthz-ready while invisible to
+  the router) — scale-up is then a routing-table add that hides the
+  multi-second warmup entirely. Scale-down picks the least-loaded
+  routed replica (``pick_victim``), SIGTERM-drains it, and reaps it
+  only after the drain answered everything; the router classifies the
+  draining exit as a *scale event*, never an incident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Sequence
+
+from cgnn_tpu.analysis import racecheck
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleSignals:
+    """One poll's snapshot of the router's signal plane.
+
+    ``queue_depth`` is fleet-total pending work (router-view in-flight
+    plus every replica's scraped serve_queue_depth); ``shed`` is the
+    CUMULATIVE fleet_shed counter (the policy differentiates it);
+    ``burn_fast``/``burn_slow`` are the worst burn rates across the
+    router's SLO objectives (0 with the SLO layer off)."""
+
+    replicas: int = 0          # routed replica count
+    ready: int = 0             # of those, ready + admittable-ish
+    draining: int = 0          # routed but draining (scale-down victims)
+    warm_pool: int = 0         # booted + warmed, NOT routed
+    queue_depth: float = 0.0
+    p99_ms: float = 0.0        # router-measured fleet rolling p99
+    shed: int = 0              # cumulative fleet_shed
+    burn_fast: float = 0.0
+    burn_slow: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDecision:
+    action: str                # "up" | "down"
+    reason: str
+    urgent: bool = False       # True = the shed path (cooldown bypassed)
+
+
+class AutoscalePolicy:
+    """The pure decision core; see the module docstring.
+
+    All state lives on this object and mutates only inside ``poll`` —
+    callers serialize polls (the Autoscaler loop does; tests are
+    single-threaded), so no lock is needed here."""
+
+    def __init__(
+        self,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+        up_queue_per_replica: float = 2.0,
+        down_queue_per_replica: float = 0.5,
+        up_p99_ms: float = 0.0,        # 0 disables the latency trigger
+        up_burn: float = 0.0,          # 0 disables the burn-rate trigger
+        cooldown_up_s: float = 5.0,
+        cooldown_down_s: float = 10.0,
+        down_sustain_s: float = 10.0,
+        warm_target: int = 1,
+    ):
+        if min_replicas < 1:
+            raise ValueError(f"min_replicas must be >= 1, got {min_replicas}")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas {max_replicas} < min_replicas {min_replicas}")
+        if down_queue_per_replica >= up_queue_per_replica:
+            # the hysteresis band: equal thresholds would flap
+            raise ValueError(
+                f"down_queue_per_replica ({down_queue_per_replica}) must be "
+                f"< up_queue_per_replica ({up_queue_per_replica})")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_queue_per_replica = float(up_queue_per_replica)
+        self.down_queue_per_replica = float(down_queue_per_replica)
+        self.up_p99_ms = float(up_p99_ms)
+        self.up_burn = float(up_burn)
+        self.cooldown_up_s = float(cooldown_up_s)
+        self.cooldown_down_s = float(cooldown_down_s)
+        self.down_sustain_s = float(down_sustain_s)
+        self.warm_target = int(warm_target)
+        self._last_action_t: float | None = None
+        self._last_shed: int | None = None
+        self._quiet_since: float | None = None
+
+    # ---- the decision ----
+
+    def poll(self, now: float, signals: ScaleSignals) -> ScaleDecision | None:
+        """One control tick: -> ScaleDecision or None (hold)."""
+        s = signals
+        # shed DELTA since the last poll: cumulative counters don't
+        # re-trigger forever on one old incident
+        if self._last_shed is None:
+            self._last_shed = s.shed
+        shed_delta = s.shed - self._last_shed
+        self._last_shed = s.shed
+
+        routed = s.replicas
+        if routed < self.min_replicas:
+            # bounds repair beats every cooldown: below min is broken
+            self._note_action(now)
+            return ScaleDecision("up", "below_min_replicas", urgent=True)
+
+        reasons = []
+        per_ready = s.queue_depth / max(s.ready, 1)
+        if per_ready >= self.up_queue_per_replica:
+            reasons.append(f"queue {per_ready:.1f}/replica")
+        if self.up_p99_ms > 0 and s.p99_ms >= self.up_p99_ms:
+            reasons.append(f"p99 {s.p99_ms:.0f}ms")
+        if (self.up_burn > 0 and s.burn_fast >= self.up_burn
+                and s.burn_slow >= self.up_burn):
+            reasons.append(f"burn {s.burn_fast:.1f}/{s.burn_slow:.1f}")
+        urgent = shed_delta > 0
+        if urgent:
+            reasons.append(f"shed +{shed_delta}")
+
+        if reasons:
+            self._quiet_since = None
+            if routed >= self.max_replicas:
+                return None  # at the bound: shedding is now legitimate
+            if urgent or self._cooled(now, self.cooldown_up_s):
+                self._note_action(now)
+                return ScaleDecision("up", ", ".join(reasons),
+                                     urgent=urgent)
+            return None
+
+        # ---- the calm path: consider shrinking ----
+        calm = per_ready <= self.down_queue_per_replica
+        if not calm or routed - s.draining <= self.min_replicas:
+            self._quiet_since = None
+            return None
+        if self._quiet_since is None:
+            self._quiet_since = now
+            return None
+        if (now - self._quiet_since >= self.down_sustain_s
+                and self._cooled(now, self.cooldown_down_s)):
+            self._note_action(now)
+            self._quiet_since = None
+            return ScaleDecision(
+                "down", f"idle {per_ready:.2f}/replica for "
+                        f"{self.down_sustain_s:g}s")
+        return None
+
+    def _cooled(self, now: float, cooldown_s: float) -> bool:
+        return (self._last_action_t is None
+                or now - self._last_action_t >= cooldown_s)
+
+    def _note_action(self, now: float) -> None:
+        self._last_action_t = now
+
+    # ---- warm-pool accounting ----
+
+    def pool_deficit(self, signals: ScaleSignals) -> int:
+        """How many spares the warm pool is short. Bounded so pool +
+        routed never exceeds max_replicas — spares that could never be
+        routed are wasted compile time."""
+        headroom = max(0, self.max_replicas - signals.replicas)
+        return max(0, min(self.warm_target, headroom) - signals.warm_pool)
+
+    # ---- victim selection ----
+
+    @staticmethod
+    def pick_victim(replicas: Sequence) -> int | None:
+        """The least-loaded routed replica (by ReplicaState.score():
+        in-flight + scraped queue depth, tie-broken by scraped p99 then
+        rid); already-draining replicas are never re-picked. None when
+        nothing qualifies."""
+        candidates = [r for r in replicas if not r.stats()["draining"]]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: r.score()).rid
+
+    def stats(self) -> dict:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "warm_target": self.warm_target,
+            "up_queue_per_replica": self.up_queue_per_replica,
+            "down_queue_per_replica": self.down_queue_per_replica,
+            "last_action_t": self._last_action_t,
+        }
+
+
+def signals_from_router(router, warm_pool: int = 0) -> ScaleSignals:
+    """Snapshot the router's signal plane into a ScaleSignals — the
+    production signal provider (tests inject fakes)."""
+    replicas = router.replica_list()
+    queue = 0.0
+    ready = draining = 0
+    for r in replicas:
+        s = r.stats()
+        queue += float(s["queue_depth"]) + float(s["inflight"])
+        ready += bool(s["ready"] and not s["draining"])
+        draining += bool(s["draining"])
+    q = router.rolling_latency()
+    burn_fast = burn_slow = 0.0
+    if router.slo is not None:
+        for obj in router.slo.state().get("objectives", {}).values():
+            for rule in obj.get("rules", {}).values():
+                burn_fast = max(burn_fast, float(rule.get("burn_fast", 0.0)))
+                burn_slow = max(burn_slow, float(rule.get("burn_slow", 0.0)))
+    return ScaleSignals(
+        replicas=len(replicas),
+        ready=ready,
+        draining=draining,
+        warm_pool=warm_pool,
+        queue_depth=queue,
+        p99_ms=float(q.get("p99", 0.0)) if q else 0.0,
+        shed=router.count("fleet_shed"),
+        burn_fast=burn_fast,
+        burn_slow=burn_slow,
+    )
+
+
+class Autoscaler:
+    """The runtime around the policy: warm pool, process lifecycle,
+    routing-table adds/removes. See the module docstring.
+
+    ``factory(rid) -> proc`` builds one replica process handle (the
+    production factory wraps fleet.spawn.ReplicaProcess on the next
+    free port); ``state_factory(rid, base_url) -> ReplicaState`` builds
+    the router-side state for a newly routed replica. Both injectable —
+    tests drive the whole runtime with fakes and a fake clock."""
+
+    def __init__(
+        self,
+        router,
+        policy: AutoscalePolicy,
+        factory: Callable,
+        state_factory: Callable,
+        *,
+        procs: dict | None = None,
+        next_rid: int = 0,
+        poll_interval_s: float = 1.0,
+        boot_timeout_s: float = 300.0,
+        drain_timeout_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+        log_fn: Callable = print,
+    ):
+        self.router = router
+        self.policy = policy
+        self.factory = factory
+        self.state_factory = state_factory
+        self.poll_interval_s = float(poll_interval_s)
+        self.boot_timeout_s = float(boot_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._clock = clock
+        self._log = log_fn
+        self._lock = racecheck.make_lock("fleet.autoscale")
+        # all below mutated under self._lock (graftcheck GC-LOCKSHARE)
+        self.procs: dict = dict(procs or {})   # rid -> proc (ever owned)
+        self.pool: list = []                   # [(rid, proc)] warm spares
+        self.events: list = []                 # the action journal
+        self.counts = {"scale_ups": 0, "scale_downs": 0, "boots": 0,
+                       "boot_failures": 0, "pool_refills": 0}
+        self._next_rid = int(next_rid)
+        self._downs_inflight: set = set()
+        self._stop = threading.Event()
+        self._loop_thread: threading.Thread | None = None
+        self._refill_thread: threading.Thread | None = None
+        self._down_threads: list = []
+        self._t0 = clock()
+
+    # ---- lifecycle ----
+
+    def start(self) -> "Autoscaler":
+        if self._loop_thread is None or not self._loop_thread.is_alive():
+            self._stop.clear()
+            self._loop_thread = threading.Thread(
+                target=self._loop, daemon=True, name="fleet-autoscale")
+            self._loop_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=30.0)
+        with self._lock:
+            down = list(self._down_threads)
+            refill = self._refill_thread
+        for t in down:
+            t.join(timeout=self.drain_timeout_s + 30.0)
+        if refill is not None:
+            refill.join(timeout=self.boot_timeout_s + 30.0)
+
+    def shutdown(self, drain_timeout_s: float | None = None) -> dict:
+        """Stop the loop and SIGTERM-drain EVERYTHING this autoscaler
+        owns (routed + pool); -> {rid: exit_code}."""
+        self.stop()
+        timeout = (self.drain_timeout_s if drain_timeout_s is None
+                   else float(drain_timeout_s))
+        with self._lock:
+            procs = dict(self.procs)
+        return {rid: p.terminate(timeout_s=timeout)
+                for rid, p in procs.items()}
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            racecheck.heartbeat()
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                self._log(f"autoscale: tick failed: {e!r}")
+
+    # ---- one control tick ----
+
+    def tick(self, now: float | None = None) -> ScaleDecision | None:
+        now = self._clock() if now is None else now
+        with self._lock:
+            pool_n = len(self.pool)
+        signals = signals_from_router(self.router, warm_pool=pool_n)
+        self._replenish_pool(signals)
+        decision = self.policy.poll(now, signals)
+        if decision is None:
+            return None
+        if decision.action == "up":
+            self.scale_up(decision.reason)
+        elif decision.action == "down":
+            self.scale_down(decision.reason)
+        return decision
+
+    # ---- warm pool ----
+
+    def _boot_one(self) -> tuple | None:
+        """Boot + warm one spare; -> (rid, proc) or None. The crash-loop
+        guard lives in spawn.boot_with_retries — a replica that dies
+        during boot retries with exponential backoff, bounded."""
+        from cgnn_tpu.fleet.spawn import boot_with_retries
+
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self.counts["boots"] += 1
+        proc = self.factory(rid)
+        if not boot_with_retries(proc, wait_ready_s=self.boot_timeout_s,
+                                 log_fn=self._log):
+            with self._lock:
+                self.counts["boot_failures"] += 1
+            self._event("boot_failed", rid, "gave up after restart backoff")
+            return None
+        with self._lock:
+            self.procs[rid] = proc
+        return rid, proc
+
+    def _replenish_pool(self, signals: ScaleSignals) -> None:
+        """Keep the warm pool at target, one boot in flight at a time
+        (a pool refill must never become its own respawn storm)."""
+        if self.policy.pool_deficit(signals) <= 0:
+            return
+        with self._lock:
+            if (self._refill_thread is not None
+                    and self._refill_thread.is_alive()):
+                return
+            t = threading.Thread(target=self._refill_one, daemon=True,
+                                 name="fleet-autoscale-refill")
+            self._refill_thread = t
+        t.start()
+
+    def _refill_one(self) -> bool:
+        pair = self._boot_one()
+        if pair is None:
+            return False
+        with self._lock:
+            self.pool.append(pair)
+            self.counts["pool_refills"] += 1
+        self._event("pool_add", pair[0], "warm spare ready")
+        return True
+
+    def prewarm(self, count: int | None = None) -> int:
+        """Synchronously fill the warm pool to ``count`` (default: the
+        policy's warm_target) BEFORE load starts — the deterministic
+        boot the smoke legs use so the first scale-up is a routing-table
+        add, never a cold boot racing the ramp. Returns spares added;
+        stops early on a boot failure."""
+        want = self.policy.warm_target if count is None else int(count)
+        added = 0
+        while True:
+            with self._lock:
+                have = len(self.pool)
+            if have >= want or not self._refill_one():
+                break
+            added += 1
+        return added
+
+    # ---- scale up: routing-table add ----
+
+    def scale_up(self, reason: str = "") -> int | None:
+        """Route one more replica; -> its rid (None on boot failure).
+        Prefers a warm-pool spare (instant: it is already compiled and
+        /healthz-ready) and falls back to a cold boot."""
+        with self._lock:
+            pair = self.pool.pop(0) if self.pool else None
+        if pair is None:
+            pair = self._boot_one()  # cold fallback: slower, still grows
+            if pair is None:
+                return None
+        rid, proc = pair
+        state = self.state_factory(rid, proc.base_url)
+        try:
+            state.probe(timeout_s=5.0)  # routed WITH a routing signal
+        except Exception:  # noqa: BLE001 — the poller re-probes anyway
+            pass
+        self.router.add_replica(state)
+        with self._lock:
+            self.counts["scale_ups"] += 1
+        self._event("scale_up", rid, reason)
+        self._log(f"autoscale: scale UP -> replica{rid} routed "
+                  f"({reason or 'manual'})")
+        return rid
+
+    # ---- scale down: drain, then reap ----
+
+    def scale_down(self, reason: str = "") -> int | None:
+        """Pick the least-loaded victim and drain it off the fleet; ->
+        its rid (None when nothing qualifies). The drain runs on its
+        own thread: SIGTERM -> the replica answers everything it
+        accepted -> exit 0 -> the router logs a SCALE EVENT (the
+        draining flag it advertised makes the disappearance
+        classifiable), and only then is the process reaped."""
+        with self._lock:
+            exclude = set(self._downs_inflight)
+        candidates = [r for r in self.router.replica_list()
+                      if r.rid not in exclude]
+        victim = self.policy.pick_victim(candidates)
+        if victim is None:
+            return None
+        with self._lock:
+            proc = self.procs.get(victim)
+            if proc is None or victim in self._downs_inflight:
+                return None
+            self._downs_inflight.add(victim)
+            t = threading.Thread(
+                target=self._drain_victim, args=(victim, proc, reason),
+                daemon=True, name=f"fleet-autoscale-drain-{victim}")
+            self._down_threads.append(t)
+        t.start()
+        return victim
+
+    def _drain_victim(self, rid: int, proc, reason: str) -> None:
+        try:
+            # mark intent router-side FIRST: even a drain that finishes
+            # inside one probe interval is then classified a scale
+            # event, never an incident
+            self.router.begin_drain(rid)
+            code = proc.terminate(timeout_s=self.drain_timeout_s)
+            # idempotent: the health poller usually removed it already
+            # when the draining replica stopped answering probes
+            self.router.remove_replica(rid, reason="scale_down")
+            with self._lock:
+                self.counts["scale_downs"] += 1
+            self._event("scale_down", rid,
+                        f"{reason or 'manual'} (exit {code})")
+            self._log(f"autoscale: scale DOWN -> replica{rid} drained "
+                      f"(exit {code}; {reason or 'manual'})")
+        finally:
+            with self._lock:
+                self._downs_inflight.discard(rid)
+
+    def proc_for(self, rid: int):
+        """The process handle this autoscaler owns for ``rid`` (None
+        for externally-spawned replicas) — the remediator's reap path."""
+        with self._lock:
+            return self.procs.get(rid)
+
+    # ---- bookkeeping ----
+
+    def _event(self, action: str, rid: int, reason: str) -> None:
+        with self._lock:
+            self.events.append({
+                "t_s": round(self._clock() - self._t0, 3),
+                "action": action, "replica": rid, "reason": reason,
+            })
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "policy": self.policy.stats(),
+                "counts": dict(self.counts),
+                "warm_pool": [rid for rid, _ in self.pool],
+                "owned": sorted(self.procs),
+                "events": list(self.events),
+            }

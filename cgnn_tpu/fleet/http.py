@@ -73,7 +73,13 @@ def make_fleet_handler(router: FleetRouter):
                         "Retry-After": str(int(router._retry_after_s()))
                     })
             elif self.path == "/stats":
-                self._reply(200, router.stats())
+                out = router.stats()
+                # the self-driving layers' state (ISSUE 17), when wired
+                if router.autoscaler is not None:
+                    out["autoscale"] = router.autoscaler.stats()
+                if router.remediator is not None:
+                    out["remediation"] = router.remediator.stats()
+                self._reply(200, out)
             elif self.path == "/metrics":
                 self._reply_text(router.registry.prometheus_text())
             elif self.path == "/metrics/fleet":

@@ -1,0 +1,304 @@
+"""Flight-recorder-driven auto-remediation (ISSUE 17).
+
+PR 16's flight recorder turned every fleet incident into an evidence
+bundle; until now a human read it. The remediator is the subscriber
+that acts: it hooks ``FlightRecorder.on_trigger`` (breaker trips,
+unreachable transitions, wedge watchdog dumps), matches the trigger
+against the fleet's own state, and — when the evidence says a replica
+is wedged, not merely loaded — runs **replace-and-drain**:
+
+    spawn replacement -> wait ready -> route it -> unroute the victim
+    -> SIGTERM-drain the victim -> force-reap past the bound
+
+Two rules keep this from making outages worse:
+
+- **every action names its evidence**: each entry appended to
+  ``remediation.jsonl`` records the flight-recorder bundle (or the
+  recorder's last bundle when the trigger itself was rate-limited)
+  that justified it — the action chain is auditable end to end;
+- **rate-limited**: a flapping replica cannot drive a respawn storm —
+  a global minimum interval between actions, a per-replica interval,
+  and a hard action cap; suppressed triggers are counted, not acted on.
+
+Split like the autoscaler: :class:`RemediationPolicy` is the pure
+decision core (``consider(now, reason, detail, replica_stats)``,
+injectable clock in the caller); :class:`Remediator` is the runtime
+that subscribes, queues triggers off the request path, and executes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable
+
+from cgnn_tpu.analysis import racecheck
+
+# triggers a remediator reacts to; everything else (5xx bursts, SLO
+# burns, drain force-exits) is evidence, not a replace signal
+ACTIONABLE = ("breaker_trip", "replica_unreachable", "watchdog")
+
+
+def rid_from_detail(reason: str, detail: str) -> int | None:
+    """Extract the replica id a trigger is about from its detail line
+    (the formats router.py emits): breaker trips name the breaker
+    (``fleet.breaker.<rid>: open after ...``), unreachable transitions
+    name the replica (``replica<rid> (url) stopped answering ...``)."""
+    detail = str(detail)
+    if reason == "breaker_trip" and detail.startswith("fleet.breaker."):
+        head = detail.split(":", 1)[0]
+        tail = head.rsplit(".", 1)[-1]
+        return int(tail) if tail.isdigit() else None
+    if detail.startswith("replica"):
+        head = detail.split(" ", 1)[0][len("replica"):]
+        return int(head) if head.isdigit() else None
+    return None
+
+
+class RemediationPolicy:
+    """The pure decision core: one trigger in, one action (or None)
+    out. State mutates only inside ``consider`` — callers serialize.
+
+    The wedge signature it keys on: the replica's HEALTH plane still
+    answers (``probe_ok`` and ``probe_ready`` True — the listener
+    lives, the last probe said ready; NOT the dispatch-path ``ready``,
+    which the k-th timeout clears in the same breath that trips the
+    breaker) while the DISPATCH plane tripped (k consecutive
+    failures/timeouts). A loaded replica rejects typed 429s (breaker
+    records success); a dead one stops answering probes (the incident
+    path); only a wedged flush presents healthy-but-failing — exactly
+    what ``wedge_flush`` injects. An unreachable
+    trigger on a NON-draining replica is the dead-replica case and is
+    also actionable (replace): with spare capacity there is no reason
+    to wait out a breaker cooldown hoping it returns."""
+
+    def __init__(
+        self,
+        *,
+        min_interval_s: float = 30.0,
+        per_replica_interval_s: float = 120.0,
+        max_actions: int = 8,
+    ):
+        self.min_interval_s = float(min_interval_s)
+        self.per_replica_interval_s = float(per_replica_interval_s)
+        self.max_actions = int(max_actions)
+        self.actions_taken = 0
+        self.suppressed = 0
+        self._last_action_t: float | None = None
+        self._last_by_rid: dict[int, float] = {}
+
+    def consider(self, now: float, reason: str, detail: str,
+                 replica_stats: dict | None) -> dict | None:
+        """-> ``{"action": "replace_and_drain", "replica": rid,
+        "why": ...}`` or None. ``replica_stats`` is the router's view
+        of the implicated replica (None = not routed / unknown)."""
+        if reason not in ACTIONABLE:
+            return None
+        rid = rid_from_detail(reason, detail)
+        if rid is None:
+            return None
+        why = None
+        if reason == "breaker_trip":
+            s = replica_stats or {}
+            if s.get("probe_ok") and s.get("probe_ready"):
+                why = ("health plane answers while the dispatch plane "
+                       "tripped the breaker (wedged-flush signature)")
+        elif reason == "replica_unreachable":
+            s = replica_stats or {}
+            if not s.get("draining"):
+                why = "stopped answering health probes (not draining)"
+        elif reason == "watchdog":
+            why = "racecheck watchdog stall report"
+        if why is None:
+            return None
+        if self.actions_taken >= self.max_actions:
+            self.suppressed += 1
+            return None
+        if (self._last_action_t is not None
+                and now - self._last_action_t < self.min_interval_s):
+            self.suppressed += 1
+            return None
+        last = self._last_by_rid.get(rid)
+        if last is not None and now - last < self.per_replica_interval_s:
+            self.suppressed += 1
+            return None
+        self.actions_taken += 1
+        self._last_action_t = now
+        self._last_by_rid[rid] = now
+        return {"action": "replace_and_drain", "replica": rid,
+                "why": why}
+
+    def stats(self) -> dict:
+        return {
+            "actions_taken": self.actions_taken,
+            "suppressed": self.suppressed,
+            "min_interval_s": self.min_interval_s,
+            "max_actions": self.max_actions,
+        }
+
+
+class Remediator:
+    """The runtime: subscribes to a FlightRecorder, queues triggers off
+    the request path, and executes replace-and-drain through the
+    autoscaler's process machinery.
+
+    ``autoscaler`` supplies the factory/state_factory/procs plumbing —
+    the remediator replaces THROUGH it so ownership stays in one place
+    (the replacement lands in ``autoscaler.procs`` and future scale
+    decisions see it). Every executed action is appended to
+    ``<out_dir>/remediation.jsonl`` naming the justifying bundle."""
+
+    def __init__(
+        self,
+        router,
+        autoscaler,
+        policy: RemediationPolicy | None = None,
+        *,
+        out_dir: str = "",
+        drain_timeout_s: float = 30.0,
+        boot_timeout_s: float = 300.0,
+        clock: Callable[[], float] = time.monotonic,
+        log_fn: Callable = print,
+    ):
+        self.router = router
+        self.autoscaler = autoscaler
+        self.policy = policy or RemediationPolicy()
+        self.out_dir = out_dir
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.boot_timeout_s = float(boot_timeout_s)
+        self._clock = clock
+        self._log = log_fn
+        self._lock = racecheck.make_lock("fleet.remediate")
+        # mutated under self._lock (graftcheck GC-LOCKSHARE)
+        self.actions: list = []
+        import queue as _queue
+
+        self._queue: _queue.Queue = _queue.Queue(maxsize=256)
+        self._stop = threading.Event()
+        self._worker: threading.Thread | None = None
+
+    # ---- wiring ----
+
+    def attach(self, recorder) -> "Remediator":
+        """Subscribe to the recorder's triggers and start the worker.
+        The subscription callback only ENQUEUES — a breaker trip on the
+        request path costs one queue put, never a process spawn."""
+        self._recorder = recorder
+        recorder.on_trigger = self._on_trigger
+        if self._worker is None or not self._worker.is_alive():
+            self._stop.clear()
+            self._worker = threading.Thread(
+                target=self._run, daemon=True, name="fleet-remediate")
+            self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(
+                timeout=self.boot_timeout_s + self.drain_timeout_s + 30.0)
+
+    def _on_trigger(self, reason: str, detail: str,
+                    bundle: str | None) -> None:
+        if reason not in ACTIONABLE:
+            return
+        try:
+            self._queue.put_nowait((reason, detail, bundle))
+        except Exception:  # noqa: BLE001 — full queue: drop, never block
+            self._log("remediate: trigger queue full; dropping "
+                      f"{reason!r}")
+
+    # ---- the worker ----
+
+    def _run(self) -> None:
+        import queue as _queue
+
+        while not self._stop.is_set():
+            racecheck.heartbeat()
+            try:
+                reason, detail, bundle = self._queue.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            try:
+                self.handle(reason, detail, bundle)
+            except Exception as e:  # noqa: BLE001 — keep consuming
+                self._log(f"remediate: action for {reason!r} "
+                          f"failed: {e!r}")
+
+    def handle(self, reason: str, detail: str,
+               bundle: str | None) -> dict | None:
+        """Consider + execute one trigger synchronously (the worker's
+        body; tests call it directly); -> the action record or None."""
+        rid = rid_from_detail(reason, detail)
+        replica = self.router._replica(rid) if rid is not None else None
+        stats = replica.stats() if replica is not None else None
+        action = self.policy.consider(self._clock(), reason, detail,
+                                      stats)
+        if action is None:
+            return None
+        # a suppressed trigger has no bundle of its own: fall back to
+        # the recorder's last bundle so the chain still names evidence
+        if not bundle:
+            rec = getattr(self, "_recorder", None)
+            bundle = rec.last_bundle if rec is not None else ""
+        return self._replace_and_drain(action["replica"], reason,
+                                       detail, bundle or "", action["why"])
+
+    def _replace_and_drain(self, victim: int, reason: str, detail: str,
+                           bundle: str, why: str) -> dict:
+        """spawn replacement -> wait ready -> route it -> unroute +
+        drain the victim -> force-reap past the bound."""
+        self._log(f"remediate: replacing replica{victim} "
+                  f"({reason}: {why})")
+        replacement = self.autoscaler.scale_up(
+            reason=f"remediation: replace replica{victim}")
+        steps = [f"scale_up -> replica{replacement}"
+                 if replacement is not None else "scale_up FAILED"]
+        # unroute the victim FIRST (reason='remediation' counts an
+        # incident — this is a failure response, not elastic sizing),
+        # then drain what it accepted; terminate() force-kills past
+        # the bound, so a fully wedged victim still dies
+        self.router.remove_replica(victim, reason="remediation")
+        proc = self.autoscaler.proc_for(victim)
+        if proc is not None:
+            code = proc.terminate(timeout_s=self.drain_timeout_s)
+            steps.append(f"drain victim (exit {code})")
+        else:
+            steps.append("victim process unknown (external spawn)")
+        record = {
+            "t_unix": time.time(),
+            "action": "replace_and_drain",
+            "replica": victim,
+            "replacement": replacement,
+            "reason": reason,
+            "detail": detail,
+            "bundle": bundle,
+            "why": why,
+            "steps": steps,
+        }
+        with self._lock:
+            self.actions.append(record)
+        self._append_jsonl(record)
+        self._log(f"remediate: replica{victim} replaced by "
+                  f"replica{replacement} ({'; '.join(steps)}) "
+                  f"[evidence: {bundle or 'no bundle'}]")
+        return record
+
+    def _append_jsonl(self, record: dict) -> None:
+        if not self.out_dir:
+            return
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir, "remediation.jsonl")
+            with open(path, "a") as f:
+                f.write(json.dumps(record, allow_nan=False) + "\n")
+        except Exception as e:  # noqa: BLE001 — the journal is evidence,
+            self._log(f"remediate: journal append failed: {e!r}")
+
+    def stats(self) -> dict:
+        with self._lock:
+            actions = list(self.actions)
+        return {"policy": self.policy.stats(), "actions": actions,
+                "queued": self._queue.qsize()}

@@ -107,6 +107,8 @@ class ReplicaState:
         breaker_cooldown_s: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
         rolling_window_s: float = 60.0,
+        probe_backoff_base_s: float = 1.0,
+        probe_backoff_max_s: float = 30.0,
     ):
         self.rid = int(rid)
         self.base_url = base_url.rstrip("/")
@@ -123,12 +125,28 @@ class ReplicaState:
         # all below mutated under self._lock (graftcheck GC-LOCKSHARE)
         self._inflight = 0
         self._ready = False          # last probed readiness
+        # the HEALTH plane's own readiness, untouched by the dispatch
+        # path: note_result clears _ready on a transport timeout (the
+        # replica must stop looking pickable NOW), which means at
+        # breaker-trip time _ready is always False — so the wedge
+        # signature (health plane fine, dispatch plane failing) keys
+        # on THIS flag, which only probes write (ISSUE 17)
+        self._probe_ready = False
         self._draining = False
+        self._drain_intent = False   # router-side, sticky (ISSUE 17)
         self._version = ""           # last probed param_version
         self._queue_depth = 0.0      # scraped serve_queue_depth
         self._scraped_p99_ms = 0.0   # scraped rolling p99
         self._probe_ok = False       # last probe reached the replica
         self._probes = 0
+        # health-poller backoff (ISSUE 17): an unreachable replica's
+        # probe interval doubles up to the bound and resets on first
+        # success, so a dead replica costs one probe timeout at a
+        # widening cadence instead of one per poll round
+        self._probe_backoff_base_s = float(probe_backoff_base_s)
+        self._probe_backoff_max_s = float(probe_backoff_max_s)
+        self._probe_backoff_s = 0.0  # 0 = no backoff (reachable)
+        self._next_probe_at = 0.0    # clock time the next probe is due
         self.counts: dict[str, int] = {
             "sent": 0, "answered": 0, "transport_errors": 0,
             "server_errors": 0, "rejections": 0,
@@ -143,6 +161,7 @@ class ReplicaState:
             self._probe_ok = True
             self._probes += 1
             self._ready = bool(ready)
+            self._probe_ready = bool(ready)
             self._draining = bool(draining)
             if version:
                 self._version = str(version)
@@ -150,6 +169,8 @@ class ReplicaState:
                 self._queue_depth = float(queue_depth)
             if p99_ms is not None:
                 self._scraped_p99_ms = float(p99_ms)
+            self._probe_backoff_s = 0.0
+            self._next_probe_at = 0.0
         if ready and not draining:
             # half-open probe re-admission: a restarted replica that
             # reports ready is probed back into rotation
@@ -160,6 +181,34 @@ class ReplicaState:
             self._probe_ok = False
             self._probes += 1
             self._ready = False
+            self._probe_ready = False
+            # NOTE: _draining survives unreachability on purpose — a
+            # drained replica's final disappearance must still read as
+            # planned (the router's scale-event classification)
+            self._probe_backoff_s = (
+                self._probe_backoff_base_s if self._probe_backoff_s <= 0
+                else min(self._probe_backoff_s * 2.0,
+                         self._probe_backoff_max_s))
+            self._next_probe_at = self._clock() + self._probe_backoff_s
+
+    def note_draining(self) -> None:
+        """Router-side drain intent (ISSUE 17): the autoscaler marks
+        its victim BEFORE the SIGTERM goes out, so the poller
+        classifies the eventual disappearance as a scale event even
+        when the drain finishes inside one probe interval. Sticky: a
+        probe landing before the SIGTERM (the replica not yet aware it
+        is draining) must not clear the intent."""
+        with self._lock:
+            self._drain_intent = True
+
+    def probe_due(self) -> bool:
+        """Whether the health poller should spend a probe on this
+        replica this round (always true while reachable; on unreachable
+        replicas, only once per backoff interval)."""
+        with self._lock:
+            if self._probe_backoff_s <= 0:
+                return True
+            return self._clock() >= self._next_probe_at
 
     def probe(self, timeout_s: float = 2.0) -> bool:
         """One health round against the live replica: GET /healthz
@@ -233,7 +282,8 @@ class ReplicaState:
     @property
     def ready(self) -> bool:
         with self._lock:
-            return self._ready and not self._draining
+            return (self._ready
+                    and not (self._draining or self._drain_intent))
 
     @property
     def version(self) -> str:
@@ -261,13 +311,15 @@ class ReplicaState:
             out = {
                 "url": self.base_url,
                 "ready": self._ready,
-                "draining": self._draining,
+                "draining": self._draining or self._drain_intent,
                 "param_version": self._version,
                 "inflight": self._inflight,
                 "queue_depth": self._queue_depth,
                 "scraped_p99_ms": self._scraped_p99_ms,
                 "probes": self._probes,
                 "probe_ok": self._probe_ok,
+                "probe_ready": self._probe_ready,
+                "probe_backoff_s": self._probe_backoff_s,
                 "counts": dict(self.counts),
             }
         out["breaker"] = self.breaker.stats()

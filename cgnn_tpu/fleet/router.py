@@ -37,6 +37,7 @@ All policy state is host-side; the router never touches jax.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import os
 import queue
@@ -104,9 +105,12 @@ class FleetRouter:
             raise ValueError("a fleet needs at least one replica")
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
-        self.replicas = list(replicas)
-        self._by_rid = {r.rid: r for r in self.replicas}
-        if len(self._by_rid) != len(self.replicas):
+        # the routing table is MUTABLE now (ISSUE 17: the autoscaler
+        # adds/removes replicas at runtime) — storage lives behind
+        # self._lock, reads go through replica_list()/_replica()
+        self._replicas = list(replicas)
+        self._by_rid = {r.rid: r for r in self._replicas}
+        if len(self._by_rid) != len(self._replicas):
             raise ValueError("replica ids must be unique")
         self._transport = transport or http_transport
         self.max_attempts = int(max_attempts)
@@ -131,7 +135,14 @@ class FleetRouter:
             "fleet_exhausted": 0, "fleet_deadline_exceeded": 0,
             "fleet_transport_errors": 0, "fleet_passthrough_rejects": 0,
             "fleet_duplicate_answers": 0,
+            # ISSUE 17: the capacity ledger. A planned disappearance
+            # (drained scale-down, exit-75 preemption) is a SCALE
+            # EVENT; an unplanned one (kill -9, crash) an INCIDENT
+            "fleet_scale_events": 0, "fleet_incidents": 0,
         }
+        # replica lifecycle journal (add/remove/incident), mutated
+        # under self._lock like counts
+        self.lifecycle: collections.deque = collections.deque(maxlen=256)
         self._trace_prefix = os.urandom(3).hex()
         self._trace_seq = itertools.count(1)
         self._stop = threading.Event()
@@ -150,6 +161,10 @@ class FleetRouter:
         # incident flight recorder (observe/flightrec.py), attached by
         # the entrypoint; breaker trips + 5xx bursts dump bundles
         self.flightrec = None
+        # the self-driving layers (ISSUE 17), attached by the
+        # entrypoint; /stats folds their state in when present
+        self.autoscaler = None
+        self.remediator = None
         # ---- fleet SLO engine + metrics truth (ISSUE 16) ----
         # the router's latency histogram is MERGEABLE (observe/hist.py)
         # where the rolling quantiles above are local color; the SLO
@@ -211,6 +226,86 @@ class FleetRouter:
         if self._tsdb_collector is not None:
             self._tsdb_collector.stop()
 
+    # ---- routing-table membership (ISSUE 17) ----
+
+    @property
+    def replicas(self) -> list:
+        """A snapshot copy of the routed set — membership can change
+        under the autoscaler, so no caller may hold the live list."""
+        with self._lock:
+            return list(self._replicas)
+
+    def replica_list(self) -> list:
+        with self._lock:
+            return list(self._replicas)
+
+    def _replica(self, rid: int):
+        with self._lock:
+            return self._by_rid.get(rid)
+
+    def count(self, key: str) -> int:
+        with self._lock:
+            return int(self.counts.get(key, 0))
+
+    def rolling_latency(self) -> dict:
+        return self._lat_rolling.quantiles()
+
+    def lifecycle_events(self) -> list:
+        with self._lock:
+            return list(self.lifecycle)
+
+    def add_replica(self, state: ReplicaState) -> None:
+        """Route a new replica (the autoscaler's scale-up add). The
+        warm-pool contract makes this cheap: the replica is already
+        booted, warm()-compiled, and /healthz-ready when it lands here,
+        so adding it is one routing-table entry, not a warmup wait."""
+        with self._lock:
+            if state.rid in self._by_rid:
+                raise ValueError(f"replica id {state.rid} already routed")
+            self._replicas.append(state)
+            self._by_rid[state.rid] = state
+            self.lifecycle.append({
+                "t": self._clock(), "event": "add",
+                "replica": state.rid, "reason": "scale_up",
+            })
+        if self.flightrec is not None:
+            state.breaker.on_trip = self._on_breaker_trip
+
+    def remove_replica(self, rid: int,
+                       reason: str = "scale_down") -> ReplicaState | None:
+        """Unroute a replica; -> its state (None if already gone —
+        idempotent, because the health poller and the autoscaler's
+        drain thread can both notice the same disappearance).
+
+        ``reason`` decides the ledger: ``scale_down`` / ``preempt`` /
+        ``drained`` are SCALE EVENTS (planned capacity change — no
+        breaker trip, no incident bundle); ``incident`` /
+        ``remediation`` count as fleet incidents."""
+        with self._lock:
+            r = self._by_rid.pop(rid, None)
+            if r is None:
+                return None
+            self._replicas = [x for x in self._replicas if x.rid != rid]
+            if reason in ("scale_down", "preempt", "drained"):
+                self.counts["fleet_scale_events"] += 1
+            elif reason in ("incident", "remediation"):
+                self.counts["fleet_incidents"] += 1
+            self.lifecycle.append({
+                "t": self._clock(), "event": "remove",
+                "replica": rid, "reason": reason,
+            })
+        self._log(f"fleet: replica{rid} unrouted ({reason})")
+        return r
+
+    def begin_drain(self, rid: int) -> None:
+        """Mark a replica draining ROUTER-SIDE before its SIGTERM goes
+        out: even a drain that completes inside one probe interval is
+        then classified a scale event when it stops answering — the
+        poller never sees an un-flagged disappearance."""
+        r = self._replica(rid)
+        if r is not None:
+            r.note_draining()
+
     # ---- fleet SLO hooks (ISSUE 16) ----
 
     def _slo_tick(self) -> None:
@@ -265,22 +360,48 @@ class FleetRouter:
     def probe_all(self, timeout_s: float = 2.0) -> int:
         """Probe every replica once; returns how many are ready.
 
-        A reachable->unreachable TRANSITION (the wire died: kill -9, a
-        machine loss — not a draining/warming 503, which still answers
-        the probe) fires the flight recorder: the next poll round after
-        a replica vanishes is the deterministic moment to bundle the
-        fleet's last minutes, whether or not enough in-flight requests
-        happened to trip its breaker first."""
+        A reachable->unreachable TRANSITION (the wire died — not a
+        draining/warming 503, which still answers the probe) is
+        classified by intent (ISSUE 17): a replica that advertised
+        ``draining`` before vanishing finished a planned drain (scale-
+        down SIGTERM or an exit-75 preemption) and is removed as a
+        SCALE EVENT — no breaker trip, no incident bundle. An
+        un-flagged disappearance (kill -9, a machine loss) is an
+        INCIDENT: it stays routed (its breaker ejects it; a restart
+        re-admits it) and fires the flight recorder — the next poll
+        round after a replica vanishes is the deterministic moment to
+        bundle the fleet's last minutes, whether or not enough
+        in-flight requests happened to trip its breaker first.
+
+        Unreachable replicas back off their own probe cadence
+        (replica.probe_due): a dead replica costs one probe timeout at
+        a doubling interval, not one per poll round."""
         ready = 0
-        for r in self.replicas:
+        for r in self.replica_list():
+            if not r.probe_due():
+                continue
             was_reachable = r.stats()["probe_ok"]
             try:
                 ready += bool(r.probe(timeout_s))
             except Exception as e:  # noqa: BLE001 — the poller must survive
                 self._log(f"fleet: health probe {r.name} failed: {e!r}")
+            st = r.stats()
+            if not was_reachable or st["probe_ok"]:
+                continue
+            if st["draining"]:
+                # planned exit completed: a scale event, never an
+                # incident (counted inside remove_replica)
+                self.remove_replica(r.rid, reason="preempt")
+                continue
+            self._count("fleet_incidents")
+            with self._lock:
+                self.lifecycle.append({
+                    "t": self._clock(), "event": "incident",
+                    "replica": r.rid,
+                    "reason": "unreachable (not draining)",
+                })
             fr = self.flightrec
-            if (fr is not None and was_reachable
-                    and not r.stats()["probe_ok"]):
+            if fr is not None:
                 fr.trigger("replica_unreachable",
                            f"{r.name} ({r.base_url}) stopped answering "
                            f"health probes")
@@ -322,7 +443,8 @@ class FleetRouter:
     def _hedge_after_s(self, rid: int) -> float:
         if self.hedge_ms is not None:
             return max(self.hedge_ms, 0.0) / 1e3
-        p99 = self._by_rid[rid].local_p99_ms()
+        r = self._replica(rid)  # may be unrouted mid-flight (ISSUE 17)
+        p99 = r.local_p99_ms() if r is not None else 0.0
         return max(0.1, 2.0 * p99 / 1e3)
 
     def _retry_after_s(self) -> float:
@@ -580,13 +702,15 @@ class FleetRouter:
                 return status, payload or {}, meta(rid)
             # retryable: transport failure or 429/500/502/503
             tried_failed.add(rid)
+            fr_ = self._replica(rid)
+            rname = fr_.name if fr_ is not None else f"replica{rid}"
             if err is not None:
                 self._count("fleet_transport_errors")
-                last_failure = f"{self._by_rid[rid].name}: {err!r}"
+                last_failure = f"{rname}: {err!r}"
             else:
                 self._count(f"fleet_upstream_{status}")
                 detail = (payload or {}).get("error", "")
-                last_failure = f"{self._by_rid[rid].name}: HTTP {status} {detail}"
+                last_failure = f"{rname}: HTTP {status} {detail}"
             if live:
                 continue  # a hedge is still racing; let it win first
             if launched < self.max_attempts:
@@ -650,8 +774,10 @@ class FleetRouter:
     def stats(self) -> dict:
         with self._lock:
             counts = dict(self.counts)
+            lifecycle = list(self.lifecycle)
         out = {
             "counts": counts,
+            "lifecycle": lifecycle,
             "replicas": {str(r.rid): r.stats() for r in self.replicas},
             "versions": {str(k): v for k, v in self.versions().items()},
             "ready": self.ready_count(),

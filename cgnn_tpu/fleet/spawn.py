@@ -20,6 +20,7 @@ import signal
 import subprocess
 import sys
 import time
+from typing import Callable
 
 from cgnn_tpu.fleet.replica import FleetTransportError, http_get_json
 
@@ -125,6 +126,84 @@ class ReplicaProcess:
         if self.alive():
             self.kill9()
         return self.start()
+
+
+class RestartBackoff:
+    """The crash-loop guard (ISSUE 17): a replica that dies during
+    boot/warmup waits exponentially longer before each retry and the
+    supervisor GIVES UP after ``give_up`` attempts — a broken
+    checkpoint or a poisoned flag must never hot-loop respawns.
+
+    Pure arithmetic on an injectable clock; ``next_delay()`` returns
+    the seconds to wait before the next attempt or None when the
+    budget is spent. ``reset()`` on the first healthy boot restores
+    the full budget (an occasional preemption is not a crash loop)."""
+
+    def __init__(self, *, base_s: float = 0.5, mult: float = 2.0,
+                 max_s: float = 30.0, give_up: int = 5,
+                 clock: Callable[[], float] = time.monotonic):
+        if give_up < 1:
+            raise ValueError(f"give_up must be >= 1, got {give_up}")
+        self.base_s = float(base_s)
+        self.mult = float(mult)
+        self.max_s = float(max_s)
+        self.give_up = int(give_up)
+        self._clock = clock
+        self.failures = 0
+        self.last_failure_t: float | None = None
+
+    def next_delay(self) -> float | None:
+        """Record one boot failure; -> seconds to back off before the
+        next attempt, or None when the give-up cap is spent."""
+        self.failures += 1
+        self.last_failure_t = self._clock()
+        if self.failures >= self.give_up:
+            return None
+        return min(self.base_s * self.mult ** (self.failures - 1),
+                   self.max_s)
+
+    def reset(self) -> None:
+        self.failures = 0
+        self.last_failure_t = None
+
+    def stats(self) -> dict:
+        return {"failures": self.failures, "give_up": self.give_up,
+                "base_s": self.base_s, "max_s": self.max_s}
+
+
+def boot_with_retries(
+    proc: ReplicaProcess,
+    *,
+    wait_ready_s: float = 300.0,
+    backoff: RestartBackoff | None = None,
+    log_fn: Callable = print,
+    sleep: Callable[[float], None] = time.sleep,
+) -> bool:
+    """Supervised boot: start ``proc`` and wait for readiness,
+    restarting through ``backoff`` when it dies during boot/warmup;
+    -> True once healthy, False when the backoff gives up (the proc is
+    reaped). The ``boot_crash=N`` fault point pins this: N boots die
+    during warmup, the N+1st succeeds — under the default budget the
+    supervisor outlasts the fault without hot-looping."""
+    backoff = backoff or RestartBackoff()
+    while True:
+        proc.start()
+        if proc.wait_ready(wait_ready_s):
+            backoff.reset()
+            return True
+        delay = backoff.next_delay()
+        if proc.alive():
+            # ready-timeout, not a crash: a wedged warmup retries too,
+            # but the old process must die first
+            proc.kill9()
+        if delay is None:
+            log_fn(f"fleet: replica{proc.rid} crash-looped "
+                   f"{backoff.failures}x during boot; giving up")
+            proc.terminate(timeout_s=5.0)
+            return False
+        log_fn(f"fleet: replica{proc.rid} died during boot "
+               f"(attempt {backoff.failures}); retrying in {delay:.2f}s")
+        sleep(delay)
 
 
 def spawn_fleet(

@@ -100,6 +100,14 @@ class FlightRecorder:
         self.triggers: dict[str, int] = {}
         self.last_bundle = ""
         self._dump_thread: threading.Thread | None = None
+        # trigger subscription (ISSUE 17): called as
+        # ``on_trigger(reason, detail, bundle_dir_or_None)`` after
+        # every trigger — INCLUDING rate-limited ones (bundle None), so
+        # an auto-remediator never misses an incident just because its
+        # evidence bundle was suppressed. Called outside the lock;
+        # exceptions are swallowed (a broken subscriber must not take
+        # the serving process down)
+        self.on_trigger: Callable | None = None
 
     # ---- the always-on cheap path ----
 
@@ -194,28 +202,43 @@ class FlightRecorder:
                        or self.bundles >= self.max_bundles)
             if busy or (limited and not force):
                 self.suppressed += 1
-                return None
-            self._last_dump = now
-            self.bundles += 1
-            # pid in the name: replicas sharing one --flightrec-dir
-            # (the serve.py 'auto' default under a shared ckpt dir)
-            # firing in the same second must land in DISTINCT dirs,
-            # never interleave files inside one
-            stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
-            bundle = os.path.join(
-                self.out_dir,
-                f"bundle-{stamp}-p{os.getpid()}"
-                f"-{self.bundles:02d}-{reason}")
-            self.last_bundle = bundle
-            t = threading.Thread(
-                target=self._dump, args=(bundle, reason, detail),
-                daemon=True, name=f"flightrec-dump-{self.bundles}",
-            )
-            self._dump_thread = t
+                bundle = t = None
+            else:
+                self._last_dump = now
+                self.bundles += 1
+                # pid in the name: replicas sharing one --flightrec-dir
+                # (the serve.py 'auto' default under a shared ckpt dir)
+                # firing in the same second must land in DISTINCT dirs,
+                # never interleave files inside one
+                stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+                bundle = os.path.join(
+                    self.out_dir,
+                    f"bundle-{stamp}-p{os.getpid()}"
+                    f"-{self.bundles:02d}-{reason}")
+                self.last_bundle = bundle
+                t = threading.Thread(
+                    target=self._dump, args=(bundle, reason, detail),
+                    daemon=True, name=f"flightrec-dump-{self.bundles}",
+                )
+                self._dump_thread = t
+        if t is None:
+            self._notify(reason, detail, None)
+            return None
         t.start()
+        self._notify(reason, detail, bundle)
         if wait:
             t.join(timeout=60.0)
         return bundle
+
+    def _notify(self, reason: str, detail: str,
+                bundle: str | None) -> None:
+        cb = self.on_trigger
+        if cb is None:
+            return
+        try:
+            cb(reason, detail, bundle)
+        except Exception as e:  # noqa: BLE001 — see on_trigger contract
+            self._log(f"flightrec: on_trigger subscriber failed: {e!r}")
 
     def wait_idle(self, timeout_s: float = 60.0) -> None:
         with self._lock:

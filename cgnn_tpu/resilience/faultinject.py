@@ -37,6 +37,22 @@ harness drives; all counted over the SERVING dispatch stream):
   connection without a response: how a dying replica presents on the
   wire (the router's transport-error retry path).
 
+Fleet autoscale/remediation fault points (ISSUE 17):
+
+- ``boot_crash=N``   — die with ``os._exit(7)`` during warmup for the
+  first N boots of this REPLICA, then boot clean: the crash-loop-guard
+  pin (``fleet/spawn.py`` restart backoff + give-up cap). Boot counts
+  persist across processes in the file named by the
+  ``CGNN_TPU_FAULT_STATE`` env var (one appended byte per boot) — a
+  crash leaves no in-process state, so the counter cannot;
+- ``wedge_warm[=SECS]`` — hang in warm() for SECS (default 600)
+  seconds: the listener is up but ``/healthz`` stays not-ready, the
+  wedged-boot case ``wait_ready`` timeouts + restart backoff cover;
+- ``exit75_at=N``    — deliver SIGTERM to ourselves at the N-th
+  (0-based) flush dispatch and exit with the PR-2 resumable code 75
+  after the drain: a mid-load preemption, which the fleet must record
+  as a SCALE EVENT (breaker untripped, no incident bundle).
+
 With the variable unset every hook is a cheap no-op: ``plan()`` is
 ``None`` and iterators are returned unwrapped.
 
@@ -57,6 +73,11 @@ from typing import Iterable, Iterator
 import numpy as np
 
 ENV_VAR = "CGNN_TPU_FAULTS"
+# cross-process fault state (ISSUE 17): ``boot_crash`` counts BOOTS,
+# and a boot that crashes takes its in-process counters with it — the
+# harness points this at a file, each boot appends one byte, and the
+# file size is the count that survives the crash
+STATE_ENV = "CGNN_TPU_FAULT_STATE"
 
 # serve-side ordinal counters are bumped from concurrent dispatch /
 # HTTP-handler threads; the lock keeps "every N-th" exactly every N-th
@@ -94,12 +115,17 @@ class FaultPlan:
     slow_dispatch_ms: float | None = None
     slow_every: int = 1
     drop_conn: int | None = None
+    # fleet autoscale/remediation faults (ISSUE 17)
+    boot_crash: int | None = None
+    wedge_warm: float | None = None
+    exit75_at: int | None = None
     # mutable hit counters (the determinism bookkeeping)
     _crash_hits: dict = dataclasses.field(default_factory=dict)
     _batches_seen: int = 0
     _sigterm_fired: bool = False
     _dispatches_seen: int = 0
     _conns_seen: int = 0
+    _exit75_fired: bool = False
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -135,6 +161,12 @@ class FaultPlan:
                     plan.slow_every = max(1, int(fields[1]))
             elif key == "drop_conn":
                 plan.drop_conn = int(value)
+            elif key == "boot_crash":
+                plan.boot_crash = int(value)
+            elif key == "wedge_warm":
+                plan.wedge_warm = float(value) if value else 600.0
+            elif key == "exit75_at":
+                plan.exit75_at = int(value)
             else:
                 raise ValueError(
                     f"unknown fault key {key!r} in {ENV_VAR}={spec!r}"
@@ -174,6 +206,12 @@ class FaultPlan:
             )
         if self.drop_conn is not None:
             parts.append(f"drop every {self.drop_conn}th connection")
+        if self.boot_crash is not None:
+            parts.append(f"crash first {self.boot_crash} boot(s)")
+        if self.wedge_warm is not None:
+            parts.append(f"wedge warm() ({self.wedge_warm:g} s)")
+        if self.exit75_at is not None:
+            parts.append(f"preempt (exit 75) @flush {self.exit75_at}")
         return ", ".join(parts) or "none"
 
 
@@ -272,6 +310,44 @@ def poison_batches(batches: Iterable) -> Iterator:
     return wrapped()
 
 
+def boot_point() -> None:
+    """Fleet boot fault point (ISSUE 17), called by serve.py right
+    before warm(): the listener is already bound (so /healthz answers,
+    not-ready), which is exactly when real warmup deaths happen.
+
+    ``boot_crash=N`` appends one byte to the ``CGNN_TPU_FAULT_STATE``
+    file and dies with ``os._exit(7)`` while the file holds <= N bytes
+    — so the first N boots crash and the N+1st proceeds, across
+    processes. Without a state file every boot crashes (the give-up
+    pin). ``wedge_warm`` just hangs here."""
+    p = plan()
+    if p is None or (p.boot_crash is None and p.wedge_warm is None):
+        return
+    if p.boot_crash is not None:
+        state = os.environ.get(STATE_ENV, "")
+        boots = p.boot_crash + 1  # no state file: crash every boot
+        if state:
+            fd = os.open(state, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            try:
+                os.write(fd, b"b")
+            finally:
+                os.close(fd)
+            boots = os.path.getsize(state)
+        if boots <= p.boot_crash:
+            os._exit(7)  # mid-warmup death: no cleanup, no drain
+    if p.wedge_warm is not None:
+        time.sleep(p.wedge_warm)
+
+
+def exit75_requested() -> bool:
+    """True once ``exit75_at`` has fired: serve.py's clean-drain path
+    then exits with the PR-2 resumable code 75 instead of 0 — the
+    preemption signature the fleet records as a scale event."""
+    p = plan()
+    return p is not None and p._exit75_fired
+
+
 def dispatch_point() -> None:
     """Serve-side fault point, called once per flush dispatch (ISSUE
     14). Counts dispatches across the run and fires the configured
@@ -279,11 +355,20 @@ def dispatch_point() -> None:
     None check) without a plan."""
     p = plan()
     if p is None or (p.dispatch_exc is None and p.wedge_flush is None
-                     and p.slow_dispatch_ms is None):
+                     and p.slow_dispatch_ms is None
+                     and p.exit75_at is None):
         return
     with _serve_lock:  # concurrent per-device dispatch threads
         i = p._dispatches_seen
         p._dispatches_seen += 1
+        fire75 = (p.exit75_at is not None and i >= p.exit75_at
+                  and not p._exit75_fired)
+        if fire75:
+            p._exit75_fired = True
+    if fire75:
+        # a preemption notice mid-load (ISSUE 17): SIGTERM ourselves —
+        # the normal graceful drain runs, then serve.py exits 75
+        os.kill(os.getpid(), signal.SIGTERM)
     if p.slow_dispatch_ms is not None and i % p.slow_every == 0:
         time.sleep(p.slow_dispatch_ms / 1e3)
     if p.wedge_flush is not None and i == p.wedge_flush:

@@ -70,6 +70,27 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="ARG", help="extra argument passed through to "
                                        "every serve.py replica "
                                        "(repeatable)")
+    # ---- self-driving fleet (ISSUE 17) ----
+    p.add_argument("--autoscale", action="store_true",
+                   help="close the control loop: grow/shrink the "
+                        "routed replica set against the scraped signal "
+                        "plane (queue depth, p99 vs SLO, burn rates, "
+                        "shed) with hysteresis + cooldowns; drained "
+                        "exits are scale events, never incidents")
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="autoscaler lower bound on the routed set")
+    p.add_argument("--max-replicas", type=int, default=8,
+                   help="autoscaler upper bound on the routed set")
+    p.add_argument("--warm-pool", type=int, default=1,
+                   help="spare replicas kept booted + warm()-compiled "
+                        "but unrouted, so scale-up is a routing-table "
+                        "add instead of a multi-second warmup")
+    p.add_argument("--remediate", action="store_true",
+                   help="auto-remediation (needs --flightrec-dir): "
+                        "subscribe to flight-recorder triggers and "
+                        "replace-and-drain wedged replicas, every "
+                        "action journaled to remediation.jsonl naming "
+                        "its evidence bundle")
     p.add_argument("--trace-ring", type=int, default=65536, metavar="N",
                    help="router span ring behind GET /trace (+ the "
                         "on-demand fleet join GET /trace/joined); "
@@ -198,6 +219,60 @@ def main(argv=None) -> int:
             log_fn=log,
         ))
 
+    # ---- the self-driving layers (ISSUE 17) ----
+    autoscaler = None
+    if args.autoscale or args.remediate:
+        from cgnn_tpu.fleet.autoscale import AutoscalePolicy, Autoscaler
+        from cgnn_tpu.fleet.spawn import ReplicaProcess
+
+        def _proc_factory(rid: int) -> ReplicaProcess:
+            log_path = (os.path.join(args.log_dir, f"replica-{rid}.log")
+                        if args.log_dir else None)
+            return ReplicaProcess(
+                rid, args.ckpt_dir, args.replica_base_port + rid,
+                host=args.host, log_path=log_path,
+                serve_args=serve_args)
+
+        def _state_factory(rid: int, base_url: str) -> ReplicaState:
+            return ReplicaState(
+                rid, base_url, breaker_k=args.breaker_k,
+                breaker_cooldown_s=args.breaker_cooldown)
+
+        autoscaler = Autoscaler(
+            router,
+            AutoscalePolicy(min_replicas=args.min_replicas,
+                            max_replicas=args.max_replicas,
+                            warm_target=args.warm_pool if args.autoscale
+                            else 0),
+            _proc_factory, _state_factory,
+            # seed ownership with the boot fleet so scale-down can
+            # drain and reap the initial replicas too
+            procs={p.rid: p for p in procs}, next_rid=args.replicas,
+            poll_interval_s=max(args.health_interval, 0.25),
+            drain_timeout_s=args.drain_timeout, log_fn=log,
+        )
+        router.autoscaler = autoscaler
+        if args.autoscale:
+            # without --autoscale the instance is just the process
+            # machinery the remediator replaces through (no loop)
+            autoscaler.start()
+
+    remediator = None
+    if args.remediate:
+        if router.flightrec is None:
+            print("fleet: --remediate needs --flightrec-dir (the "
+                  "remediator consumes flight-recorder triggers)",
+                  file=sys.stderr)
+            return 2
+        from cgnn_tpu.fleet.remediate import Remediator
+
+        remediator = Remediator(
+            router, autoscaler,
+            out_dir=args.flightrec_dir,
+            drain_timeout_s=args.drain_timeout, log_fn=log,
+        ).attach(router.flightrec)
+        router.remediator = remediator
+
     httpd = make_fleet_http_server(router, host=args.host, port=args.port)
     stop = threading.Event()
     handler = PreemptionHandler(
@@ -239,15 +314,28 @@ def main(argv=None) -> int:
             f"{len(doc['traces'])} trace(s)"
             + (f"; unreachable: {sorted(errors)}" if errors else "")
             + ")")
-    codes = [p.terminate(timeout_s=args.drain_timeout) for p in procs]
+    if remediator is not None:
+        remediator.stop()
+    if autoscaler is not None:
+        # drains EVERYTHING the autoscaler owns: the boot fleet it was
+        # seeded with, scaled-up replicas, and warm-pool spares
+        codes = list(autoscaler.shutdown(
+            drain_timeout_s=args.drain_timeout).values())
+    else:
+        codes = [p.terminate(timeout_s=args.drain_timeout) for p in procs]
     handler.uninstall()
     if router.flightrec is not None:
         router.flightrec.wait_idle(timeout_s=15.0)
     stats = router.stats()["counts"]
     log(f"fleet: drained — {stats['fleet_answered']} answered, "
         f"{stats['fleet_retries']} retries, {stats['fleet_hedges']} "
-        f"hedges, {stats['fleet_shed']} shed; replica exits {codes}")
-    if any(c != 0 for c in codes):
+        f"hedges, {stats['fleet_shed']} shed; "
+        f"{stats['fleet_scale_events']} scale events, "
+        f"{stats['fleet_incidents']} incidents; replica exits {codes}")
+    # the PR-2 resumable code 75 is a PREEMPTION, not a failure: a
+    # drained exit-75 replica left cleanly (the scale-event contract)
+    bad = [c for c in codes if c not in (0, 75)]
+    if bad:
         print(f"fleet: replica drain failures: {codes}", file=sys.stderr)
         return 1
     return 0

@@ -44,6 +44,24 @@
 #     every replica's own scrape, the router's fleet latency histogram
 #     count EXACTLY equal to answered requests, and its median in
 #     agreement with the client-measured p50 within bucket resolution.
+#  6. AUTOSCALE RAMP LEG (ISSUE 17, --autoscale --ramp): open-loop
+#     load ramps low -> peak -> calm tail over 2 replicas while one
+#     replica takes an injected preemption notice (exit75_at: SIGTERM
+#     itself mid-load, drain, exit 75). The loadgen hard-asserts the
+#     whole self-driving arc: the fleet GREW before any request was
+#     shed (here: zero shed at all), SHRANK back on the calm tail
+#     with zero lost accepted requests, the preempted replica's
+#     announced exit was recorded as a SCALE EVENT (code 75, counted
+#     in fleet_scale_events, breaker untouched) and NOT an incident
+#     (fleet_incidents == 0, no flight-recorder bundle).
+#  7. REMEDIATION WEDGE LEG (ISSUE 17, --remediate): one replica's
+#     flush WEDGES mid-load (health plane keeps answering, dispatch
+#     plane times out until the breaker trips). The breaker-trip
+#     flight-recorder bundle must drive the remediator's
+#     replace-and-drain — replacement routed from the warm pool,
+#     victim unrouted (counted fleet_incidents) and force-reaped past
+#     the drain bound — under continuing load with ZERO lost accepted
+#     requests, and remediation.jsonl must name the justifying bundle.
 #
 # Runs anywhere jax[cpu] does (synthetic data, CPU device).
 set -euo pipefail
@@ -354,6 +372,90 @@ print("leg 5 ok:", r["answered"], "answered | alert fired",
       "histogram families | router hist count", lt["hist_count"],
       "== answered, p50", lt["hist_p50_ms"], "~", lt["measured_p50_ms"],
       "ms | bundle:", b["bundle"])
+EOF
+
+echo "== leg 6: load ramp -> elastic autoscale + exit-75 preemption =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 2 --fleet-base-port "$((BASE + 50))" \
+  --fleet-log-dir "$WORK/fleet6-logs" \
+  --clients 16 --duration 30 --ramp 4:60 \
+  --autoscale --min-replicas 2 --max-replicas 4 --warm-pool 1 \
+  --replica-faults "exit75_at=15" --faulty-replica 1 \
+  --no-scrape \
+  --report "$WORK/fleet_ramp.json"
+python - "$WORK/fleet_ramp.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+fl = r["fleet"]
+rc = fl["router"]["counts"]
+auto = fl["autoscale"]
+counts = auto["counts"]
+# the fleet grew under the ramp and shrank back on the calm tail
+assert counts["scale_ups"] >= 1, counts
+assert counts["scale_downs"] >= 1, counts
+# grew before shedding — here strictly: it never shed at all
+assert rc["fleet_shed"] == 0, rc
+# the announced exits (injected exit-75 preemption + the autoscaler's
+# own drained scale-downs) are SCALE EVENTS, never incidents
+assert rc["fleet_scale_events"] >= 1, rc
+assert rc["fleet_incidents"] == 0, rc
+# the preempted replica delivered the resumable code, not a crash
+assert fl["replica_exit_codes"][1] == 75, fl["replica_exit_codes"]
+# every autoscaler-owned replica drained clean at teardown
+assert all(c in (0, 75) for c in auto["exit_codes"].values()), auto
+ups = [e for e in auto["events"] if e["action"] == "scale_up"]
+downs = [e for e in auto["events"] if e["action"] == "scale_down"]
+print("leg 6 ok:", r["answered"], "answered, 0 shed |",
+      counts["scale_ups"], "up /", counts["scale_downs"], "down |",
+      "first up @", round(ups[0]["t_s"], 1), "s, first down @",
+      round(downs[0]["t_s"], 1), "s |", rc["fleet_scale_events"],
+      "scale events, 0 incidents | preempt exit",
+      fl["replica_exit_codes"][1])
+EOF
+
+echo "== leg 7: wedged flush -> flight-recorder-driven remediation =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 2 --fleet-base-port "$((BASE + 55))" \
+  --fleet-log-dir "$WORK/fleet7-logs" \
+  --clients 12 --duration 35 \
+  --replica-faults "wedge_flush=25:600" --faulty-replica 1 \
+  --remediate --warm-pool 1 --max-replicas 4 \
+  --timeout-ms 5000 --hedge-ms 100 --no-scrape \
+  --report "$WORK/fleet_wedge.json"
+python - "$WORK/fleet_wedge.json" <<'EOF'
+import json, os, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+fl = r["fleet"]
+rc = fl["router"]["counts"]
+rem = fl["remediation"]
+acts = rem["actions"]
+assert acts, "remediator never acted"
+a = acts[0]
+# the action chain is auditable: the breaker-trip evidence bundle is
+# named by the action that it justified
+assert a["action"] == "replace_and_drain", a
+assert a["replica"] == 1, a
+assert a["bundle"], a
+assert a["replacement"] is not None, a
+# the replacement actually answered traffic
+rbd = r["devices"]["responses_by_device"]
+assert rbd.get(str(a["replacement"]), 0) > 0, (a, rbd)
+# the victim is out of rotation; its removal counted an INCIDENT
+# (remediation is a failure response, not elastic sizing)
+assert str(a["replica"]) not in fl["router"]["replicas"], (
+    list(fl["router"]["replicas"]))
+assert rc["fleet_incidents"] >= 1, rc
+# the journal on disk carries the same evidence chain
+entries = [json.loads(line) for line in
+           open(os.path.join(os.path.dirname(sys.argv[1]),
+                             "remediation.jsonl"))]
+assert entries and all(e["bundle"] for e in entries), entries
+print("leg 7 ok:", r["answered"], "answered, 0 lost | replica",
+      a["replica"], "->", a["replacement"], "|",
+      len(entries), "journal entr(y/ies), evidence:",
+      os.path.basename(a["bundle"]))
 EOF
 
 echo "fleet smoke: ALL LEGS PASSED"

@@ -89,6 +89,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "'slow_dispatch=150' for the hedging leg or "
                         "'dispatch_exc=5' for the 500-retry leg")
     p.add_argument("--faulty-replica", type=int, default=2)
+    # ---- the self-driving fleet (ISSUE 17) ----
+    p.add_argument("--ramp", default="", metavar="LOW:PEAK",
+                   help="fleet mode (ISSUE 17): open-loop fleet-total "
+                        "request rate in rps — holds LOW, climbs to "
+                        "PEAK by mid-duration, then drops to a calm "
+                        "tail. With --autoscale the self-driving "
+                        "invariants are hard-asserted: the fleet grew "
+                        "BEFORE any request was shed on the way up and "
+                        "shrank with zero lost accepted on the way down")
+    p.add_argument("--autoscale", action="store_true",
+                   help="fleet mode (ISSUE 17): run the SLO-signal-"
+                        "driven autoscaler over the replica set "
+                        "(hysteresis decision core, prewarmed spare "
+                        "pool, drain-then-reap scale-down); drained "
+                        "exits must be recorded as scale events, never "
+                        "incidents (asserted)")
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="autoscaler lower bound")
+    p.add_argument("--max-replicas", type=int, default=4,
+                   help="autoscaler upper bound")
+    p.add_argument("--warm-pool", type=int, default=1,
+                   help="pre-compiled unrouted spares kept warm "
+                        "(prewarmed before load, so a scale-up is a "
+                        "routing-table add, not a cold boot)")
+    p.add_argument("--remediate", action="store_true",
+                   help="fleet mode (ISSUE 17): attach the flight-"
+                        "recorder-driven remediator; a wedged replica "
+                        "(--replica-faults wedge_flush=N — health "
+                        "plane answers, dispatch plane trips its "
+                        "breaker) must be replaced-and-drained with "
+                        "zero lost accepted, and every action's "
+                        "remediation.jsonl entry must name the "
+                        "evidence bundle that justified it (asserted; "
+                        "needs --trace-ring > 0)")
     p.add_argument("--retries", type=int, default=3,
                    help="fleet router max extra attempts per request")
     p.add_argument("--hedge-ms", type=float, default=None,
@@ -905,6 +939,12 @@ def _run_fleet(args) -> dict:
         "--poll-interval", "0.5",
         "--drain-timeout", "30",
     ]
+    if args.autoscale or args.remediate:
+        # drain with the listener up, then linger past a health-probe
+        # round (0.5 s here) so the router OBSERVES the draining flag
+        # before the process exits — what classifies the disappearance
+        # as a scale event instead of an incident
+        serve_args += ["--drain-linger", "1.5"]
     procs = []
     for i in range(n):
         env = dict(os.environ)
@@ -978,6 +1018,63 @@ def _run_fleet(args) -> dict:
         )
         router.attach_flight_recorder(recorder)
 
+    # ---- the self-driving layer (ISSUE 17) ----
+    autoscaler = None
+    remediator = None
+    asc_t0_mono = 0.0
+    if args.autoscale or args.remediate:
+        from cgnn_tpu.fleet.autoscale import AutoscalePolicy, Autoscaler
+        from cgnn_tpu.fleet.remediate import (
+            RemediationPolicy,
+            Remediator,
+        )
+
+        def _proc_factory(rid: int):
+            return ReplicaProcess(
+                rid, args.ckpt_dir, args.fleet_base_port + rid,
+                log_path=os.path.join(log_dir, f"replica-{rid}.log"),
+                serve_args=serve_args)
+
+        def _state_factory(rid: int, base_url: str):
+            return ReplicaState(rid, base_url,
+                                breaker_k=args.breaker_k,
+                                breaker_cooldown_s=args.breaker_cooldown)
+
+        # smoke-scale policy: second-scale cooldowns/sustain so the
+        # whole grow-then-shrink arc fits inside one short leg
+        asc_policy = AutoscalePolicy(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            up_queue_per_replica=2.0,
+            down_queue_per_replica=0.4,
+            cooldown_up_s=2.0, cooldown_down_s=4.0, down_sustain_s=3.0,
+            warm_target=args.warm_pool)
+        asc_t0_mono = time.monotonic()
+        autoscaler = Autoscaler(
+            router, asc_policy, _proc_factory, _state_factory,
+            procs={p.rid: p for p in procs}, next_rid=n,
+            poll_interval_s=0.5, drain_timeout_s=30.0)
+        router.autoscaler = autoscaler
+        if args.warm_pool > 0:
+            warmed = autoscaler.prewarm()
+            print(f"loadgen: prewarmed {warmed} spare replica(s) "
+                  f"(pool {autoscaler.stats()['warm_pool']})")
+        if args.autoscale:
+            autoscaler.start()
+        if args.remediate:
+            if recorder is None:
+                raise RuntimeError("--remediate needs the flight "
+                                   "recorder (--trace-ring > 0)")
+            remediator = Remediator(
+                router, autoscaler,
+                RemediationPolicy(min_interval_s=2.0),
+                out_dir=os.path.dirname(os.path.abspath(args.report))
+                or ".",
+                # a wedged victim cannot drain; kill9 past this bound
+                drain_timeout_s=8.0,
+            ).attach(recorder)
+            router.remediator = remediator
+
     from cgnn_tpu.data.dataset import load_synthetic
 
     meta = CheckpointManager(args.ckpt_dir).read_meta("latest")
@@ -999,11 +1096,35 @@ def _run_fleet(args) -> dict:
     fleet_counts = {"attempts_hist": {}, "hedged_answers": 0,
                     "retried_answers": 0}
 
+    # open-loop rate ramp (ISSUE 17): fleet-total rps as a function of
+    # elapsed fraction — hold LOW, climb to PEAK by mid-duration, hold,
+    # then drop to a calm tail (the autoscaler's scale-down window)
+    ramp = None
+    if args.ramp:
+        _lo, _peak = (float(x) for x in args.ramp.split(":", 1))
+        ramp = (_lo, _peak)
+
+    def _ramp_rate(frac: float) -> float:
+        lo, peak = ramp
+        if frac < 0.1:
+            return lo
+        if frac < 0.45:
+            return lo + (peak - lo) * (frac - 0.1) / 0.35
+        if frac < 0.6:
+            return peak
+        return max(lo * 0.5, 0.5)
+
     def client(ci: int):
         import numpy as _np
 
         rng = _np.random.default_rng(args.seed + ci)
         while not stop.is_set():
+            t_pace = None
+            if ramp is not None:
+                frac = (time.monotonic() - t_start) / max(args.duration,
+                                                          1e-9)
+                rate = _ramp_rate(min(frac, 1.0))
+                t_pace = time.monotonic() + args.clients / max(rate, 0.1)
             body = bodies[int(rng.integers(len(bodies)))]
             with stats.lock:
                 stats.submitted += 1
@@ -1013,7 +1134,11 @@ def _run_fleet(args) -> dict:
             except Exception as e:  # noqa: BLE001 — report, don't die
                 with stats.lock:
                     stats.errors.append(repr(e))
+                if t_pace is not None:
+                    stop.wait(max(0.0, t_pace - time.monotonic()))
                 continue
+            if t_pace is not None:
+                stop.wait(max(0.0, t_pace - time.monotonic()))
             with stats.lock:
                 if status == 200:
                     stats.answered += 1
@@ -1111,6 +1236,27 @@ def _run_fleet(args) -> dict:
     for t in side:
         t.start()
 
+    # ---- the scale-event timeline (ISSUE 17) ----
+    # samples the router's own counters so the grew-BEFORE-shed assert
+    # compares times from one clock, not inferred ordering
+    scale_watch: dict = {}
+    if autoscaler is not None:
+
+        def scale_watcher():
+            while not stop.is_set():
+                if ("first_shed_at_s" not in scale_watch
+                        and router.count("fleet_shed") > 0):
+                    scale_watch["first_shed_at_s"] = round(
+                        time.monotonic() - t_start, 2)
+                if ("first_scale_event_at_s" not in scale_watch
+                        and router.count("fleet_scale_events") > 0):
+                    scale_watch["first_scale_event_at_s"] = round(
+                        time.monotonic() - t_start, 2)
+                stop.wait(0.1)
+
+        threading.Thread(target=scale_watcher, daemon=True,
+                         name="loadgen-fleet-scalewatch").start()
+
     # ---- the SLO alert watcher (ISSUE 16, --slo-report) ----
     slo_thread = None
     slo_timeline: dict = {}
@@ -1202,6 +1348,13 @@ def _run_fleet(args) -> dict:
     if scraper.is_alive():
         scraper.join(timeout=30.0)
     wall = time.monotonic() - t_start
+    # quiesce the self-driving layer BEFORE the router stops: the
+    # remediator must not act on teardown noise, and autoscaler.stop()
+    # joins any scale-down drain still in flight
+    if remediator is not None:
+        remediator.stop()
+    if autoscaler is not None:
+        autoscaler.stop()
     slo_report: dict = {}
     if slo_thread is not None:
         # the resolve leg may land AFTER the load ends (the router's
@@ -1278,6 +1431,16 @@ def _run_fleet(args) -> dict:
                 observe_report["bundle_cross_process_requests"] = (
                     bundle_cross_max)
     exit_codes = [p.terminate(timeout_s=60.0) for p in procs]
+    # replicas the autoscaler booted (routed replacements + warm pool
+    # spares) drain separately — 75 is the preemption-clean exit
+    autoscaled_exits: dict = {}
+    if autoscaler is not None:
+        for rid in autoscaler.stats()["owned"]:
+            if rid >= n:
+                pr = autoscaler.proc_for(rid)
+                if pr is not None:
+                    autoscaled_exits[str(rid)] = pr.terminate(
+                        timeout_s=60.0)
 
     lat = np.asarray(stats.latencies) if stats.latencies else np.zeros(1)
     with stats.lock:
@@ -1340,6 +1503,20 @@ def _run_fleet(args) -> dict:
         report["fleet"]["metrics_scrape"] = scrape
     if slo_report:
         report["fleet"]["slo"] = slo_report
+    if autoscaler is not None:
+        a_stats = autoscaler.stats()
+        # events carry t_s relative to the autoscaler's own birth;
+        # t0_offset_s maps them onto the load timeline (t_start = 0)
+        a_stats["t0_offset_s"] = round(asc_t0_mono - t_start, 3)
+        a_stats.update(scale_watch)
+        a_stats["exit_codes"] = autoscaled_exits
+        report["fleet"]["autoscale"] = a_stats
+    if remediator is not None:
+        rem_stats = remediator.stats()
+        rem_stats["journal"] = os.path.join(
+            os.path.dirname(os.path.abspath(args.report)) or ".",
+            "remediation.jsonl")
+        report["fleet"]["remediation"] = rem_stats
     return report
 
 
@@ -1813,17 +1990,108 @@ def main(argv=None) -> int:
                 "expected hedged requests (--expect-hedges) but none "
                 "fired"
             )
+        # exits 0 (drained) and 75 (resumable preemption, PR 2) are
+        # both clean; a remediated victim was force-reaped on purpose
+        remediated = {a.get("replica") for a in
+                      fl.get("remediation", {}).get("actions", [])}
         codes = fl["replica_exit_codes"]
         bad_exits = [
             (i, c) for i, c in enumerate(codes)
-            if c != 0 and not (i == fl["victim"] and args.kill_at > 0
-                               and args.restart_at == 0)
+            if c not in (0, 75) and i not in remediated
+            and not (i == fl["victim"] and args.kill_at > 0
+                     and args.restart_at == 0)
         ]
         if bad_exits:
             failures.append(
                 f"replica drain exits non-zero: {bad_exits} "
-                f"(graceful SIGTERM drain must exit 0)"
+                f"(graceful SIGTERM drain must exit 0 or 75)"
             )
+        for rid_s, c in (fl.get("autoscale", {}).get("exit_codes")
+                         or {}).items():
+            if c not in (0, 75) and int(rid_s) not in remediated:
+                failures.append(
+                    f"autoscaled replica {rid_s} drain exit {c} "
+                    f"(must be 0 or 75)")
+        if args.autoscale:
+            # ---- the self-driving scaling invariants (ISSUE 17) ----
+            auto = fl.get("autoscale", {})
+            ac = auto.get("counts", {})
+            if not ac.get("scale_ups"):
+                failures.append(
+                    "autoscale leg: the fleet never grew under the ramp")
+            if args.ramp and not ac.get("scale_downs"):
+                failures.append(
+                    "autoscale leg: the fleet never shrank after the "
+                    "ramp-down")
+            if rc.get("fleet_shed"):
+                # shedding is legitimate ONLY after growth was attempted:
+                # the first scale-up must predate the first shed
+                ups = [e for e in auto.get("events", [])
+                       if e["action"] == "scale_up"]
+                first_up = (ups[0]["t_s"] + auto.get("t0_offset_s", 0.0)
+                            if ups else None)
+                first_shed = auto.get("first_shed_at_s")
+                if first_up is None or (first_shed is not None
+                                        and first_up >= first_shed):
+                    failures.append(
+                        f"autoscaler shed before growing: first shed at "
+                        f"{first_shed} s, first scale-up at {first_up} s "
+                        f"({rc['fleet_shed']} shed)")
+            if not rc.get("fleet_scale_events"):
+                failures.append(
+                    "no fleet scale events recorded (every drained "
+                    "exit must be classified a scale event)")
+            if rc.get("fleet_incidents") and not args.remediate:
+                failures.append(
+                    f"{rc['fleet_incidents']} fleet incident(s) during "
+                    f"a pure scaling leg (planned drains must never "
+                    f"count as incidents)")
+        if args.remediate:
+            # ---- the auto-remediation invariants (ISSUE 17) ----
+            rem = fl.get("remediation", {})
+            acts = rem.get("actions", [])
+            if not acts:
+                failures.append(
+                    f"remediation leg: no action executed (policy: "
+                    f"{rem.get('policy')})")
+            else:
+                a0 = acts[0]
+                if not a0.get("bundle"):
+                    failures.append(
+                        "remediation action names no evidence bundle")
+                repl = a0.get("replacement")
+                if repl is None:
+                    failures.append(
+                        "remediation replace step failed (no "
+                        "replacement replica booted)")
+                elif not report["devices"]["responses_by_device"].get(
+                        str(repl)):
+                    failures.append(
+                        f"replacement replica {repl} answered nothing "
+                        f"after the swap: "
+                        f"{report['devices']['responses_by_device']}")
+                if str(a0.get("replica")) in fl["router"]["replicas"]:
+                    failures.append(
+                        f"remediated replica {a0.get('replica')} is "
+                        f"still routed")
+                jp = rem.get("journal", "")
+                try:
+                    with open(jp) as f:
+                        entries = [json.loads(x) for x in f]
+                except (OSError, ValueError):
+                    entries = []
+                if not entries:
+                    failures.append(
+                        f"remediation journal missing or empty: {jp!r}")
+                elif not all(e.get("bundle") for e in entries):
+                    failures.append(
+                        "remediation journal entry missing its bundle "
+                        "reference (every action must name its "
+                        "evidence)")
+            if not rc.get("fleet_incidents"):
+                failures.append(
+                    "wedge leg recorded no fleet incident (the "
+                    "remediation removal must count as one)")
         scrape_fl = fl.get("metrics_scrape")
         if scrape_fl is not None:
             if not scrape_fl.get("parse_ok"):

@@ -81,6 +81,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the process force-exits non-zero with the "
                         "unanswered count logged (a wedged flush must "
                         "not hold shutdown forever)")
+    p.add_argument("--drain-linger", type=float, default=0.0,
+                   help="after a clean drain, keep answering /healthz "
+                        "(draining=true) for this many seconds before "
+                        "exiting — set it >= the fleet health-probe "
+                        "interval so the router OBSERVES the draining "
+                        "state and classifies the exit as a scale "
+                        "event, not an incident (ISSUE 17)")
     p.add_argument("--calibrate", type=int, default=256,
                    help="synthetic calibration structures for shape planning")
     p.add_argument("--calibration-cache", type=str, default="",
@@ -301,6 +308,11 @@ def main(argv=None) -> int:
     log(f"listening on http://{args.host}:{args.port} "
         f"(warming {len(server.shape_set)} shapes; "
         f"/healthz reports ready=false until done)")
+    # fleet boot fault point (ISSUE 17): the listener is bound, warm()
+    # has not run — where boot_crash dies and wedge_warm hangs
+    from cgnn_tpu.resilience import faultinject
+
+    faultinject.boot_point()
     server.warm(parts["template"])
     server.start()
     if recorder is not None:
@@ -336,9 +348,18 @@ def main(argv=None) -> int:
             pass
     except KeyboardInterrupt:
         server.begin_drain()
+    # drain with the LISTENER STILL UP (ISSUE 17): /healthz keeps
+    # answering draining=true (new /predict requests get the typed 503
+    # rejection), so the fleet health poller can observe the planned
+    # exit and classify it a scale event instead of an incident. The
+    # listener closes only after the drain (+ optional linger) ends.
+    clean = server.drain(timeout_s=args.drain_timeout)
+    if clean and args.drain_linger > 0:
+        import time as _time
+
+        _time.sleep(args.drain_linger)
     httpd.shutdown()
     httpd.server_close()
-    clean = server.drain(timeout_s=args.drain_timeout)
     handler.uninstall()
     if live_writer is not None:
         live_writer.stop()
@@ -380,6 +401,13 @@ def main(argv=None) -> int:
         os._exit(3)
     if recorder is not None:
         recorder.wait_idle(timeout_s=10.0)
+    if faultinject.exit75_requested():
+        # the injected preemption drained cleanly: report it with the
+        # PR-2 resumable code, the signature the fleet router records
+        # as a scale event rather than an incident
+        from cgnn_tpu.resilience import RESUMABLE_EXIT_CODE
+
+        return RESUMABLE_EXIT_CODE
     return 0
 
 

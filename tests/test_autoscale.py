@@ -1,0 +1,729 @@
+"""Self-driving fleet tests (cgnn_tpu.fleet.autoscale/remediate;
+ISSUE 17).
+
+Everything here is host-side policy on injectable clocks with fake
+signal providers — no jax, no sockets. The live legs (load ramp with
+scale-up-before-shed, wedge with remediator replace-and-drain) run in
+scripts/fleet_smoke.sh against real serve.py replicas.
+
+The load-bearing guarantees, pinned:
+
+- autoscaler decision core: hysteresis (up threshold above down
+  threshold; the band holds), cooldowns between actions (shed bypasses
+  the up-cooldown — capacity was REFUSED), min/max bounds, scale-down
+  only after a sustained-calm window, warm-pool accounting bounded by
+  headroom, victim selection = least loaded and never a draining one;
+- scale-event vs incident: a draining replica's disappearance is
+  removed as a scale event (no flight-recorder trigger, breaker
+  untouched); an un-flagged disappearance counts an incident, fires
+  the recorder, and STAYS routed for re-admission;
+- crash-loop guard: exponential restart backoff with a give-up cap;
+- health-poller backoff: the probe interval for an unreachable replica
+  doubles to a bound and resets on first success;
+- remediator: the wedge signature (health plane answers, dispatch
+  plane tripped) maps to replace-and-drain, rate limits hold against
+  respawn storms, and every action names its evidence bundle.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from cgnn_tpu.fleet.autoscale import (
+    AutoscalePolicy,
+    Autoscaler,
+    ScaleSignals,
+    signals_from_router,
+)
+from cgnn_tpu.fleet.remediate import (
+    RemediationPolicy,
+    Remediator,
+    rid_from_detail,
+)
+from cgnn_tpu.fleet.replica import ReplicaState
+from cgnn_tpu.fleet.router import FleetRouter
+from cgnn_tpu.fleet.spawn import RestartBackoff, boot_with_retries
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _policy(**kw) -> AutoscalePolicy:
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 8)
+    kw.setdefault("up_queue_per_replica", 2.0)
+    kw.setdefault("down_queue_per_replica", 0.5)
+    kw.setdefault("cooldown_up_s", 5.0)
+    kw.setdefault("cooldown_down_s", 10.0)
+    kw.setdefault("down_sustain_s", 10.0)
+    return AutoscalePolicy(**kw)
+
+
+def _sig(**kw) -> ScaleSignals:
+    kw.setdefault("replicas", 2)
+    kw.setdefault("ready", 2)
+    return ScaleSignals(**kw)
+
+
+# ------------------------------------------------- the decision core
+
+
+class TestAutoscalePolicy:
+    def test_queue_above_up_threshold_scales_up(self):
+        p = _policy()
+        d = p.poll(0.0, _sig(queue_depth=5.0))  # 2.5/replica >= 2.0
+        assert d is not None and d.action == "up"
+        assert "queue" in d.reason
+
+    def test_hysteresis_band_holds(self):
+        # between down (0.5) and up (2.0) per replica: no decision in
+        # EITHER direction, no matter how long it sits there
+        p = _policy()
+        clk = 0.0
+        for _ in range(50):
+            assert p.poll(clk, _sig(queue_depth=2.0)) is None  # 1.0/rep
+            clk += 1.0
+
+    def test_equal_thresholds_rejected(self):
+        with pytest.raises(ValueError):
+            _policy(up_queue_per_replica=1.0, down_queue_per_replica=1.0)
+
+    def test_up_cooldown_blocks_back_to_back_ups(self):
+        p = _policy(cooldown_up_s=5.0)
+        assert p.poll(0.0, _sig(queue_depth=10.0)).action == "up"
+        assert p.poll(1.0, _sig(replicas=3, ready=3,
+                                queue_depth=10.0)) is None
+        d = p.poll(6.0, _sig(replicas=3, ready=3, queue_depth=10.0))
+        assert d is not None and d.action == "up"
+
+    def test_shed_bypasses_up_cooldown(self):
+        # a shed means capacity was REFUSED: the urgent path must not
+        # sit out a cooldown while requests bounce
+        p = _policy(cooldown_up_s=60.0)
+        assert p.poll(0.0, _sig(queue_depth=10.0, shed=0)).action == "up"
+        d = p.poll(1.0, _sig(replicas=3, ready=3, shed=4))
+        assert d is not None and d.action == "up" and d.urgent
+        assert "shed" in d.reason
+
+    def test_shed_delta_not_cumulative(self):
+        # the cumulative fleet_shed counter must not re-trigger forever
+        # on one old incident
+        p = _policy()
+        # queue_depth in the hysteresis band: only a shed could trigger
+        assert p.poll(0.0, _sig(queue_depth=2.0, shed=7)) is None
+        assert p.poll(20.0, _sig(queue_depth=2.0, shed=7)) is None
+
+    def test_max_bound_holds_even_urgent(self):
+        p = _policy(max_replicas=2)
+        assert p.poll(0.0, _sig(replicas=2, ready=2, queue_depth=50.0,
+                                shed=9)) is None
+
+    def test_below_min_repairs_immediately(self):
+        p = _policy(min_replicas=2)
+        d = p.poll(0.0, _sig(replicas=1, ready=1))
+        assert d is not None and d.action == "up" and d.urgent
+        assert d.reason == "below_min_replicas"
+
+    def test_p99_and_burn_triggers(self):
+        p = _policy(up_p99_ms=500.0)
+        d = p.poll(0.0, _sig(p99_ms=900.0))
+        assert d is not None and "p99" in d.reason
+        p2 = _policy(up_burn=6.0)
+        # both windows must burn (the multi-window rule): fast alone no
+        assert p2.poll(0.0, _sig(burn_fast=10.0, burn_slow=1.0)) is None
+        d2 = p2.poll(0.0, _sig(burn_fast=10.0, burn_slow=8.0))
+        assert d2 is not None and "burn" in d2.reason
+
+    def test_scale_down_needs_sustained_calm(self):
+        p = _policy(down_sustain_s=10.0, cooldown_down_s=0.0)
+        calm = _sig(replicas=3, ready=3, queue_depth=0.0)
+        assert p.poll(0.0, calm) is None     # calm starts counting
+        assert p.poll(5.0, calm) is None     # not sustained yet
+        # a busy blip RESETS the calm window
+        assert p.poll(6.0, _sig(replicas=3, ready=3,
+                                queue_depth=3.0)) is None
+        assert p.poll(7.0, calm) is None
+        assert p.poll(12.0, calm) is None    # only 5 s calm again
+        d = p.poll(17.5, calm)
+        assert d is not None and d.action == "down"
+
+    def test_scale_down_never_below_min(self):
+        p = _policy(min_replicas=2, down_sustain_s=0.5,
+                    cooldown_down_s=0.0)
+        calm = _sig(replicas=2, ready=2, queue_depth=0.0)
+        p.poll(0.0, calm)
+        assert p.poll(10.0, calm) is None
+
+    def test_draining_counts_against_down_headroom(self):
+        # 3 routed but 2 already draining: one more down would land
+        # below min — hold
+        p = _policy(min_replicas=1, down_sustain_s=0.5,
+                    cooldown_down_s=0.0)
+        calm = _sig(replicas=3, ready=1, draining=2, queue_depth=0.0)
+        p.poll(0.0, calm)
+        assert p.poll(10.0, calm) is None
+
+    def test_down_cooldown(self):
+        p = _policy(down_sustain_s=1.0, cooldown_down_s=30.0)
+        calm = _sig(replicas=4, ready=4, queue_depth=0.0)
+        p.poll(0.0, calm)
+        assert p.poll(2.0, calm).action == "down"
+        p.poll(3.0, calm)
+        assert p.poll(10.0, calm) is None    # cooldown holds
+        assert p.poll(40.0, calm).action == "down"
+
+    def test_pool_deficit_bounded_by_headroom(self):
+        p = _policy(max_replicas=4, warm_target=2)
+        assert p.pool_deficit(_sig(replicas=1, warm_pool=0)) == 2
+        assert p.pool_deficit(_sig(replicas=1, warm_pool=1)) == 1
+        assert p.pool_deficit(_sig(replicas=1, warm_pool=2)) == 0
+        # at the bound, a spare could never be routed: don't warm it
+        assert p.pool_deficit(_sig(replicas=4, warm_pool=0)) == 0
+        assert p.pool_deficit(_sig(replicas=3, warm_pool=0)) == 1
+
+    def test_pick_victim_least_loaded_never_draining(self):
+        a = ReplicaState(0, "http://127.0.0.1:9000")
+        a.note_probe(ready=True, queue_depth=5.0)
+        b = ReplicaState(1, "http://127.0.0.1:9001")
+        b.note_probe(ready=True, queue_depth=0.0)
+        c = ReplicaState(2, "http://127.0.0.1:9002")
+        c.note_probe(ready=True, queue_depth=0.0)
+        c.note_draining()
+        # b and c are equally idle, but c is already going
+        assert AutoscalePolicy.pick_victim([a, b, c]) == 1
+        b.note_draining()
+        assert AutoscalePolicy.pick_victim([a, b, c]) == 0
+        a.note_draining()
+        assert AutoscalePolicy.pick_victim([a, b, c]) is None
+
+
+# -------------------------------------------------- crash-loop guard
+
+
+class FakeProc:
+    """A ReplicaProcess-shaped fake: scripted boot outcomes."""
+
+    def __init__(self, rid=0, outcomes=()):
+        self.rid = rid
+        self.base_url = f"http://127.0.0.1:{9100 + rid}"
+        self.outcomes = list(outcomes)  # True = boot ok, False = crash
+        self.starts = 0
+        self.kills = 0
+        self.terminated = False
+        self.exit_code = 0
+        self._ok = False
+
+    def start(self):
+        self._ok = self.outcomes.pop(0) if self.outcomes else True
+        self.starts += 1
+        return self
+
+    def alive(self):
+        return self._ok and not self.terminated
+
+    def wait_ready(self, timeout_s=300.0, poll_s=0.25):
+        return self._ok
+
+    def kill9(self):
+        self.kills += 1
+        self._ok = False
+
+    def terminate(self, timeout_s=60.0):
+        self.terminated = True
+        self._ok = False
+        return self.exit_code
+
+    def probe(self, timeout_s=2.0):
+        return True
+
+
+class TestRestartBackoff:
+    def test_exponential_delays_then_give_up(self):
+        clk = FakeClock()
+        b = RestartBackoff(base_s=0.5, mult=2.0, max_s=3.0, give_up=5,
+                           clock=clk)
+        assert b.next_delay() == 0.5
+        assert b.next_delay() == 1.0
+        assert b.next_delay() == 2.0
+        assert b.next_delay() == 3.0   # capped at max_s
+        assert b.next_delay() is None  # 5th failure: budget spent
+        assert b.failures == 5
+
+    def test_reset_restores_budget(self):
+        b = RestartBackoff(base_s=0.5, give_up=2, clock=FakeClock())
+        assert b.next_delay() == 0.5
+        b.reset()
+        assert b.failures == 0
+        assert b.next_delay() == 0.5   # full budget again
+
+    def test_boot_with_retries_outlasts_boot_crash(self):
+        # the boot_crash=N pin shape: N boots die, the N+1st succeeds
+        proc = FakeProc(outcomes=[False, False, True])
+        slept = []
+        ok = boot_with_retries(
+            proc, backoff=RestartBackoff(base_s=0.25, give_up=5,
+                                         clock=FakeClock()),
+            log_fn=lambda *a: None, sleep=slept.append)
+        assert ok and proc.starts == 3
+        assert slept == [0.25, 0.5]    # exponential between attempts
+
+    def test_boot_with_retries_gives_up_and_reaps(self):
+        proc = FakeProc(outcomes=[False] * 10)
+        ok = boot_with_retries(
+            proc, backoff=RestartBackoff(base_s=0.1, give_up=3,
+                                         clock=FakeClock()),
+            log_fn=lambda *a: None, sleep=lambda s: None)
+        assert not ok
+        assert proc.starts == 3        # give_up bounds the respawns
+        assert proc.terminated
+
+    def test_boot_crash_fault_point_across_real_processes(self, tmp_path):
+        # the boot_crash=N pin against the REAL fault point: state
+        # survives each crashed process (the crash takes its in-memory
+        # counters with it), so the first N boots die with os._exit(7)
+        # and the N+1st proceeds — exactly what the crash-loop guard
+        # retries through
+        state = tmp_path / "boots"
+        env = dict(os.environ)
+        env["CGNN_TPU_FAULTS"] = "boot_crash=2"
+        env["CGNN_TPU_FAULT_STATE"] = str(state)
+        code = ("from cgnn_tpu.resilience import faultinject; "
+                "faultinject.boot_point(); print('SURVIVED')")
+        runs = [subprocess.run([sys.executable, "-c", code], env=env,
+                               capture_output=True, text=True,
+                               timeout=120)
+                for _ in range(3)]
+        assert [r.returncode for r in runs] == [7, 7, 0]
+        assert "SURVIVED" in runs[2].stdout
+        assert state.stat().st_size == 3  # one byte per boot attempt
+
+
+# ------------------------------------------- health-poller backoff
+
+
+class TestProbeBackoff:
+    def test_interval_doubles_to_bound_and_resets(self):
+        clk = FakeClock()
+        r = ReplicaState(0, "http://127.0.0.1:9000", clock=clk,
+                         probe_backoff_base_s=1.0,
+                         probe_backoff_max_s=4.0)
+        assert r.probe_due()           # reachable: always due
+        r.note_unreachable()
+        assert not r.probe_due()       # 1 s backoff armed
+        clk.advance(1.1)
+        assert r.probe_due()
+        r.note_unreachable()           # still dead: doubles to 2 s
+        clk.advance(1.1)
+        assert not r.probe_due()
+        clk.advance(1.0)
+        assert r.probe_due()
+        r.note_unreachable()           # 4 s
+        r.note_unreachable()           # capped at 4 s
+        assert r.stats()["probe_backoff_s"] == 4.0
+        clk.advance(4.1)
+        assert r.probe_due()
+        r.note_probe(ready=True)       # first success resets fully
+        assert r.stats()["probe_backoff_s"] == 0.0
+        assert r.probe_due()
+
+
+# ------------------------- scale events vs incidents (the ledger)
+
+
+class ScriptedReplica(ReplicaState):
+    """probe() plays back a script of states instead of hitting a
+    socket: True = healthy probe, 'draining' = healthy-but-draining,
+    False = unreachable."""
+
+    def __init__(self, rid, script, **kw):
+        super().__init__(rid, f"http://127.0.0.1:{9200 + rid}", **kw)
+        self.script = list(script)
+
+    def probe(self, timeout_s=2.0):
+        step = self.script.pop(0) if self.script else False
+        if step is False:
+            self.note_unreachable()
+            return False
+        self.note_probe(ready=step is True, draining=step == "draining")
+        return step is True
+
+
+class FakeRecorder:
+    def __init__(self):
+        self.trigger_calls = []
+        self.last_bundle = "/tmp/bundle-last"
+        self.on_trigger = None
+
+    def trigger(self, reason, detail="", **kw):
+        self.trigger_calls.append((reason, detail))
+        if self.on_trigger is not None:
+            self.on_trigger(reason, detail, f"/tmp/bundle-{reason}")
+        return f"/tmp/bundle-{reason}"
+
+
+def _router(replicas, **kw):
+    kw.setdefault("slo_layer", False)
+    kw.setdefault("trace_ring", 0)
+    kw.setdefault("log_fn", lambda *a: None)
+    return FleetRouter(replicas, transport=lambda *a: (200, {}), **kw)
+
+
+class TestScaleEventClassification:
+    def test_draining_disappearance_is_scale_event(self):
+        clk = FakeClock()
+        victim = ScriptedReplica(0, [True, "draining", False], clock=clk)
+        other = ScriptedReplica(1, [True] * 10, clock=clk)
+        router = _router([victim, other], clock=clk)
+        rec = FakeRecorder()
+        router.flightrec = rec
+        router.probe_all()             # both healthy
+        router.probe_all()             # victim advertises draining
+        router.probe_all()             # victim gone
+        counts = router.stats()["counts"]
+        assert counts["fleet_scale_events"] == 1
+        assert counts["fleet_incidents"] == 0
+        # removed from routing, NO incident bundle, breaker untripped
+        assert [r.rid for r in router.replica_list()] == [1]
+        assert rec.trigger_calls == []
+        assert victim.breaker.stats()["state"] == "closed"
+
+    def test_unflagged_disappearance_is_incident_and_stays_routed(self):
+        clk = FakeClock()
+        victim = ScriptedReplica(0, [True, False], clock=clk)
+        other = ScriptedReplica(1, [True] * 10, clock=clk)
+        router = _router([victim, other], clock=clk)
+        rec = FakeRecorder()
+        router.flightrec = rec
+        router.probe_all()
+        router.probe_all()             # victim vanishes un-flagged
+        counts = router.stats()["counts"]
+        assert counts["fleet_incidents"] == 1
+        assert counts["fleet_scale_events"] == 0
+        # stays routed: a kill -9'd replica may restart and re-admit
+        assert [r.rid for r in router.replica_list()] == [0, 1]
+        assert [c[0] for c in rec.trigger_calls] == ["replica_unreachable"]
+
+    def test_begin_drain_makes_fast_exit_a_scale_event(self):
+        # the race the sticky router-side mark closes: SIGTERM lands
+        # and the replica dies before ANY probe saw it draining
+        clk = FakeClock()
+        victim = ScriptedReplica(0, [True, True, False], clock=clk)
+        router = _router([victim, ScriptedReplica(1, [True] * 9,
+                                                  clock=clk)], clock=clk)
+        router.probe_all()
+        router.begin_drain(0)
+        router.probe_all()             # probe overwrites nothing:
+        assert victim.stats()["draining"]  # intent is sticky
+        router.probe_all()
+        counts = router.stats()["counts"]
+        assert counts["fleet_scale_events"] == 1
+        assert counts["fleet_incidents"] == 0
+
+    def test_probe_backoff_skips_dead_replica_rounds(self):
+        clk = FakeClock()
+        dead = ScriptedReplica(0, [False] * 10, clock=clk,
+                               probe_backoff_base_s=2.0)
+        router = _router([dead, ScriptedReplica(1, [True] * 10,
+                                                clock=clk)], clock=clk)
+        router.probe_all()             # probes it (due), backs off 2 s
+        router.probe_all()             # NOT due: skipped
+        router.probe_all()
+        assert dead.stats()["probes"] == 1
+        clk.advance(2.1)
+        router.probe_all()             # due again
+        assert dead.stats()["probes"] == 2
+
+
+class TestRouterMembership:
+    def test_add_and_remove(self):
+        router = _router([ScriptedReplica(0, [True])])
+        n = ReplicaState(5, "http://127.0.0.1:9905")
+        router.add_replica(n)
+        assert [r.rid for r in router.replica_list()] == [0, 5]
+        with pytest.raises(ValueError):
+            router.add_replica(ReplicaState(5, "http://127.0.0.1:9906"))
+        assert router.remove_replica(5, reason="scale_down") is n
+        # idempotent: the poller and the drain thread can both notice
+        assert router.remove_replica(5, reason="scale_down") is None
+        assert router.count("fleet_scale_events") == 1
+        events = router.lifecycle_events()
+        assert [e["event"] for e in events] == ["add", "remove"]
+
+    def test_remediation_removal_counts_incident(self):
+        router = _router([ScriptedReplica(0, [True]),
+                          ScriptedReplica(1, [True])])
+        router.remove_replica(0, reason="remediation")
+        assert router.count("fleet_incidents") == 1
+        assert router.count("fleet_scale_events") == 0
+
+
+# ------------------------------------------------ autoscaler runtime
+
+
+def _runtime(clk=None, n=2, **pol_kw):
+    clk = clk or FakeClock()
+    replicas = [ScriptedReplica(i, [True] * 50, clock=clk)
+                for i in range(n)]
+    router = _router(replicas, clock=clk)
+    router.probe_all()
+    procs = {i: FakeProc(i) for i in range(n)}
+    made = []
+
+    def factory(rid):
+        p = FakeProc(rid)
+        made.append(p)
+        return p
+
+    def state_factory(rid, base_url):
+        r = ReplicaState(rid, base_url, clock=clk)
+        r.note_probe(ready=True)
+        return r
+
+    pol_kw.setdefault("min_replicas", 1)
+    pol_kw.setdefault("max_replicas", 6)
+    asc = Autoscaler(router, _policy(**pol_kw), factory, state_factory,
+                     procs=procs, next_rid=n, drain_timeout_s=1.0,
+                     clock=clk, log_fn=lambda *a: None)
+    return router, asc, made
+
+
+class TestAutoscalerRuntime:
+    def test_scale_up_prefers_warm_pool(self):
+        router, asc, made = _runtime()
+        asc._refill_one()              # warm one spare synchronously
+        assert [rid for rid, _ in asc.pool] == [2]
+        rid = asc.scale_up("test")
+        assert rid == 2
+        assert asc.pool == []          # popped from the pool
+        assert 2 in [r.rid for r in router.replica_list()]
+        assert asc.stats()["counts"]["scale_ups"] == 1
+        actions = [e["action"] for e in asc.stats()["events"]]
+        assert actions == ["pool_add", "scale_up"]
+
+    def test_scale_up_cold_boots_when_pool_empty(self):
+        router, asc, made = _runtime()
+        rid = asc.scale_up("test")
+        assert rid == 2 and len(made) == 1
+        assert 2 in [r.rid for r in router.replica_list()]
+
+    def test_scale_down_drains_least_loaded_and_records(self):
+        router, asc, _ = _runtime()
+        router._replica(0).note_probe(ready=True, queue_depth=9.0)
+        victim = asc.scale_down("test")
+        assert victim == 1             # the idle one
+        for t in asc._down_threads:
+            t.join(timeout=5.0)
+        assert asc.proc_for(1).terminated
+        assert [r.rid for r in router.replica_list()] == [0]
+        assert router.count("fleet_scale_events") == 1
+        assert router.count("fleet_incidents") == 0
+        assert asc.stats()["counts"]["scale_downs"] == 1
+
+    def test_tick_replenishes_pool_when_calm(self):
+        router, asc, made = _runtime(warm_target=1)
+        d = asc.tick()                 # calm fleet: no scale decision
+        assert d is None
+        with asc._lock:
+            refill = asc._refill_thread
+        assert refill is not None
+        refill.join(timeout=5.0)
+        assert len(asc.pool) == 1      # ...but the pool got warmed
+        assert asc.stats()["counts"]["pool_refills"] == 1
+
+    def test_tick_acts_on_overload(self):
+        router, asc, made = _runtime(warm_target=0)
+        router._replica(0).note_probe(ready=True, queue_depth=10.0)
+        d = asc.tick()
+        assert d is not None and d.action == "up"
+        assert len(router.replica_list()) == 3
+
+    def test_signals_from_router_snapshot(self):
+        clk = FakeClock()
+        replicas = [ScriptedReplica(i, [True] * 5, clock=clk)
+                    for i in range(2)]
+        router = _router(replicas, clock=clk)
+        router.probe_all()
+        replicas[0].note_probe(ready=True, queue_depth=3.0)
+        replicas[1].note_probe(ready=True, draining=True)
+        s = signals_from_router(router, warm_pool=2)
+        assert s.replicas == 2 and s.ready == 1 and s.draining == 1
+        assert s.queue_depth == 3.0 and s.warm_pool == 2
+
+
+# -------------------------------------------------------- remediator
+
+
+def _wedged_stats(**kw):
+    # the wedge signature: health plane answers, dispatch plane dead.
+    # ready=False is the REALISTIC trip-time state — the k-th timeout
+    # clears the dispatch-path ready flag in the same breath that
+    # trips the breaker — which is exactly why the signature must key
+    # on probe_ready (the health plane's own word), never on ready
+    kw.setdefault("probe_ok", True)
+    kw.setdefault("probe_ready", True)
+    kw.setdefault("ready", False)
+    kw.setdefault("draining", False)
+    return kw
+
+
+class TestRemediationPolicy:
+    def test_rid_extraction(self):
+        assert rid_from_detail(
+            "breaker_trip",
+            "fleet.breaker.3: open after 3 consecutive failures") == 3
+        assert rid_from_detail(
+            "replica_unreachable",
+            "replica12 (http://h:1) stopped answering health probes",
+        ) == 12
+        assert rid_from_detail("breaker_trip", "garbage") is None
+
+    def test_wedge_signature_triggers_replace(self):
+        p = RemediationPolicy(min_interval_s=0.0)
+        a = p.consider(0.0, "breaker_trip",
+                       "fleet.breaker.1: open after 3 consecutive "
+                       "failures", _wedged_stats())
+        assert a == {"action": "replace_and_drain", "replica": 1,
+                     "why": a["why"]}
+        assert "wedged" in a["why"]
+
+    def test_loaded_or_dead_replica_not_replaced_on_trip(self):
+        p = RemediationPolicy(min_interval_s=0.0)
+        # dead replica: probe plane down too — the breaker did its job,
+        # the restart/re-admission path owns this, not the remediator
+        assert p.consider(0.0, "breaker_trip", "fleet.breaker.1: open",
+                          _wedged_stats(probe_ok=False)) is None
+        assert p.consider(0.0, "breaker_trip", "fleet.breaker.1: open",
+                          _wedged_stats(probe_ready=False)) is None
+
+    def test_unreachable_acts_unless_draining(self):
+        p = RemediationPolicy(min_interval_s=0.0,
+                              per_replica_interval_s=0.0)
+        detail = "replica2 (http://h) stopped answering health probes"
+        assert p.consider(0.0, "replica_unreachable", detail,
+                          _wedged_stats(draining=True)) is None
+        a = p.consider(0.0, "replica_unreachable", detail,
+                       _wedged_stats(probe_ok=False, probe_ready=False))
+        assert a is not None and a["replica"] == 2
+
+    def test_rate_limits_hold_against_respawn_storm(self):
+        p = RemediationPolicy(min_interval_s=10.0,
+                              per_replica_interval_s=60.0,
+                              max_actions=3)
+        detail = "fleet.breaker.1: open after 3 consecutive failures"
+        assert p.consider(0.0, "breaker_trip", detail,
+                          _wedged_stats()) is not None
+        # global interval
+        assert p.consider(5.0, "breaker_trip",
+                          "fleet.breaker.2: open", _wedged_stats()) is None
+        # per-replica interval outlives the global one
+        assert p.consider(15.0, "breaker_trip", detail,
+                          _wedged_stats()) is None
+        assert p.consider(15.0, "breaker_trip",
+                          "fleet.breaker.2: open",
+                          _wedged_stats()) is not None
+        # hard cap
+        assert p.consider(30.0, "breaker_trip",
+                          "fleet.breaker.3: open",
+                          _wedged_stats()) is not None
+        assert p.consider(60.0, "breaker_trip",
+                          "fleet.breaker.4: open", _wedged_stats()) is None
+        assert p.stats()["actions_taken"] == 3
+        assert p.stats()["suppressed"] == 3
+
+    def test_non_actionable_reasons_ignored(self):
+        p = RemediationPolicy(min_interval_s=0.0)
+        assert p.consider(0.0, "5xx_burst", "20+ server errors",
+                          _wedged_stats()) is None
+        assert p.consider(0.0, "slo_burn_fleet_availability", "x",
+                          _wedged_stats()) is None
+
+
+class TestRemediator:
+    def _make(self, tmp_path, clk=None):
+        clk = clk or FakeClock()
+        router, asc, made = _runtime(clk=clk)
+        rem = Remediator(router, asc,
+                         RemediationPolicy(min_interval_s=0.0,
+                                           per_replica_interval_s=0.0),
+                         out_dir=str(tmp_path), drain_timeout_s=1.0,
+                         clock=clk, log_fn=lambda *a: None)
+        return router, asc, rem, made
+
+    def test_replace_and_drain_chain(self, tmp_path):
+        router, asc, rem, made = self._make(tmp_path)
+        # wedge replica 1: health plane fine, breaker tripped
+        record = rem.handle(
+            "breaker_trip",
+            "fleet.breaker.1: open after 3 consecutive failures",
+            "/tmp/bundle-breaker_trip")
+        assert record is not None
+        # replacement routed, victim unrouted + reaped
+        rids = [r.rid for r in router.replica_list()]
+        assert 1 not in rids and 2 in rids
+        assert record["replacement"] == 2
+        assert asc.proc_for(1).terminated
+        # the removal was an INCIDENT response, not elastic sizing
+        assert router.count("fleet_incidents") == 1
+        # the action chain names its evidence
+        assert record["bundle"] == "/tmp/bundle-breaker_trip"
+        path = os.path.join(str(tmp_path), "remediation.jsonl")
+        with open(path) as f:
+            lines = [json.loads(x) for x in f]
+        assert len(lines) == 1
+        assert lines[0]["replica"] == 1
+        assert lines[0]["bundle"] == "/tmp/bundle-breaker_trip"
+
+    def test_suppressed_bundle_falls_back_to_last(self, tmp_path):
+        router, asc, rem, _ = self._make(tmp_path)
+        rec = FakeRecorder()
+        rem._recorder = rec
+        record = rem.handle(
+            "breaker_trip",
+            "fleet.breaker.0: open after 3 consecutive failures", None)
+        assert record is not None
+        assert record["bundle"] == rec.last_bundle
+
+    def test_attach_subscribes_and_worker_consumes(self, tmp_path):
+        router, asc, rem, _ = self._make(tmp_path)
+        rec = FakeRecorder()
+        rem.attach(rec)
+        assert rec.on_trigger is not None
+        try:
+            rec.trigger(
+                "breaker_trip",
+                "fleet.breaker.1: open after 3 consecutive failures")
+            deadline = 50
+            while not rem.stats()["actions"] and deadline:
+                threading.Event().wait(0.05)
+                deadline -= 1
+            actions = rem.stats()["actions"]
+            assert len(actions) == 1
+            assert actions[0]["replica"] == 1
+            assert actions[0]["bundle"] == "/tmp/bundle-breaker_trip"
+        finally:
+            rem.stop()
+
+    def test_policy_veto_means_no_action(self, tmp_path):
+        router, asc, rem, made = self._make(tmp_path)
+        # mark the implicated replica draining: unreachable-on-draining
+        # is the planned-exit path, not a remediation case
+        router.begin_drain(1)
+        record = rem.handle(
+            "replica_unreachable",
+            "replica1 (http://h) stopped answering health probes",
+            "/tmp/b")
+        assert record is None
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "remediation.jsonl"))
