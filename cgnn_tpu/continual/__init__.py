@@ -1,0 +1,28 @@
+"""Closed-loop continual learning (ISSUE 18): serve -> label -> train
+-> shadow-evaluate -> canary-promote, with zero serving downtime.
+
+- :mod:`journal` — the label journal: served requests append
+  fingerprint/trace-keyed records; late-arriving ground truth joins
+  exactly once, producing the labeled replay set the trainer consumes.
+- :mod:`trainer` — the colocated fine-tune loop: journal -> existing
+  loader/pack machinery -> guarded train steps -> versioned commits
+  into the fleet's shared checkpoint directory on a cadence.
+- :mod:`canary` — the shadow-evaluation plane: the pure promotion gate
+  (injectable clock, AutoscalePolicy idiom) plus the controller that
+  pins one canary replica per candidate, mirrors labeled traffic to it,
+  and promotes fleet-wide or rolls back with a flight-recorder bundle
+  naming the regressing version.
+"""
+
+from cgnn_tpu.continual.canary import (  # noqa: F401
+    CanaryController,
+    CanaryGate,
+    GateConfig,
+    GateDecision,
+    GateStats,
+)
+from cgnn_tpu.continual.journal import (  # noqa: F401
+    JournalTail,
+    LabelJournal,
+)
+from cgnn_tpu.continual.trainer import ContinualTrainer  # noqa: F401

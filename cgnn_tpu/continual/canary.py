@@ -1,0 +1,523 @@
+"""Shadow evaluation + the canary promotion gate (ISSUE 18).
+
+The trainer commits CANDIDATE versions into the fleet's shared
+checkpoint directory; nothing may reach the fleet untested. The plane
+here has two halves:
+
+- :class:`CanaryGate` — the PURE decision core, in the
+  ``AutoscalePolicy.poll(now, signals)`` idiom: frozen config, all
+  state mutated only inside ``poll``, injectable clock and samples, so
+  every promote/hold/rollback path is a deterministic unit test. The
+  rules are declarative: a candidate promotes when its shadow MAE is
+  within ``max_mae_ratio`` of the live fleet's over at least
+  ``min_samples`` labeled mirrors AND its shadow p99 fits the budget;
+  it rolls back when the MAE ratio crosses ``rollback_mae_ratio``, the
+  latency budget breaks, or the observation window expires without a
+  verdict (undecided = not promotable — the safe default).
+- :class:`CanaryController` — the runtime driving the loop against a
+  fleet adapter (the :class:`~cgnn_tpu.fleet.router.FleetRouter` in
+  production, a fake in tests): watch for new committed candidates,
+  pin ONE canary replica to each (the replica leaves the routing
+  rotation but stays addressable), mirror a configurable fraction of
+  labeled live traffic to it — the shadow answer NEVER counts toward
+  any client response — and turn the gate's verdict into a fleet-wide
+  rolling promotion or a rollback whose flight-recorder bundle names
+  the regressing version.
+
+Per-version rolling MAE and shadow latency accumulate in the PR-17
+mergeable-histogram plane (``fleet_label_mae_hist`` /
+``fleet_shadow_latency_ms_hist``, labeled by ``param_version``), so
+shadow-vs-live error is scrapeable from ``/metrics``, not loop-internal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+from typing import Callable
+
+from cgnn_tpu.analysis import racecheck
+from cgnn_tpu.observe.hist import (
+    LATENCY_MS_BOUNDS,
+    MAE_BOUNDS,
+    Histogram,
+    format_labels,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GateConfig:
+    """Declarative promotion gate. Ratios are candidate/baseline MAE."""
+
+    min_samples: int = 50           # labeled shadow mirrors before verdict
+    min_baseline: int = 50          # labeled LIVE answers before verdict
+    max_mae_ratio: float = 1.05     # <= this -> promote
+    rollback_mae_ratio: float = 1.25  # >= this -> rollback (mae)
+    p99_budget_ms: float = 2000.0   # shadow p99 above -> rollback (latency)
+    min_window_s: float = 2.0       # never decide faster than this
+    max_window_s: float = 300.0     # undecided past this -> rollback
+
+    def __post_init__(self):
+        if self.max_mae_ratio >= self.rollback_mae_ratio:
+            raise ValueError(
+                f"max_mae_ratio ({self.max_mae_ratio}) must be < "
+                f"rollback_mae_ratio ({self.rollback_mae_ratio}) — an "
+                "overlapping band would promote and roll back the same "
+                "candidate"
+            )
+        if self.min_samples <= 0 or self.min_window_s < 0:
+            raise ValueError("min_samples must be > 0, min_window_s >= 0")
+        if self.max_window_s <= self.min_window_s:
+            raise ValueError(
+                f"max_window_s ({self.max_window_s}) must exceed "
+                f"min_window_s ({self.min_window_s})"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class GateStats:
+    """One observation snapshot fed to ``poll`` (all window-scoped:
+    accumulated since ``begin``, not lifetime)."""
+
+    candidate_count: int = 0
+    candidate_mae: float = float("nan")
+    candidate_p99_ms: float = float("nan")
+    baseline_count: int = 0
+    baseline_mae: float = float("nan")
+
+
+@dataclasses.dataclass(frozen=True)
+class GateDecision:
+    action: str       # 'promote' | 'rollback'
+    version: str
+    reason: str       # 'mae' | 'latency' | 'window_expired' | 'ok'
+    mae_ratio: float
+    stats: GateStats
+
+
+class CanaryGate:
+    """Pure verdict state machine for ONE candidate at a time.
+
+    ``begin(version, now)`` opens an evaluation window; ``poll(now,
+    stats)`` returns a :class:`GateDecision` exactly once per window
+    (then deactivates) or None to hold. No clocks, no threads, no IO —
+    callers serialize access.
+    """
+
+    def __init__(self, config: GateConfig | None = None):
+        self.config = config or GateConfig()
+        self._version: str | None = None
+        self._started: float = 0.0
+        self.decisions: list[GateDecision] = []
+
+    @property
+    def active(self) -> str | None:
+        """The candidate under evaluation (None between windows)."""
+        return self._version
+
+    def begin(self, version: str, now: float) -> None:
+        if self._version is not None:
+            raise RuntimeError(
+                f"gate already evaluating {self._version}; one candidate "
+                "at a time"
+            )
+        self._version = version
+        self._started = float(now)
+
+    def _decide(self, action: str, reason: str, ratio: float,
+                stats: GateStats) -> GateDecision:
+        d = GateDecision(action=action, version=self._version,
+                         reason=reason, mae_ratio=ratio, stats=stats)
+        self.decisions.append(d)
+        self._version = None
+        return d
+
+    def poll(self, now: float, stats: GateStats) -> GateDecision | None:
+        if self._version is None:
+            return None
+        cfg = self.config
+        elapsed = now - self._started
+        expired = elapsed >= cfg.max_window_s
+        have_samples = (stats.candidate_count >= cfg.min_samples
+                        and stats.baseline_count >= cfg.min_baseline)
+        ratio = float("nan")
+        if (stats.baseline_mae == stats.baseline_mae
+                and stats.candidate_mae == stats.candidate_mae):
+            ratio = stats.candidate_mae / max(stats.baseline_mae, 1e-12)
+        if have_samples and elapsed >= cfg.min_window_s:
+            # latency first: a candidate that answers correctly but
+            # blows the p99 budget still cannot take the fleet
+            if (stats.candidate_p99_ms == stats.candidate_p99_ms
+                    and stats.candidate_p99_ms > cfg.p99_budget_ms):
+                return self._decide("rollback", "latency", ratio, stats)
+            if ratio == ratio and ratio >= cfg.rollback_mae_ratio:
+                return self._decide("rollback", "mae", ratio, stats)
+            if ratio == ratio and ratio <= cfg.max_mae_ratio:
+                return self._decide("promote", "ok", ratio, stats)
+            # inconclusive band: keep observing until the window expires
+        if expired:
+            # undecided is NOT promotable: starved of samples or parked
+            # in the inconclusive band, the fleet keeps what it has
+            return self._decide("rollback", "window_expired", ratio, stats)
+        return None
+
+    def state(self) -> dict:
+        return {
+            "active": self._version,
+            "started": self._started if self._version else None,
+            "decisions": len(self.decisions),
+        }
+
+
+class CanaryController:
+    """Drives the closed loop against a fleet adapter.
+
+    ``fleet`` is duck-typed (the FleetRouter grows these in ISSUE 18;
+    tests pass a fake):
+
+    - ``fleet_version() -> str | None`` — the version the routed fleet
+      serves (the promotion baseline);
+    - ``begin_canary(version) -> rid | None`` — take one ready replica
+      out of rotation and pin its watcher to ``version`` (None = no
+      replica to spare this tick; retried);
+    - ``canary_version(rid) -> str | None`` — what the pinned replica
+      serves right now (the convergence probe);
+    - ``shadow_predict(rid, payload, timeout_s) -> (prediction,
+      latency_ms)`` — a mirrored request straight to the canary,
+      bypassing routing; raises on failure;
+    - ``promote(rid, version)`` — broadcast the gate fleet-wide (every
+      watcher's ceiling rises to ``version``; the rolling-promotion
+      path) and return the canary to rotation;
+    - ``abort_canary(rid, to_version)`` — pin the canary back to the
+      fleet version (rollback); controller calls ``end_canary(rid)``
+      once converged;
+    - ``end_canary(rid)`` — clear the pin and return the replica to
+      rotation.
+
+    ``newest_fn`` surfaces trainer commits (``CheckpointManager.
+    newest_committed`` on the shared directory). ``journal`` supplies
+    the labeled live traffic; every newly joined record contributes its
+    live |prediction - label| to the per-version MAE plane, and — while
+    a candidate is evaluating — a ``mirror_fraction`` subset is
+    replayed to the canary for the shadow sample.
+    """
+
+    def __init__(self, *, gate: CanaryGate, journal, fleet,
+                 newest_fn: Callable[[], str | None],
+                 mirror_fraction: float = 1.0,
+                 shadow_timeout_s: float = 15.0,
+                 flightrec=None,
+                 tick_interval_s: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic,
+                 log_fn: Callable | None = None):
+        if not 0.0 < mirror_fraction <= 1.0:
+            raise ValueError(
+                f"mirror_fraction must be in (0, 1], got {mirror_fraction}"
+            )
+        self.gate = gate
+        self.journal = journal
+        self.fleet = fleet
+        self._newest = newest_fn
+        self.mirror_fraction = float(mirror_fraction)
+        self.shadow_timeout_s = float(shadow_timeout_s)
+        self.flightrec = flightrec
+        self.tick_interval_s = float(tick_interval_s)
+        self._clock = clock
+        self._log = log_fn or (lambda m: print(m, file=sys.stderr))
+        self._lock = racecheck.make_lock("continual.canary")
+        # state machine: idle -> pinning -> evaluating -> (promote |
+        # rollback: unpinning) -> idle. All mutated on the tick path,
+        # read by /stats scrapers — hence the lock.
+        self._state = "idle"
+        self._candidate: str | None = None
+        self._rid = None
+        self._consumed_seq = 0
+        self._mirror_acc = 0.0
+        self._pin_deadline = 0.0
+        # lifetime per-version metric plane (scrapeable)
+        self._mae_hists: dict[str, Histogram] = {}
+        self._shadow_lat_hists: dict[str, Histogram] = {}
+        # window accumulators (reset per candidate)
+        self._win_cand: Histogram | None = None
+        self._win_lat: Histogram | None = None
+        self._win_base_count = 0
+        self._win_base_sum = 0.0
+        self.shadow_sent = 0
+        self.shadow_errors = 0
+        self.live_observed = 0
+        self.rejected: set[str] = set()
+        self.events: list[dict] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- metric plane ----
+
+    def _observe_live(self, version: str, abs_err: float) -> None:
+        with self._lock:
+            h = self._mae_hists.get(version)
+            if h is None:
+                h = self._mae_hists[version] = Histogram(MAE_BOUNDS)
+        h.observe(abs_err)
+
+    def _observe_shadow(self, version: str, abs_err: float,
+                        latency_ms: float) -> None:
+        with self._lock:
+            h = self._mae_hists.get(version)
+            if h is None:
+                h = self._mae_hists[version] = Histogram(MAE_BOUNDS)
+            lh = self._shadow_lat_hists.get(version)
+            if lh is None:
+                lh = self._shadow_lat_hists[version] = Histogram(
+                    LATENCY_MS_BOUNDS)
+        h.observe(abs_err)
+        lh.observe(latency_ms)
+
+    def metrics_histograms(self) -> dict:
+        """``param_version``-labeled snapshot map for the registry
+        provider (export.py renders the labeled keys; /metrics/fleet
+        merges them label-set by label-set)."""
+        with self._lock:
+            mae = dict(self._mae_hists)
+            lat = dict(self._shadow_lat_hists)
+        out = {}
+        for v, h in mae.items():
+            key = format_labels({"param_version": v})
+            out[f"fleet_label_mae_hist{key}"] = h.snapshot()
+        for v, h in lat.items():
+            key = format_labels({"param_version": v})
+            out[f"fleet_shadow_latency_ms_hist{key}"] = h.snapshot()
+        return out
+
+    # ---- the tick (synchronous, testable) ----
+
+    def tick(self, now: float | None = None) -> None:
+        now = self._clock() if now is None else now
+        self._drain_labels(now)
+        with self._lock:
+            state = self._state
+        if state == "idle":
+            self._maybe_begin(now)
+        elif state == "pinning":
+            self._check_pinned(now)
+        elif state == "evaluating":
+            self._poll_gate(now)
+        elif state == "unpinning":
+            self._check_unpinned(now)
+
+    def _drain_labels(self, now: float) -> None:
+        records = self.journal.labeled_records(after_seq=self._consumed_seq)
+        if not records:
+            return
+        self._consumed_seq = records[-1]["join_seq"]
+        with self._lock:
+            evaluating = self._state == "evaluating"
+            rid, cand = self._rid, self._candidate
+        for rec in records:
+            pred, label = rec.get("prediction"), rec.get("label")
+            version = rec.get("param_version") or "unknown"
+            if pred is None or label is None:
+                continue
+            err = abs(float(pred) - float(label))
+            self._observe_live(version, err)
+            with self._lock:
+                self.live_observed += 1
+                if evaluating:
+                    self._win_base_count += 1
+                    self._win_base_sum += err
+            if evaluating:
+                self._maybe_mirror(rid, cand, rec)
+
+    def _maybe_mirror(self, rid, cand: str, rec: dict) -> None:
+        payload = rec.get("payload")
+        if not payload:
+            return
+        # deterministic fraction sampling: an accumulator, not an RNG —
+        # exactly mirror_fraction of eligible records mirror, in order
+        with self._lock:
+            self._mirror_acc += self.mirror_fraction
+            if self._mirror_acc < 1.0:
+                return
+            self._mirror_acc -= 1.0
+        try:
+            pred, latency_ms = self.fleet.shadow_predict(
+                rid, payload, self.shadow_timeout_s)
+        except Exception as e:  # noqa: BLE001 — a failed shadow is a
+            # metric, never an outage: the client was answered long ago
+            with self._lock:
+                self.shadow_errors += 1
+            self._log(f"canary: shadow predict failed: {e!r}")
+            return
+        with self._lock:
+            self.shadow_sent += 1
+        err = abs(float(pred) - float(rec["label"]))
+        self._observe_shadow(cand, err, latency_ms)
+        with self._lock:
+            if self._win_cand is not None:
+                self._win_cand.observe(err)
+                self._win_lat.observe(latency_ms)
+
+    def _maybe_begin(self, now: float) -> None:
+        newest = self._newest()
+        fleet_v = self.fleet.fleet_version()
+        if (newest is None or fleet_v is None or newest == fleet_v
+                or newest in self.rejected or newest <= fleet_v):
+            return
+        rid = self.fleet.begin_canary(newest)
+        if rid is None:
+            return  # no spare replica this tick; retry
+        self._log(f"canary: evaluating candidate {newest} on replica "
+                  f"{rid} (fleet at {fleet_v})")
+        self._pin_deadline = now + self.gate.config.max_window_s
+        with self._lock:
+            self._mirror_acc = 0.0
+            self._state = "pinning"
+            self._candidate = newest
+            self._rid = rid
+            self._win_cand = Histogram(MAE_BOUNDS)
+            self._win_lat = Histogram(LATENCY_MS_BOUNDS)
+            self._win_base_count = 0
+            self._win_base_sum = 0.0
+        self._event("canary_begin", version=newest, rid=rid)
+
+    def _check_pinned(self, now: float) -> None:
+        with self._lock:
+            rid, cand = self._rid, self._candidate
+        if self.fleet.canary_version(rid) == cand:
+            self.gate.begin(cand, now)
+            with self._lock:
+                self._state = "evaluating"
+            self._event("canary_pinned", version=cand, rid=rid)
+        elif now >= self._pin_deadline:
+            # the pin never converged (corrupt save, dead replica):
+            # treat as a rollback — the candidate is not promotable
+            self._log(f"canary: pin to {cand} never converged; rejecting")
+            self._begin_rollback(rid, cand, "pin_timeout", None)
+
+    def _poll_gate(self, now: float) -> None:
+        with self._lock:
+            rid, cand = self._rid, self._candidate
+            cw, lw = self._win_cand, self._win_lat
+            bc, bs = self._win_base_count, self._win_base_sum
+        cs = cw.snapshot()
+        stats = GateStats(
+            candidate_count=int(cs["count"]),
+            candidate_mae=(cs["sum"] / cs["count"] if cs["count"]
+                           else float("nan")),
+            candidate_p99_ms=lw.quantile(0.99),
+            baseline_count=bc,
+            baseline_mae=(bs / bc if bc else float("nan")),
+        )
+        decision = self.gate.poll(now, stats)
+        if decision is None:
+            return
+        if decision.action == "promote":
+            self._log(
+                f"canary: PROMOTING {cand} fleet-wide (shadow MAE "
+                f"{stats.candidate_mae:.4g} vs live "
+                f"{stats.baseline_mae:.4g}, ratio "
+                f"{decision.mae_ratio:.3f}, {stats.candidate_count} "
+                "shadow samples)"
+            )
+            self.fleet.promote(rid, cand)
+            with self._lock:
+                self._state = "idle"
+                self._candidate = None
+                self._rid = None
+            self._event("promoted", version=cand, rid=rid,
+                        mae_ratio=decision.mae_ratio,
+                        shadow_samples=stats.candidate_count)
+        else:
+            self._begin_rollback(rid, cand, decision.reason, decision)
+
+    def _begin_rollback(self, rid, version: str, reason: str,
+                        decision: GateDecision | None) -> None:
+        fleet_v = self.fleet.fleet_version()
+        ratio = decision.mae_ratio if decision is not None else float("nan")
+        self._log(
+            f"canary: ROLLING BACK {version} (reason={reason}, mae "
+            f"ratio {ratio:.3f}); fleet stays on {fleet_v}"
+        )
+        self.rejected.add(version)
+        # the accountability pin: every rollback dumps a bundle NAMING
+        # the regressing version — in the reason (the bundle dir name)
+        # and in the manifest detail
+        if self.flightrec is not None:
+            self.flightrec.trigger(
+                f"canary_rollback_{version}",
+                detail=(f"candidate {version} rejected: {reason}, "
+                        f"mae_ratio={ratio:.4g}, fleet stays {fleet_v}"),
+            )
+        self.fleet.abort_canary(rid, fleet_v)
+        self._pin_deadline = self._clock() + self.gate.config.max_window_s
+        with self._lock:
+            self._state = "unpinning"
+        self._event("rolled_back", version=version, rid=rid,
+                    reason=reason, mae_ratio=ratio)
+
+    def _check_unpinned(self, now: float) -> None:
+        with self._lock:
+            rid = self._rid
+        fleet_v = self.fleet.fleet_version()
+        if self.fleet.canary_version(rid) == fleet_v:
+            self.fleet.end_canary(rid)
+            with self._lock:
+                self._state = "idle"
+                self._candidate = None
+                self._rid = None
+            self._event("canary_returned", rid=rid, version=fleet_v)
+        elif now >= self._pin_deadline:
+            # a canary that cannot even restore the fleet version is a
+            # sick replica: return it to the router's remediation plane
+            # rather than holding the loop hostage
+            self._log(f"canary: replica {rid} failed to unpin; releasing")
+            self.fleet.end_canary(rid)
+            with self._lock:
+                self._state = "idle"
+                self._candidate = None
+                self._rid = None
+            self._event("canary_release_forced", rid=rid)
+
+    def _event(self, kind: str, **fields) -> None:
+        with self._lock:
+            self.events.append(dict(kind=kind, **fields))
+
+    # ---- lifecycle ----
+
+    def start(self) -> "CanaryController":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="fleet-canary"
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.tick_interval_s):
+            racecheck.heartbeat()
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must
+                # survive a flaky canary; next tick retries
+                self._log(f"canary: tick error (will retry): {e!r}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "candidate": self._candidate,
+                "canary_rid": self._rid,
+                "shadow_sent": self.shadow_sent,
+                "shadow_errors": self.shadow_errors,
+                "live_observed": self.live_observed,
+                "rejected": sorted(self.rejected),
+                "gate": self.gate.state(),
+                "events": [dict(e) for e in self.events],
+            }

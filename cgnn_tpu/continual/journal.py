@@ -1,0 +1,371 @@
+"""The label journal: served traffic -> a growing labeled replay set.
+
+Serving answers requests whose ground truth arrives LATER (a DFT run
+finishes, an experiment is measured). The journal is the join point:
+
+- every answered request appends a SERVED record — the wire payload
+  that produced it, the prediction, the ``param_version`` that computed
+  it, the trace id, and (when the serving core computed one) the
+  content fingerprint;
+- a late ``POST /label`` joins ground truth to that record by trace id
+  or fingerprint, EXACTLY ONCE: retried/hedged requests share a trace
+  id, so the journal holds at most one record per trace id, and a
+  label that already landed answers ``already`` without touching the
+  stored value — a retransmitted label can never double-apply.
+
+Durability is an append-only JSONL stream (``served`` and ``label``
+lines), bounded by size-capped rotation; the in-memory index is
+bounded by record count with oldest-first eviction. The stream is the
+CROSS-PROCESS interface: the continual trainer tails the router's
+journal file (:class:`JournalTail` survives rotation) and replays the
+same join logic to rebuild the labeled replay set — replay goes through
+the identical ``_apply`` path as live appends, so exactly-once holds
+across process restarts too.
+
+Everything here is host-side bookkeeping: nothing touches the serving
+dispatch path beyond one append per answered request, and nothing is
+staged into jitted code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Iterator
+
+from cgnn_tpu.analysis import racecheck
+from cgnn_tpu.observe.metrics_io import jsonfinite
+
+
+class LabelJournal:
+    """Bounded served-request journal with exactly-once label joins.
+
+    ``capacity`` bounds the in-memory index (oldest records evicted,
+    labeled or not — the replay set is a window, not an archive);
+    ``max_bytes`` bounds the on-disk stream via single-file rotation
+    (``<path>`` -> ``<path>.1``). ``path=None`` keeps the journal
+    memory-only (tests, and the serve-side journal when only the
+    router's is durable).
+    """
+
+    def __init__(self, path: str | None = None, *, capacity: int = 8192,
+                 max_bytes: int = 64 * 1024 * 1024):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be > 0, got {capacity}")
+        self.path = path
+        self.capacity = int(capacity)
+        self.max_bytes = int(max_bytes)
+        self._lock = racecheck.make_lock("continual.journal")
+        # trace_id -> record dict; insertion order = arrival order, so
+        # popitem(last=False) evicts oldest. Fingerprint is a secondary
+        # index (many trace ids MAY share a fingerprint — the same
+        # structure re-submitted; a fingerprint join lands on the OLDEST
+        # unlabeled record with that print).
+        self._by_trace: OrderedDict[str, dict] = OrderedDict()
+        self._by_fp: dict[str, list] = {}
+        self._join_seq = 0
+        self.served = 0
+        self.joined = 0
+        self.duplicate_joins = 0
+        self.unmatched_labels = 0
+        self.evicted = 0
+        self._fh = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    # ---- the shared apply path (live appends AND file replay) ----
+
+    def _apply(self, obj: dict, persist: bool) -> str:
+        """Apply one journal line under the lock; returns the join
+        status for label lines ('joined'|'already'|'unmatched') and
+        'served' for served lines."""
+        kind = obj.get("kind")
+        with self._lock:
+            if kind == "served":
+                status = self._apply_served_locked(obj)
+            elif kind == "label":
+                status = self._apply_label_locked(obj)
+            else:
+                raise ValueError(f"unknown journal line kind {kind!r}")
+            if persist:
+                self._write_locked(obj)
+        return status
+
+    def _apply_served_locked(self, obj: dict) -> str:
+        tid = obj["trace_id"]
+        if tid in self._by_trace:
+            # a hedged/retried attempt re-reporting the same request:
+            # the trace id IS the idempotency key — keep the first
+            return "served"
+        rec = dict(obj)
+        rec.setdefault("label", None)
+        rec["labeled"] = bool(rec.get("labeled"))
+        self._by_trace[tid] = rec
+        fp = rec.get("fingerprint")
+        if fp:
+            self._by_fp.setdefault(fp, []).append(tid)
+        self.served += 1
+        while len(self._by_trace) > self.capacity:
+            old_tid, old = self._by_trace.popitem(last=False)
+            ofp = old.get("fingerprint")
+            if ofp and ofp in self._by_fp:
+                tids = [t for t in self._by_fp[ofp] if t != old_tid]
+                if tids:
+                    self._by_fp[ofp] = tids
+                else:
+                    del self._by_fp[ofp]
+            self.evicted += 1
+        return "served"
+
+    def _find_locked(self, trace_id: str | None,
+                     fingerprint: str | None) -> dict | None:
+        if trace_id is not None:
+            return self._by_trace.get(trace_id)
+        if fingerprint is not None:
+            for tid in self._by_fp.get(fingerprint, ()):
+                rec = self._by_trace.get(tid)
+                if rec is not None and not rec["labeled"]:
+                    return rec
+            # all labeled (or none left): report the first for the
+            # 'already' classification
+            for tid in self._by_fp.get(fingerprint, ()):
+                rec = self._by_trace.get(tid)
+                if rec is not None:
+                    return rec
+        return None
+
+    def _apply_label_locked(self, obj: dict) -> str:
+        rec = self._find_locked(obj.get("trace_id"), obj.get("fingerprint"))
+        if rec is None:
+            self.unmatched_labels += 1
+            return "unmatched"
+        if rec["labeled"]:
+            # exactly-once: the stored label is immutable; a re-sent
+            # (or double-emitted) label is acknowledged, never applied
+            self.duplicate_joins += 1
+            return "already"
+        rec["label"] = float(obj["label"])
+        rec["labeled"] = True
+        self._join_seq += 1
+        rec["join_seq"] = self._join_seq
+        self.joined += 1
+        return "joined"
+
+    # ---- live API ----
+
+    def note_served(self, *, trace_id: str, payload: dict | None,
+                    prediction: float | None, param_version: str,
+                    fingerprint: str | None = None,
+                    ts: float | None = None) -> None:
+        """Append one answered request. ``payload`` is the wire body
+        that produced it (what the trainer replays); None is allowed
+        when the caller only needs join accounting."""
+        self._apply(
+            {
+                "kind": "served",
+                "trace_id": str(trace_id),
+                "fingerprint": fingerprint,
+                "payload": payload,
+                "prediction": (None if prediction is None
+                               else float(prediction)),
+                "param_version": param_version,
+                "ts": ts,
+            },
+            persist=self.path is not None,
+        )
+
+    def join(self, label: float, *, trace_id: str | None = None,
+             fingerprint: str | None = None) -> str:
+        """Join ground truth -> 'joined' | 'already' | 'unmatched'."""
+        if trace_id is None and fingerprint is None:
+            raise ValueError("join needs a trace_id or a fingerprint")
+        return self._apply(
+            {
+                "kind": "label",
+                "trace_id": trace_id,
+                "fingerprint": fingerprint,
+                "label": float(label),
+            },
+            persist=self.path is not None,
+        )
+
+    def apply_line(self, obj: dict) -> str:
+        """Replay one parsed journal line WITHOUT re-persisting it (the
+        tail-follower path; identical join semantics as live calls)."""
+        return self._apply(obj, persist=False)
+
+    # ---- consumption ----
+
+    def labeled_records(self, after_seq: int = 0) -> list:
+        """Joined records with ``join_seq > after_seq`` (join order) —
+        copies of the record dicts, so callers mutate nothing shared."""
+        with self._lock:
+            recs = [dict(r) for r in self._by_trace.values()
+                    if r["labeled"] and r.get("join_seq", 0) > after_seq]
+        recs.sort(key=lambda r: r["join_seq"])
+        return recs
+
+    @property
+    def join_seq(self) -> int:
+        with self._lock:
+            return self._join_seq
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "served": self.served,
+                "joined": self.joined,
+                "duplicate_joins": self.duplicate_joins,
+                "unmatched_labels": self.unmatched_labels,
+                "evicted": self.evicted,
+                "resident": len(self._by_trace),
+            }
+
+    # ---- persistence ----
+
+    def _write_locked(self, obj: dict) -> None:
+        if self._fh is None:
+            self._fh = open(self.path, "a", encoding="utf-8")
+        # non-finite predictions/labels -> null: a diverging model must
+        # not make the stream unparseable (graftcheck GC-JSONFINITE)
+        self._fh.write(json.dumps(jsonfinite(obj), allow_nan=False) + "\n")
+        self._fh.flush()
+        if self._fh.tell() >= self.max_bytes:
+            self._fh.close()
+            self._fh = None
+            os.replace(self.path, self.path + ".1")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    @classmethod
+    def replay(cls, path: str, **kwargs) -> "LabelJournal":
+        """Rebuild a journal's in-memory state from its stream (restart
+        path). Reads the rotated predecessor first when present. The
+        returned journal keeps appending to ``path``."""
+        j = cls(path=None, **kwargs)
+        for p in (path + ".1", path):
+            try:
+                with open(p, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            j.apply_line(json.loads(line))
+            except FileNotFoundError:
+                continue
+        j.path = path
+        return j
+
+
+class JournalTail:
+    """Incremental reader of a journal JSONL stream (cross-process).
+
+    ``poll()`` returns newly appended parsed lines since the last call,
+    surviving the writer's rotation: the open handle keeps reading the
+    renamed file to EOF (POSIX semantics), and a changed inode at EOF
+    reopens the new stream from offset 0 — no line is skipped and none
+    is delivered twice. A torn trailing line (writer mid-append) stays
+    buffered until its newline lands.
+
+    The no-skip guarantee assumes the tail polls at least once per
+    rotation (a second ``os.replace`` overwrites ``<path>.1`` for
+    good); with the 64 MiB default rotation size and second-scale poll
+    cadences that holds by many orders of magnitude.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+        self._ino = None
+        self._buf = ""
+
+    def _open(self) -> bool:
+        try:
+            self._fh = open(self.path, encoding="utf-8")
+        except FileNotFoundError:
+            self._fh = None
+            return False
+        self._ino = os.fstat(self._fh.fileno()).st_ino
+        self._buf = ""
+        return True
+
+    def _rotated(self) -> bool:
+        try:
+            return os.stat(self.path).st_ino != self._ino
+        except FileNotFoundError:
+            return False
+
+    def poll(self, on_error: Callable | None = None) -> list:
+        """Newly appended parsed line objects (possibly empty)."""
+        out: list = []
+        if self._fh is None and not self._open():
+            return out
+        for _round in range(2):  # current handle, then post-rotation
+            chunk = self._fh.read()
+            if chunk:
+                self._buf += chunk
+                while "\n" in self._buf:
+                    line, self._buf = self._buf.split("\n", 1)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError as e:
+                        if on_error is not None:
+                            on_error(f"journal tail: bad line: {e}")
+            if not self._rotated():
+                break
+            # writer rotated underneath us: old handle is drained (read
+            # returned ''), switch to the new stream from the top
+            self._fh.close()
+            if not self._open():
+                break
+        return out
+
+    def follow_into(self, journal: LabelJournal,
+                    on_error: Callable | None = None) -> int:
+        """Apply every new line into ``journal``; returns lines applied."""
+        lines = self.poll(on_error=on_error)
+        for obj in lines:
+            journal.apply_line(obj)
+        return len(lines)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def iter_labeled_graphs(records) -> Iterator:
+    """Journal records -> (CrystalGraph with the TRUE target, record).
+
+    Featurized-wire records replay through the same
+    ``graph_from_json`` path the HTTP handler uses; records without a
+    payload (accounting-only journals) are skipped.
+    """
+    import dataclasses
+
+    import numpy as np
+
+    from cgnn_tpu.serve.http import graph_from_json
+
+    for rec in records:
+        payload = rec.get("payload")
+        if not payload or not rec.get("labeled"):
+            continue
+        graph_json = payload.get("graph")
+        if graph_json is None:
+            continue
+        try:
+            g = graph_from_json(graph_json)
+        except ValueError:
+            continue
+        g = dataclasses.replace(
+            g, target=np.asarray([rec["label"]], np.float32))
+        yield g, rec

@@ -1,0 +1,305 @@
+"""The colocated continual trainer: journal -> fine-tune -> commit.
+
+Consumes the label journal's replay set through the EXISTING data
+machinery (``iter_labeled_graphs`` -> ``graph_from_json`` ->
+``capacities_for``/``batch_iterator`` via ``train.loop.fit``), fine-
+tunes from the newest committed checkpoint, and commits versioned
+candidates into the fleet's shared checkpoint directory with the PR-2
+``CheckpointManager`` protocol — the same manifest-as-commit-marker
+saves the serving watchers poll. Nothing here promotes anything: a
+commit only makes a CANDIDATE visible; the canary gate (canary.py)
+decides whether the fleet ever serves it, and the reload-watcher gate
+(serve/reload.py) holds every fleet replica until it does.
+
+Commit cadence is doubly gated — at least ``min_new_labels`` newly
+joined labels AND at least ``min_interval_s`` since the last commit —
+so a label burst cannot thrash the checkpoint directory and a trickle
+cannot starve the loop. Training is guard/divergence-protected exactly
+like ``train.py``: the in-graph guard skips non-finite updates and a
+``DivergenceMonitor`` rolls back to the last committed save with an LR
+cut on sustained divergence.
+
+This loop is the first workload training WHILE the same host serves
+(the fleet smoke runs it beside N serving replicas); keep all its
+bookkeeping under the racecheck-instrumented lock discipline.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable
+
+from cgnn_tpu.analysis import racecheck
+from cgnn_tpu.continual.journal import (
+    JournalTail,
+    LabelJournal,
+    iter_labeled_graphs,
+)
+from cgnn_tpu.resilience import faultinject
+
+
+class ContinualTrainer:
+    """Fine-tune-on-served-traffic loop over a shared checkpoint dir.
+
+    ``journal`` is an in-process :class:`LabelJournal` (tests, and the
+    single-process serve path) OR ``journal_path`` names a JSONL stream
+    another process appends (the router's journal in the fleet) which
+    is tailed into a private replay journal — both go through the same
+    exactly-once join logic.
+
+    ``poll_once`` is the synchronous, testable unit: it drains new
+    journal lines, checks the cadence gates, and runs at most one
+    fine-tune round -> committed save name (or None). ``run`` loops it.
+    """
+
+    def __init__(self, ckpt_dir: str, *, journal: LabelJournal | None = None,
+                 journal_path: str | None = None,
+                 min_new_labels: int = 64, min_interval_s: float = 5.0,
+                 batch_size: int = 16, epochs_per_round: int = 2,
+                 lr: float = 0.01, max_replay: int = 4096,
+                 max_rounds: int = 0, seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic,
+                 log_fn: Callable | None = None):
+        if (journal is None) == (journal_path is None):
+            raise ValueError("pass exactly one of journal / journal_path")
+        if min_new_labels <= 0:
+            raise ValueError(
+                f"min_new_labels must be > 0, got {min_new_labels}")
+        self.ckpt_dir = ckpt_dir
+        self._tail = None
+        if journal is not None:
+            self.journal = journal
+        else:
+            self.journal = LabelJournal(path=None, capacity=max_replay)
+            self._tail = JournalTail(journal_path)
+        self.min_new_labels = int(min_new_labels)
+        self.min_interval_s = float(min_interval_s)
+        self.batch_size = int(batch_size)
+        self.epochs_per_round = int(epochs_per_round)
+        self.lr = float(lr)
+        self.max_replay = int(max_replay)
+        self.max_rounds = int(max_rounds)  # 0 = unbounded
+        self.seed = int(seed)
+        self._clock = clock
+        self._log = log_fn or (lambda m: print(m, file=sys.stderr))
+        self._lock = racecheck.make_lock("continual.trainer")
+        # train-side lazies (built on the first round, once the replay
+        # set exists): manager, model, state, monitor, fixed capacities
+        self._mgr = None
+        self._state = None
+        self._model_cfg = None
+        self._meta = None
+        self._monitor = None
+        self._caps = None
+        self._trained_seq = 0   # join_seq consumed by the last commit
+        self._last_commit_t = float("-inf")
+        self.rounds = 0
+        self.commits: list[str] = []
+        self.labels_trained = 0
+        self.divergence_rollbacks = 0
+
+    # ---- lazy train-side boot ----
+
+    def _ensure_mgr(self):
+        if self._mgr is None:
+            from cgnn_tpu.train import CheckpointManager
+
+            self._mgr = CheckpointManager(self.ckpt_dir)
+        return self._mgr
+
+    def _ensure_state(self, graphs):
+        """Build model/state from the checkpoint's own meta and restore
+        the newest committed save INTO it (params + optimizer +
+        normalizer) — the fine-tune starting point."""
+        if self._state is not None:
+            return
+        import jax
+        import numpy as np
+
+        from cgnn_tpu.config import DataConfig, ModelConfig, build_model
+        from cgnn_tpu.data.graph import batch_iterator, capacities_for
+        from cgnn_tpu.resilience import DivergenceMonitor
+        from cgnn_tpu.train import (
+            Normalizer,
+            create_train_state,
+            make_optimizer,
+        )
+
+        mgr = self._ensure_mgr()
+        meta = mgr.read_meta("latest")
+        if not meta.get("model"):
+            raise RuntimeError(
+                f"no committed checkpoint with model meta under "
+                f"{self.ckpt_dir}; the continual trainer fine-tunes, it "
+                "does not bootstrap"
+            )
+        self._model_cfg = ModelConfig.from_meta(meta["model"])
+        data_cfg = DataConfig.from_meta(meta["data"])
+        self._meta = {
+            "model": meta["model"], "data": meta["data"],
+            "task": meta.get("task", "regression"),
+        }
+        # fixed capacities for the whole loop: sized once with headroom
+        # over the first replay set, so every round reuses the same
+        # compiled step shapes instead of retracing per replay window
+        nc, ec = capacities_for(graphs, self.batch_size,
+                                dense_m=self._model_cfg.dense_m)
+        self._caps = (nc, ec)
+        example = next(batch_iterator(
+            graphs[: self.batch_size], self.batch_size, nc, ec,
+            dense_m=self._model_cfg.dense_m, in_cap=0))
+        model = build_model(self._model_cfg, data_cfg,
+                            self._meta["task"])
+        state = create_train_state(
+            model, example, make_optimizer(lr=self.lr),
+            Normalizer.fit(np.stack([g.target for g in graphs])),
+            rng=jax.random.key(self.seed),
+        )
+        state, _ = mgr.restore(state, "latest")
+        self._state = state
+        self._monitor = DivergenceMonitor(mgr, log_fn=self._log)
+
+    # ---- the synchronous unit ----
+
+    def poll_once(self, now: float | None = None) -> str | None:
+        """Drain the journal; run one gated fine-tune round if due.
+        Returns the committed save name, or None (gates closed)."""
+        now = self._clock() if now is None else now
+        if self._tail is not None:
+            self._tail.follow_into(self.journal, on_error=self._log)
+        with self._lock:
+            rounds = self.rounds
+        if self.max_rounds and rounds >= self.max_rounds:
+            return None
+        new_labels = self.journal.join_seq - self._trained_seq
+        if new_labels < self.min_new_labels:
+            return None
+        if now - self._last_commit_t < self.min_interval_s:
+            return None
+        return self._round(now)
+
+    def _round(self, now: float) -> str | None:
+        import numpy as np
+
+        from cgnn_tpu.train.loop import fit
+
+        records = self.journal.labeled_records()
+        if len(records) > self.max_replay:
+            records = records[-self.max_replay:]
+        graphs = [g for g, _rec in iter_labeled_graphs(records)]
+        if len(graphs) < self.min_new_labels:
+            # labels joined but payloads missing (accounting-only
+            # records replay nothing) — hold
+            return None
+        with self._lock:
+            round_idx = self.rounds + 1
+        noise = faultinject.label_noise_for_round(round_idx)
+        if noise is not None:
+            # the injected REGRESSING candidate (fleet_smoke leg 8):
+            # shift every label by a constant offset so even a short
+            # fine-tune drags predictions off by ~the offset — the
+            # committed version is measurably worse on TRUE labels and
+            # the canary gate must catch it. (A zero-mean corruption
+            # would NOT regress the model: a couple of epochs can't fit
+            # unstructured noise, so the candidate would stay near its
+            # init and pass the gate honestly.)
+            self._log(
+                f"continual: FAULT label_noise +{noise:g} shift on round "
+                f"{round_idx} — committing a deliberately bad candidate"
+            )
+            import dataclasses as _dc
+
+            graphs = [
+                _dc.replace(
+                    g,
+                    target=np.asarray(g.target, np.float32)
+                    + np.float32(noise),
+                )
+                for g in graphs
+            ]
+        self._ensure_state(graphs)
+        # replay split: every 4th graph validates (the divergence
+        # monitor and best-tracking need a val signal; the replay set
+        # is served traffic, so any slice is distribution-faithful)
+        train_g = [g for i, g in enumerate(graphs) if i % 4 != 0]
+        val_g = [g for i, g in enumerate(graphs) if i % 4 == 0] or train_g
+        nc, ec = self._caps
+        self._log(
+            f"continual: round {round_idx}: fine-tuning on "
+            f"{len(train_g)} replayed labels (val {len(val_g)}, "
+            f"{self.journal.join_seq - self._trained_seq} new)"
+        )
+        before = self._monitor.rollbacks if self._monitor else 0
+        state, result = fit(
+            self._state, train_g, val_g,
+            epochs=self.epochs_per_round,
+            batch_size=min(self.batch_size, max(1, len(train_g))),
+            node_cap=nc, edge_cap=ec,
+            dense_m=self._model_cfg.dense_m,
+            print_freq=0, log_fn=self._log,
+            seed=self.seed + round_idx,
+            guard=True, monitor=self._monitor,
+        )
+        self._state = state
+        if self._monitor is not None:
+            with self._lock:
+                self.divergence_rollbacks += (
+                    self._monitor.rollbacks - before)
+        mgr = self._ensure_mgr()
+        epoch = 0
+        try:
+            epoch = int(mgr.read_meta("latest").get("epoch", 0))
+        except (TypeError, ValueError):
+            pass
+        mgr.save(state, dict(
+            self._meta, epoch=epoch + 1, continual_round=round_idx,
+            replay_labels=len(graphs),
+            val_best=float(result.get("best", float("nan"))),
+        ))
+        mgr.wait()
+        name = mgr.newest_committed()
+        with self._lock:
+            self.rounds = round_idx
+            self.commits.append(name)
+            self.labels_trained += len(graphs)
+        self._trained_seq = self.journal.join_seq
+        self._last_commit_t = now
+        self._log(f"continual: round {round_idx} committed {name}")
+        return name
+
+    # ---- the loop ----
+
+    def run(self, poll_interval_s: float = 1.0,
+            stop: threading.Event | None = None) -> None:
+        stop = stop or threading.Event()
+        while not stop.wait(poll_interval_s):
+            racecheck.heartbeat()
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — a failed round must
+                # not kill the loop; the journal keeps growing and the
+                # next round retries from the restored state
+                self._log(f"continual: round failed (will retry): {e!r}")
+            with self._lock:
+                rounds = self.rounds
+            if self.max_rounds and rounds >= self.max_rounds:
+                return
+
+    def close(self) -> None:
+        if self._tail is not None:
+            self._tail.close()
+        if self._mgr is not None:
+            self._mgr.close()
+            self._mgr = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rounds": self.rounds,
+                "commits": list(self.commits),
+                "labels_trained": self.labels_trained,
+                "divergence_rollbacks": self.divergence_rollbacks,
+                "journal": self.journal.stats(),
+            }

@@ -194,9 +194,13 @@ class AutoscalePolicy:
     def pick_victim(replicas: Sequence) -> int | None:
         """The least-loaded routed replica (by ReplicaState.score():
         in-flight + scraped queue depth, tie-broken by scraped p99 then
-        rid); already-draining replicas are never re-picked. None when
-        nothing qualifies."""
-        candidates = [r for r in replicas if not r.stats()["draining"]]
+        rid); already-draining replicas are never re-picked, nor is a
+        canary mid-evaluation (ISSUE 18: draining the canary would
+        silently abort the candidate's gate window). None when nothing
+        qualifies."""
+        candidates = [r for r in replicas
+                      if not r.stats()["draining"]
+                      and not getattr(r, "canary", False)]
         if not candidates:
             return None
         return min(candidates, key=lambda r: r.score()).rid

@@ -170,7 +170,7 @@ def make_fleet_handler(router: FleetRouter):
             self._reply(200, doc)
 
         def do_POST(self):  # noqa: N802
-            if self.path != "/predict":
+            if self.path not in ("/predict", "/label"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             try:
@@ -181,6 +181,9 @@ def make_fleet_handler(router: FleetRouter):
                 return
             if not isinstance(body, dict):
                 self._reply(400, {"error": "body must be a JSON object"})
+                return
+            if self.path == "/label":
+                self._do_label(body)
                 return
             trace_id = (self.headers.get("X-Request-Id")
                         or body.get("trace_id"))
@@ -196,6 +199,33 @@ def make_fleet_handler(router: FleetRouter):
                 headers["Retry-After"] = str(
                     int(max(meta["retry_after_s"], 1)))
             self._reply(status, payload, headers=headers)
+
+        def _do_label(self, body: dict) -> None:
+            # late ground truth -> the router's label journal, joined
+            # by the trace id the /predict answer carried (ISSUE 18:
+            # exactly once — a retransmitted label answers 'already')
+            if router.journal is None:
+                self._reply(501, {
+                    "error": "label journal not configured "
+                             "(fleet.py --journal)",
+                })
+                return
+            try:
+                label = float(body["label"])
+            except (KeyError, TypeError, ValueError) as e:
+                self._reply(400, {"error": f"malformed label: {e}"})
+                return
+            trace_id = body.get("trace_id")
+            fingerprint = body.get("fingerprint")
+            if trace_id is None and fingerprint is None:
+                self._reply(400, {
+                    "error": "label needs a 'trace_id' or a 'fingerprint'",
+                })
+                return
+            status = router.journal.join(
+                label, trace_id=trace_id, fingerprint=fingerprint)
+            self._reply(200 if status != "unmatched" else 404,
+                        {"status": status})
 
     return FleetHandler
 

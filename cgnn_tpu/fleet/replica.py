@@ -84,6 +84,28 @@ def http_get_json(url: str, timeout_s: float = 2.0) -> tuple[int, dict]:
         raise FleetTransportError(f"{url}: {e!r}") from None
 
 
+def http_post_json(url: str, body: dict,
+                   timeout_s: float = 5.0) -> tuple[int, dict]:
+    """POST a JSON body to a control endpoint (the canary plane's
+    /reload-control and /label); -> (status, payload). HTTP error
+    statuses are returned; wire failures raise FleetTransportError."""
+    data = json.dumps(body, allow_nan=False).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            return e.code, {}
+    except (urllib.error.URLError, ConnectionError, OSError,
+            TimeoutError) as e:
+        raise FleetTransportError(f"{url}: {e!r}") from None
+
+
 def http_get_text(url: str, timeout_s: float = 2.0) -> str:
     """GET a text endpoint (the /metrics scrape)."""
     try:
@@ -134,6 +156,11 @@ class ReplicaState:
         self._probe_ready = False
         self._draining = False
         self._drain_intent = False   # router-side, sticky (ISSUE 17)
+        # canary pin (ISSUE 18): this replica is evaluating a candidate
+        # version — out of the client-traffic rotation (shadow traffic
+        # only), but NOT a drain: the poller must keep classifying it
+        # healthy and the autoscaler must not pick it as a victim
+        self._canary = False
         self._version = ""           # last probed param_version
         self._queue_depth = 0.0      # scraped serve_queue_depth
         self._scraped_p99_ms = 0.0   # scraped rolling p99
@@ -200,6 +227,18 @@ class ReplicaState:
         is draining) must not clear the intent."""
         with self._lock:
             self._drain_intent = True
+
+    def note_canary(self, on: bool) -> None:
+        """Mark/unmark this replica as the canary under evaluation
+        (ISSUE 18). Separate from drain intent on purpose: a canary is
+        healthy and stays probed — it just takes no client traffic."""
+        with self._lock:
+            self._canary = bool(on)
+
+    @property
+    def canary(self) -> bool:
+        with self._lock:
+            return self._canary
 
     def probe_due(self) -> bool:
         """Whether the health poller should spend a probe on this
@@ -291,6 +330,9 @@ class ReplicaState:
             return self._version
 
     def pickable(self) -> bool:
+        with self._lock:
+            if self._canary:
+                return False
         return self.ready and self.breaker.would_admit()
 
     def score(self) -> tuple:
@@ -320,6 +362,7 @@ class ReplicaState:
                 "probe_ok": self._probe_ok,
                 "probe_ready": self._probe_ready,
                 "probe_backoff_s": self._probe_backoff_s,
+                "canary": self._canary,
                 "counts": dict(self.counts),
             }
         out["breaker"] = self.breaker.stats()

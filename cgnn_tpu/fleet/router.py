@@ -165,6 +165,12 @@ class FleetRouter:
         # entrypoint; /stats folds their state in when present
         self.autoscaler = None
         self.remediator = None
+        # continual-learning plane (ISSUE 18), attached by the
+        # entrypoint: the label journal every 200 dispatch lands in
+        # (POST /label joins late ground truth) and the canary
+        # controller whose per-version histograms ride this registry
+        self.journal = None
+        self.canary = None
         # ---- fleet SLO engine + metrics truth (ISSUE 16) ----
         # the router's latency histogram is MERGEABLE (observe/hist.py)
         # where the rolling quantiles above are local color; the SLO
@@ -305,6 +311,158 @@ class FleetRouter:
         r = self._replica(rid)
         if r is not None:
             r.note_draining()
+
+    # ---- the canary plane (ISSUE 18) ----
+    # The fleet-adapter protocol continual/canary.py drives: pin one
+    # replica to a candidate version (out of client rotation, shadow
+    # traffic only), and promote fleet-wide by raising every reload
+    # watcher's gate — the watchers then swap independently, rolling,
+    # with zero dropped requests (in-flight work finishes on the params
+    # it started with; serve/reload.py).
+
+    def attach_journal(self, journal) -> None:
+        """Wire the label journal: every 200 dispatch appends a served
+        record; POST /label (fleet/http.py) joins late ground truth."""
+        self.journal = journal
+
+    def attach_canary(self, controller) -> None:
+        """Wire the canary controller: its per-version MAE/latency
+        histograms join this registry's scrape and /stats folds its
+        state machine in."""
+        self.canary = controller
+
+    def _reload_control(self, r, body: dict) -> bool:
+        from cgnn_tpu.fleet.replica import http_post_json
+
+        try:
+            status, _ = http_post_json(
+                r.base_url + "/reload-control", body, timeout_s=5.0)
+        except FleetTransportError as e:
+            self._log(f"fleet: reload-control {r.name} failed: {e!r}")
+            return False
+        if status != 200:
+            self._log(f"fleet: reload-control {r.name} -> HTTP {status}")
+        return status == 200
+
+    def fleet_version(self) -> str | None:
+        """The version the routed (non-canary) fleet serves — the
+        promotion baseline. With replicas mid-swap, the most common
+        probed version wins; None before any probe landed."""
+        versions = [r.version for r in self.replicas
+                    if not r.canary and r.version]
+        if not versions:
+            return None
+        return collections.Counter(versions).most_common(1)[0][0]
+
+    def begin_canary(self, version: str) -> int | None:
+        """Take one ready replica out of rotation and pin its reload
+        watcher to ``version``; -> rid, or None when no replica can be
+        spared this tick (a one-replica fleet never gives one up)."""
+        pool = sorted((r for r in self.replicas if r.pickable()),
+                      key=lambda r: r.score())
+        if len(pool) < 2:
+            return None
+        r = pool[0]
+        r.note_canary(True)
+        if not self._reload_control(r, {"pin": version}):
+            r.note_canary(False)
+            return None
+        with self._lock:
+            self.counts["fleet_canaries"] = (
+                self.counts.get("fleet_canaries", 0) + 1)
+            self.lifecycle.append({
+                "t": self._clock(), "event": "canary_begin",
+                "replica": r.rid, "reason": version,
+            })
+        return r.rid
+
+    def canary_version(self, rid: int) -> str | None:
+        """What the pinned replica serves right now (the convergence
+        probe); None when unreachable or unrouted."""
+        from cgnn_tpu.fleet.replica import http_get_json
+
+        r = self._replica(rid)
+        if r is None:
+            return None
+        try:
+            _, health = http_get_json(r.base_url + "/healthz",
+                                      timeout_s=5.0)
+        except FleetTransportError:
+            return None
+        v = str(health.get("param_version", ""))
+        return v or None
+
+    def shadow_predict(self, rid: int, payload: dict,
+                       timeout_s: float) -> tuple[float, float]:
+        """One mirrored request straight to the canary, bypassing
+        routing, breakers, and the journal — the shadow answer never
+        counts toward any client response or routing signal. Raises on
+        any failure; -> (prediction, latency_ms)."""
+        r = self._replica(rid)
+        if r is None:
+            raise FleetTransportError(f"replica{rid} is not routed")
+        body = dict(payload)
+        body["timeout_ms"] = timeout_s * 1e3
+        t0 = time.perf_counter()
+        status, resp = self._transport(r, body, timeout_s)
+        lat_ms = (time.perf_counter() - t0) * 1e3
+        if status != 200:
+            raise RuntimeError(
+                f"shadow predict -> HTTP {status}: "
+                f"{(resp or {}).get('error', '')}")
+        self._count("fleet_shadow_mirrors")
+        return float(resp["prediction"][0]), lat_ms
+
+    def promote(self, rid: int, version: str) -> None:
+        """Broadcast the gate fleet-wide: every replica's reload
+        watcher ceiling rises to ``version`` and each swaps
+        independently when it next polls — the rolling, zero-downtime
+        promotion. The canary un-pins and returns to rotation already
+        serving the promoted version."""
+        self._log(f"fleet: promoting {version} fleet-wide")
+        for r in self.replicas:
+            body = {"gate": version}
+            if r.rid == rid:
+                body["pin"] = None
+            self._reload_control(r, body)
+        r = self._replica(rid)
+        if r is not None:
+            r.note_canary(False)
+        with self._lock:
+            self.counts["fleet_promotions"] = (
+                self.counts.get("fleet_promotions", 0) + 1)
+            self.lifecycle.append({
+                "t": self._clock(), "event": "promote",
+                "replica": rid, "reason": version,
+            })
+
+    def abort_canary(self, rid: int, to_version: str | None) -> None:
+        """Pin the canary back to the fleet version (the rollback);
+        the controller calls end_canary once it converged."""
+        r = self._replica(rid)
+        if r is not None and to_version:
+            self._reload_control(r, {"pin": to_version})
+        with self._lock:
+            self.counts["fleet_canary_rollbacks"] = (
+                self.counts.get("fleet_canary_rollbacks", 0) + 1)
+            self.lifecycle.append({
+                "t": self._clock(), "event": "canary_rollback",
+                "replica": rid, "reason": to_version or "",
+            })
+
+    def end_canary(self, rid: int) -> None:
+        """Clear the pin and return the replica to rotation (its gate
+        stays wherever the last promotion left it)."""
+        r = self._replica(rid)
+        if r is None:
+            return
+        self._reload_control(r, {"pin": None})
+        r.note_canary(False)
+        with self._lock:
+            self.lifecycle.append({
+                "t": self._clock(), "event": "canary_end",
+                "replica": rid, "reason": "",
+            })
 
     # ---- fleet SLO hooks (ISSUE 16) ----
 
@@ -567,6 +725,28 @@ class FleetRouter:
                 "reason": (payload or {}).get("reason", ""),
             })
             fr.note_status(int(status))
+        j = self.journal
+        if j is not None and status == 200:
+            # journal the answered request (continual/journal.py): the
+            # wire body is the replay payload, the trace id the join
+            # key a late POST /label lands on. Hedged/retried attempts
+            # shared this trace id, so the journal holds ONE record per
+            # client answer whatever the attempt count was.
+            pred = (payload or {}).get("prediction")
+            try:
+                pred = float(pred[0]) if pred is not None else None
+            except (TypeError, ValueError, IndexError):
+                pred = None
+            wire_payload = {k: body[k] for k in ("graph", "structure")
+                            if k in body}
+            j.note_served(
+                trace_id=meta["trace_id"],
+                payload=wire_payload or None,
+                prediction=pred,
+                param_version=str((payload or {}).get(
+                    "param_version", "")),
+                ts=time.time(),
+            )
         return status, payload, meta
 
     def _dispatch_inner(self, body: dict, *,
@@ -788,6 +968,11 @@ class FleetRouter:
             out["slo"] = self.slo.state()
         if self.tsdb is not None:
             out["tsdb"] = self.tsdb.stats()
+        # continual-learning plane (ISSUE 18)
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
+        if self.canary is not None:
+            out["canary"] = self.canary.stats()
         return out
 
     def _registry_snapshot(self) -> dict:
@@ -839,6 +1024,16 @@ class FleetRouter:
             out["histograms"] = {
                 name: h.snapshot() for name, h in self.hists.items()
             }
+        if self.canary is not None:
+            # per-version shadow-vs-live MAE + shadow latency (ISSUE
+            # 18): param_version-labeled families export.py renders
+            out.setdefault("histograms", {}).update(
+                self.canary.metrics_histograms())
+        if self.journal is not None:
+            js = self.journal.stats()
+            for k in ("served", "joined", "duplicate_joins",
+                      "unmatched_labels"):
+                counters[f"fleet_journal_{k}"] = float(js[k])
         if self.slo is not None:
             gauges.update(self.slo.gauges())
         if self.tsdb is not None:

@@ -298,17 +298,32 @@ class MetricsRegistry:
         # mergeable histogram families (observe/hist.py): cumulative
         # _bucket/le + _sum/_count, bounds and sums rendered at full
         # round-trip precision — the cross-process truth the fleet
-        # merge and the SLO engine consume
-        for name, hsnap in sorted(snap["histograms"].items()):
+        # merge and the SLO engine consume. A provider key may carry a
+        # label set (`name{param_version="..."}`, ISSUE 18): labeled
+        # members group under ONE family declaration, labels riding
+        # every sample — the per-version serve latency families.
+        hist_fams: dict[str, list[tuple[dict | None, dict]]] = {}
+        for key, hsnap in sorted(snap["histograms"].items()):
+            name, labels = key, None
+            if "{" in key:
+                name, _, rest = key.partition("{")
+                labels = _hist.parse_labels("{" + rest)
             full = f"{ns}_{sanitize_metric_name(name)}"
-            try:
-                body = _hist.snapshot_exposition_lines(full, hsnap)
-            except Exception as e:  # noqa: BLE001 — a malformed provider
-                # snapshot must not take down the whole scrape
-                self.last_provider_errors[f"histogram:{name}"] = repr(e)
-                continue
-            lines.append(f"# TYPE {full} histogram")
-            lines.extend(body)
+            hist_fams.setdefault(full, []).append((labels, hsnap))
+        for full, members in sorted(hist_fams.items()):
+            body: list[str] = []
+            ok = True
+            for labels, hsnap in members:
+                try:
+                    body.extend(_hist.snapshot_exposition_lines(
+                        full, hsnap, labels=labels))
+                except Exception as e:  # noqa: BLE001 — a malformed
+                    # provider snapshot must not take down the scrape
+                    self.last_provider_errors[f"histogram:{full}"] = repr(e)
+                    ok = False
+            if body or ok:
+                lines.append(f"# TYPE {full} histogram")
+                lines.extend(body)
         return "\n".join(lines) + "\n"
 
 

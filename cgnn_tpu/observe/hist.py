@@ -89,6 +89,10 @@ def log_bounds(lo: float, hi: float, per_decade: int = 6) -> tuple:
 LATENCY_MS_BOUNDS = log_bounds(0.1, 60_000.0, per_decade=6)
 QUEUE_WAIT_MS_BOUNDS = LATENCY_MS_BOUNDS
 OCCUPANCY_BOUNDS = log_bounds(0.01, 1.0, per_decade=8)
+# absolute prediction error (the shadow-vs-live MAE plane, ISSUE 18):
+# wide because the unit is the task's — eV/atom-scale errors and the
+# deliberately-corrupted regression candidates must both land on-grid
+MAE_BOUNDS = log_bounds(1e-4, 1e4, per_decade=6)
 
 
 class Histogram:

@@ -119,6 +119,12 @@ class FaultPlan:
     boot_crash: int | None = None
     wedge_warm: float | None = None
     exit75_at: int | None = None
+    # continual-learning fault (ISSUE 18): shift the labels of the
+    # N-th fine-tune round (1-based) by a constant offset — the
+    # deterministic way to make the trainer commit a REGRESSING
+    # candidate the canary gate must catch
+    label_noise_round: int | None = None
+    label_noise_scale: float = 10.0
     # mutable hit counters (the determinism bookkeeping)
     _crash_hits: dict = dataclasses.field(default_factory=dict)
     _batches_seen: int = 0
@@ -167,6 +173,11 @@ class FaultPlan:
                 plan.wedge_warm = float(value) if value else 600.0
             elif key == "exit75_at":
                 plan.exit75_at = int(value)
+            elif key == "label_noise":
+                fields = value.split(":")
+                plan.label_noise_round = int(fields[0])
+                if len(fields) > 1 and fields[1]:
+                    plan.label_noise_scale = float(fields[1])
             else:
                 raise ValueError(
                     f"unknown fault key {key!r} in {ENV_VAR}={spec!r}"
@@ -212,6 +223,11 @@ class FaultPlan:
             parts.append(f"wedge warm() ({self.wedge_warm:g} s)")
         if self.exit75_at is not None:
             parts.append(f"preempt (exit 75) @flush {self.exit75_at}")
+        if self.label_noise_round is not None:
+            parts.append(
+                f"label shift +{self.label_noise_scale:g} @fine-tune "
+                f"round {self.label_noise_round}"
+            )
         return ", ".join(parts) or "none"
 
 
@@ -346,6 +362,16 @@ def exit75_requested() -> bool:
     preemption signature the fleet records as a scale event."""
     p = plan()
     return p is not None and p._exit75_fired
+
+
+def label_noise_for_round(round_idx: int) -> float | None:
+    """Label-shift offset for this fine-tune round (continual trainer,
+    ISSUE 18), or None when the round is clean. 1-based."""
+    p = plan()
+    if p is None or p.label_noise_round is None:
+        return None
+    return (p.label_noise_scale if round_idx == p.label_noise_round
+            else None)
 
 
 def dispatch_point() -> None:

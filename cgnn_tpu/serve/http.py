@@ -315,6 +315,54 @@ def make_handler(server: InferenceServer):
                 return
             self._reply(200, {"ok": True, **record})
 
+        def _do_label(self, payload: dict) -> None:
+            # late ground truth -> the label journal's exactly-once
+            # join (continual/journal.py, ISSUE 18). 'already' is a 200:
+            # a retransmitted label is acknowledged, never re-applied.
+            if server.journal is None:
+                self._reply(501, {
+                    "error": "label journal not configured "
+                             "(serve.py --journal)",
+                })
+                return
+            try:
+                label = float(payload["label"])
+            except (KeyError, TypeError, ValueError) as e:
+                self._reply(400, {"error": f"malformed label: {e}"})
+                return
+            trace_id = payload.get("trace_id")
+            fingerprint = payload.get("fingerprint")
+            if trace_id is None and fingerprint is None:
+                self._reply(400, {
+                    "error": "label needs a 'trace_id' or a 'fingerprint'",
+                })
+                return
+            status = server.journal.join(
+                label, trace_id=trace_id, fingerprint=fingerprint)
+            self._reply(200 if status != "unmatched" else 404,
+                        {"status": status})
+
+        def _do_reload_control(self, payload: dict) -> None:
+            # canary plane (ISSUE 18): pin this replica to an exact
+            # version, or raise its auto-swap gate — the promotion
+            # broadcast. Keys absent = untouched; present-null = clear.
+            w = server.watcher
+            if w is None:
+                self._reply(501, {
+                    "error": "no reload watcher attached "
+                             "(serve.py --reload)",
+                })
+                return
+            try:
+                if "pin" in payload:
+                    w.set_pin(payload["pin"])
+                if "gate" in payload:
+                    w.set_gate(payload["gate"])
+            except (TypeError, ValueError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            self._reply(200, w.control())
+
         def do_POST(self):  # noqa: N802
             # serve-side chaos point (resilience/faultinject.py):
             # close the socket without a response — the way a dying
@@ -334,6 +382,12 @@ def make_handler(server: InferenceServer):
                 return
             if self.path == "/profile":
                 self._do_profile(payload)
+                return
+            if self.path == "/label":
+                self._do_label(payload)
+                return
+            if self.path == "/reload-control":
+                self._do_reload_control(payload)
                 return
             if self.path != "/predict":
                 self._reply(404, {"error": f"unknown path {self.path}"})

@@ -134,6 +134,8 @@ class CheckpointWatcher:
         on_swap: Callable | None = None,
         coordinator: Callable | None = None,
         log_fn: Callable | None = None,
+        gate: str | None = None,
+        pin: str | None = None,
     ):
         self._mgr = manager
         self._store = store
@@ -152,8 +154,49 @@ class CheckpointWatcher:
         self._thread: threading.Thread | None = None
         # verified-bad saves: never retried (a corrupt file stays corrupt)
         self._skipped: set[str] = set()
+        # ---- promotion guard (ISSUE 18) ----
+        # Ungated newest_committed() chasing is only safe while the ONLY
+        # committer is the promotion path itself. A continual trainer
+        # committing candidates into the SAME shared directory made that
+        # assumption false: every fleet replica would auto-swap to an
+        # unevaluated candidate, making the rolling-promotion invariant
+        # vacuous. ``gate`` caps what this watcher may auto-swap to (the
+        # newest save it has been TOLD is approved); saves sorting above
+        # it are held until the canary gate raises it. ``pin`` overrides
+        # everything: converge on exactly that committed save (up OR
+        # down — the canary replica evaluating a candidate, and the
+        # rollback path returning it to the fleet version). Both are
+        # mutated from the HTTP control thread while the watcher thread
+        # reads them, hence the dedicated lock.
+        self._ctl_lock = racecheck.make_lock("serve.reloadctl")
+        self._gate = gate
+        self._pin = pin
         self.swaps = 0
         self.skips = 0
+        self.gate_holds = 0
+
+    # ---- promotion-guard control (ISSUE 18) ----
+
+    def set_pin(self, name: str | None) -> None:
+        """Pin to exactly ``name`` (a committed ``ckpt-%08d`` save);
+        None clears the pin and resumes gate/newest behaviour."""
+        with self._ctl_lock:
+            self._pin = name
+
+    def set_gate(self, name: str | None) -> None:
+        """Newest save this watcher may auto-swap to; None = chase
+        ``newest_committed()`` unguarded (the pre-ISSUE-18 behaviour,
+        right only when the trainer IS the promotion path)."""
+        with self._ctl_lock:
+            self._gate = name
+
+    def control(self) -> dict:
+        """The guard state + current version (the /reload-control view)."""
+        with self._ctl_lock:
+            pin, gate = self._pin, self._gate
+            swaps, gate_holds = self.swaps, self.gate_holds
+        return {"pin": pin, "gate": gate, "version": self._store.version,
+                "swaps": swaps, "gate_holds": gate_holds}
 
     # ---- the synchronous unit ----
 
@@ -168,20 +211,50 @@ class CheckpointWatcher:
         directory that never shows the agreed commit marker is a fatal
         desync, and swallowing it would leave the peer hosts blocked at
         the swap barrier — loud beats silently hung."""
-        newest = self._mgr.newest_committed()
-        if self._coordinator is not None:
-            # multi-host: every host polls in lockstep and swaps only to
-            # the save process 0 announced, after the shared barrier —
-            # a reload lands version-consistent on every process
-            newest = self._coordinator(newest)
-        if newest is None or newest == self._store.version:
-            return False
-        if newest in self._skipped:
-            return False
+        with self._ctl_lock:
+            pin, gate = self._pin, self._gate
+        if pin is not None:
+            # exact-version override: the canary path. Downgrades are
+            # deliberate here (rollback returns the canary to the fleet
+            # version); an uncommitted pin just retries next poll — the
+            # candidate may still be mid-commit.
+            if pin == self._store.version or pin in self._skipped:
+                return False
+            if not self._mgr.is_committed(pin):
+                return False
+            target = pin
+        else:
+            newest = self._mgr.newest_committed()
+            if self._coordinator is not None:
+                # multi-host: every host polls in lockstep and swaps only
+                # to the save process 0 announced, after the shared
+                # barrier — a reload lands version-consistent on every
+                # process
+                newest = self._coordinator(newest)
+            if newest is None or newest == self._store.version:
+                return False
+            if newest in self._skipped:
+                return False
+            target = newest
+            if gate is not None and newest > gate:
+                # ungated candidate: hold the line at the gate. If the
+                # gate itself is newer than what we serve, converge on
+                # IT (the fleet-wide promotion broadcast); otherwise
+                # keep serving what we have. ckpt-%08d names compare
+                # lexically, so > is version order.
+                cur = self._store.version
+                if (gate == cur or gate in self._skipped
+                        or (cur.startswith("ckpt-") and gate < cur)
+                        or not self._mgr.is_committed(gate)):
+                    with self._ctl_lock:
+                        self.gate_holds += 1
+                    return False
+                target = gate
         try:
-            state = self._mgr.restore_for_inference(self._template, newest)
+            state = self._mgr.restore_for_inference(self._template, target)
         except Exception as e:  # noqa: BLE001 — skip, keep serving
-            self.skips += 1
+            with self._ctl_lock:
+                self.skips += 1
             if self._coordinator is None:
                 # single-host: a verified-bad save stays bad — never
                 # hot-retried. Under a coordinator the peers already
@@ -190,10 +263,10 @@ class CheckpointWatcher:
                 # next round or this host serves stale params forever
                 # while reporting nothing — the exact divergence the
                 # coordinator exists to prevent.
-                self._skipped.add(newest)
+                self._skipped.add(target)
             report = "; ".join(self._mgr.last_restore_report) or repr(e)
             self._log(
-                f"hot reload: SKIPPING {newest} (integrity/restore "
+                f"hot reload: SKIPPING {target} (integrity/restore "
                 f"failure: {report}); still serving "
                 f"{self._store.version}"
                 + ("" if self._coordinator is None
@@ -203,13 +276,14 @@ class CheckpointWatcher:
                 self._telemetry.counter_add("serve_reload_skipped", 1)
             return False
         old = self._store.version
-        self._store.swap(state, newest)
-        self.swaps += 1
-        self._log(f"hot reload: swapped params {old} -> {newest}")
+        self._store.swap(state, target)
+        with self._ctl_lock:
+            self.swaps += 1
+        self._log(f"hot reload: swapped params {old} -> {target}")
         if self._telemetry is not None:
             self._telemetry.counter_add("serve_reloads", 1)
         if self._on_swap is not None:
-            self._on_swap(newest)
+            self._on_swap(target)
         return True
 
     # ---- the background thread ----

@@ -72,6 +72,7 @@ import itertools
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Sequence
 
 import numpy as np
@@ -376,6 +377,16 @@ class InferenceServer:
         # incident flight recorder (observe/flightrec.py), attached by
         # the entrypoint — None keeps every hook below a no-op
         self.flightrec = None
+        # label journal (continual/journal.py, ISSUE 18), attached by
+        # the entrypoint — None keeps the serving path journal-free
+        self.journal = None
+        # per-version latency histograms (ISSUE 18): bounded map of the
+        # most recent param versions, rendered as
+        # serve_version_latency_ms_hist{param_version="..."} so
+        # /metrics/fleet can merge shadow-vs-live latency per version.
+        # Rides the slo_layer switch like the other histogram families.
+        self._version_hists: "OrderedDict[str, object]" = OrderedDict()
+        self._version_hists_cap = 8
         from cgnn_tpu.observe.export import MetricsRegistry, RollingSeries
 
         # rolling (time-windowed) twins of the run-lifetime SLO series:
@@ -553,16 +564,73 @@ class InferenceServer:
 
     # ---- metrics-truth feeds (ISSUE 16) ----
 
-    def _observe_served(self, latency_ms: float) -> None:
+    def _observe_served(self, latency_ms: float,
+                        version: str | None = None) -> None:
         """One answered request into the mergeable latency histogram +
         the SLO good/bad ledger. Cache hits count: a client got an
         answer either way, and the fleet-merged histogram must describe
-        the same population clients measure."""
+        the same population clients measure. ``version`` additionally
+        lands the sample in that param version's labeled family (ISSUE
+        18) so per-version latency survives the fleet merge."""
         h = self.hists.get("serve_latency_ms_hist")
         if h is not None:
             h.observe(latency_ms)
+            if version is not None:
+                with self._lock:
+                    vh = self._version_hists.get(version)
+                    if vh is None:
+                        from cgnn_tpu.observe.hist import (
+                            LATENCY_MS_BOUNDS,
+                            Histogram,
+                        )
+
+                        vh = self._version_hists[version] = Histogram(
+                            LATENCY_MS_BOUNDS)
+                        while len(self._version_hists) > \
+                                self._version_hists_cap:
+                            self._version_hists.popitem(last=False)
+                vh.observe(latency_ms)
         if self.slo is not None:
             self.slo.record(True, latency_ms)
+
+    def attach_journal(self, journal) -> None:
+        """Wire a continual/journal.LabelJournal into the answer path:
+        every served response appends a replayable record the late
+        ``POST /label`` joins ground truth onto (ISSUE 18)."""
+        self.journal = journal
+
+    def _journal_served(self, *, graph, fingerprint, trace_id, prediction,
+                        version, wire) -> None:
+        """One answered request into the label journal (no-op until one
+        is attached). The payload is the request re-encoded in its wire
+        form, so the continual trainer replays EXACTLY what was served
+        through the same graph_from_json path the HTTP handler uses."""
+        j = self.journal
+        if j is None:
+            return
+        try:
+            pred = float(np.asarray(prediction).reshape(-1)[0])
+        except (TypeError, ValueError):
+            pred = None
+        payload = None
+        if wire == "featurized" and isinstance(graph, CrystalGraph):
+            payload = {"graph": {
+                "atom_fea": np.asarray(graph.atom_fea).tolist(),
+                "edge_fea": np.asarray(graph.edge_fea).tolist(),
+                "centers": np.asarray(graph.centers).tolist(),
+                "neighbors": np.asarray(graph.neighbors).tolist(),
+                "id": graph.cif_id,
+            }}
+        elif isinstance(graph, RawStructure):
+            payload = {"structure": {
+                "frac_coords": np.asarray(graph.frac_coords).tolist(),
+                "lattice": np.asarray(graph.lattice).tolist(),
+                "numbers": np.asarray(graph.numbers).tolist(),
+                "id": graph.cif_id,
+            }}
+        j.note_served(trace_id=trace_id, payload=payload, prediction=pred,
+                      param_version=version, fingerprint=fingerprint,
+                      ts=time.time())
 
     def _record_slo_bad(self) -> None:
         """One failed request (dispatch failure / deadline expiry) into
@@ -697,6 +765,18 @@ class InferenceServer:
             out["histograms"] = {
                 name: h.snapshot() for name, h in self.hists.items()
             }
+            with self._lock:
+                vhists = list(self._version_hists.items())
+            if vhists:
+                # per-param-version latency (ISSUE 18): labeled members
+                # of one family, keyed name{param_version="..."} — the
+                # canary gate's scrapeable shadow-vs-live comparison
+                from cgnn_tpu.observe.hist import format_labels
+
+                for ver, vh in vhists:
+                    key = ("serve_version_latency_ms_hist"
+                           + format_labels({"param_version": str(ver)}))
+                    out["histograms"][key] = vh.snapshot()
         if self.slo is not None:
             gauges.update(self.slo.gauges())
         if self.tsdb is not None:
@@ -739,6 +819,12 @@ class InferenceServer:
         )
         if self._worker is not None and self._worker.is_alive():
             self._watcher.start()
+        return self._watcher
+
+    @property
+    def watcher(self) -> CheckpointWatcher | None:
+        """The attached reload watcher (None before attach_watcher) —
+        the POST /reload-control pin/gate endpoint drives it."""
         return self._watcher
 
     def install_signal_handlers(self):
@@ -980,7 +1066,7 @@ class InferenceServer:
                     # different populations under a warm cache
                     self._record_latency(latency_ms)
                     self._lat_rolling.add(latency_ms)
-                    self._observe_served(latency_ms)
+                    self._observe_served(latency_ms, version=version)
                     self.telemetry.observe_value("serve_latency_ms",
                                                  latency_ms)
                     if self._spans_on:
@@ -994,6 +1080,10 @@ class InferenceServer:
                         param_version=version, precision=tier,
                         wire="raw" if form == "raw" else "featurized",
                         latency_ms=latency_ms)
+                    self._journal_served(
+                        graph=graph, fingerprint=fp, trace_id=tid,
+                        prediction=row, version=version,
+                        wire="raw" if form == "raw" else "featurized")
                     return fut
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
                    else self.default_timeout)
@@ -1363,9 +1453,13 @@ class InferenceServer:
                 trace_id=r.trace_id, status="ok", param_version=version,
                 precision=tier, wire=wire, flush_id=flush.flush_id,
                 device=shard, latency_ms=latency_ms, stamps=stamps)
+            self._journal_served(
+                graph=r.graph, fingerprint=r.fingerprint,
+                trace_id=r.trace_id, prediction=prediction,
+                version=version, wire=wire)
             self._record_latency(latency_ms)
             self._lat_rolling.add(latency_ms)
-            self._observe_served(latency_ms)
+            self._observe_served(latency_ms, version=version)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
             self._count("responses")
             if wire == "raw":
@@ -1622,9 +1716,13 @@ class InferenceServer:
                 trace_id=r.trace_id, status="ok", param_version=version,
                 precision=tier, wire=wire, flush_id=flush.flush_id,
                 device=device, latency_ms=latency_ms, stamps=stamps)
+            self._journal_served(
+                graph=r.graph, fingerprint=r.fingerprint,
+                trace_id=r.trace_id, prediction=row,
+                version=version, wire=wire)
             self._record_latency(latency_ms)
             self._lat_rolling.add(latency_ms)
-            self._observe_served(latency_ms)
+            self._observe_served(latency_ms, version=version)
             # per REQUEST, not per batch: the run-summary quantiles must
             # describe the same distribution stats() does (PERF.md §10)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
@@ -1779,7 +1877,10 @@ class InferenceServer:
             out["cache"] = self.cache.stats()
         if self._watcher is not None:
             out["reload"] = {"swaps": self._watcher.swaps,
-                             "skips": self._watcher.skips}
+                             "skips": self._watcher.skips,
+                             **self._watcher.control()}
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
         # the metrics-truth layer (ISSUE 16): error-budget accounting +
         # alert states, and the embedded time-series store's own health
         if self.slo is not None:

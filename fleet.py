@@ -134,6 +134,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-for-s", type=float, default=0.0,
                    help="burn-rate rule override: hold time before "
                         "pending becomes firing")
+    # ---- closed-loop continual learning (ISSUE 18) ----
+    p.add_argument("--journal", default="", metavar="PATH",
+                   help="label journal JSONL: every answered /predict "
+                        "is journaled and POST /label joins late "
+                        "ground truth by trace id, exactly once — the "
+                        "continual trainer's replay feed ('' disables)")
+    p.add_argument("--canary", action="store_true",
+                   help="canary-gate trainer commits (needs --journal): "
+                        "replicas boot reload-GATED at their boot "
+                        "version, each new committed candidate is "
+                        "pinned to one canary replica, shadow-evaluated "
+                        "on mirrored labeled traffic, and only a "
+                        "passing candidate promotes fleet-wide "
+                        "(rolling, zero downtime); failures roll back "
+                        "with a flight-recorder bundle naming the "
+                        "version")
+    p.add_argument("--canary-mirror", type=float, default=1.0,
+                   help="fraction of labeled live traffic mirrored to "
+                        "the canary (0, 1]")
+    p.add_argument("--canary-min-samples", type=int, default=50,
+                   help="labeled shadow mirrors required for a verdict")
+    p.add_argument("--canary-max-mae-ratio", type=float, default=1.05,
+                   help="promote when shadow/live MAE ratio <= this")
+    p.add_argument("--canary-rollback-mae-ratio", type=float,
+                   default=1.25,
+                   help="roll back when the MAE ratio >= this")
+    p.add_argument("--canary-p99-ms", type=float, default=2000.0,
+                   help="shadow p99 budget; above it the candidate "
+                        "rolls back on latency")
+    p.add_argument("--canary-window", type=float, default=300.0,
+                   help="max seconds a candidate may stay undecided "
+                        "before it rolls back (window_expired)")
     return p
 
 
@@ -159,6 +191,15 @@ def main(argv=None) -> int:
     serve_args = list(args.serve_arg)
     if args.log_json:
         serve_args.append("--log-json")
+    if args.canary and not args.journal:
+        print("fleet: --canary needs --journal (the gate evaluates "
+              "labeled live traffic)", file=sys.stderr)
+        return 2
+    if args.canary:
+        # every replica (boot fleet, autoscaled adds, warm spares)
+        # holds its reload gate at its boot version: trainer commits
+        # are CANDIDATES until the canary controller promotes them
+        serve_args.append("--reload-gated")
     try:
         procs = spawn_fleet(
             args.ckpt_dir, args.replicas,
@@ -273,6 +314,44 @@ def main(argv=None) -> int:
         ).attach(router.flightrec)
         router.remediator = remediator
 
+    # ---- closed-loop continual learning (ISSUE 18) ----
+    journal = None
+    canary_ctl = None
+    if args.journal:
+        from cgnn_tpu.continual import LabelJournal
+
+        journal = LabelJournal(args.journal)
+        router.attach_journal(journal)
+        log(f"fleet: label journal -> {args.journal} (POST /label "
+            "joins ground truth)")
+    if args.canary:
+        from cgnn_tpu.continual import (
+            CanaryController,
+            CanaryGate,
+            GateConfig,
+        )
+        from cgnn_tpu.train import CheckpointManager
+
+        canary_mgr = CheckpointManager(args.ckpt_dir)
+        canary_ctl = CanaryController(
+            gate=CanaryGate(GateConfig(
+                min_samples=args.canary_min_samples,
+                min_baseline=args.canary_min_samples,
+                max_mae_ratio=args.canary_max_mae_ratio,
+                rollback_mae_ratio=args.canary_rollback_mae_ratio,
+                p99_budget_ms=args.canary_p99_ms,
+                max_window_s=args.canary_window,
+            )),
+            journal=journal, fleet=router,
+            newest_fn=canary_mgr.newest_committed,
+            mirror_fraction=args.canary_mirror,
+            flightrec=router.flightrec, log_fn=log,
+        )
+        router.attach_canary(canary_ctl)
+        canary_ctl.start()
+        log("fleet: canary gate armed (replicas reload-gated; trainer "
+            "commits shadow-evaluate before fleet-wide promotion)")
+
     httpd = make_fleet_http_server(router, host=args.host, port=args.port)
     stop = threading.Event()
     handler = PreemptionHandler(
@@ -298,7 +377,12 @@ def main(argv=None) -> int:
         pass
     httpd.shutdown()
     httpd.server_close()
+    if canary_ctl is not None:
+        canary_ctl.stop()
+        canary_mgr.close()
     router.stop()
+    if journal is not None:
+        journal.close()
     if args.trace_out and router.tracer is not None:
         # one joined Perfetto file for the whole run: the router's ring
         # plus every still-reachable replica's /trace window (pulled

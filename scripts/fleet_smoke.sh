@@ -62,6 +62,18 @@
 #     victim unrouted (counted fleet_incidents) and force-reaped past
 #     the drain bound — under continuing load with ZERO lost accepted
 #     requests, and remediation.jsonl must name the justifying bundle.
+#  8. CONTINUAL LOOP LEG (ISSUE 18, --continual): the full closed
+#     loop under live load. Late ground-truth labels POST to the
+#     router's /label and join the durable journal EXACTLY ONCE
+#     (deliberate re-POSTs answer 'already'); a continual.py trainer
+#     subprocess tails the journal and commits two candidates — a
+#     clean round and a round trained on deliberately corrupted
+#     labels (injected label_noise fault). The canary controller pins
+#     one replica per candidate, mirrors labeled traffic to it, and
+#     the gate PROMOTES the clean candidate fleet-wide (every
+#     replica's gated reload watcher rolls it in; fleet converges,
+#     zero drops) then ROLLS BACK the corrupted one, dumping a
+#     flight-recorder bundle that names the regressing version.
 #
 # Runs anywhere jax[cpu] does (synthetic data, CPU device).
 set -euo pipefail
@@ -456,6 +468,49 @@ print("leg 7 ok:", r["answered"], "answered, 0 lost | replica",
       a["replica"], "->", a["replacement"], "|",
       len(entries), "journal entr(y/ies), evidence:",
       os.path.basename(a["bundle"]))
+EOF
+
+echo "== leg 8: labels -> trainer -> canary -> promote + rollback =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 3 --fleet-base-port "$((BASE + 60))" \
+  --fleet-log-dir "$WORK/fleet8-logs" \
+  --clients 8 --duration 45 --continual \
+  --no-scrape \
+  --report "$WORK/fleet_continual.json"
+python - "$WORK/fleet_continual.json" <<'EOF'
+import json, os, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+fl = r["fleet"]
+lb = fl["labels"]; js = lb["journal"]
+# the exactly-once join ledger, over the wire
+assert lb["sent"] >= 1 and lb["joined"] == lb["sent"], lb
+assert lb["unmatched"] == 0 and lb["resend_not_already"] == 0, lb
+assert js["duplicate_joins"] == lb["double_posts"], lb
+assert js["served"] == r["answered"], (js, r["answered"])
+cont = fl["continual"]
+commits = cont["commits"]
+assert len(commits) >= 2, cont
+# the clean candidate promoted fleet-wide, zero drops while it rolled
+assert cont["promoted"] == commits[0], cont
+assert cont["promotion_consistent"], cont
+assert r["param_versions"].get(cont["promoted"], 0) > 0, (
+    r["param_versions"])
+# the corrupted candidate refused: rolled back, bundle NAMES it
+assert cont["rolled_back"] == commits[1], cont
+assert cont["rollback_bundle"], cont
+assert cont["rolled_back"] in os.path.basename(
+    cont["rollback_bundle"]), cont
+man = json.load(open(os.path.join(cont["rollback_bundle"],
+                                  "manifest.json")))
+assert cont["rolled_back"] in man["reason"], man
+assert cont["trainer_exit"] in (0, 75), cont
+print("leg 8 ok:", r["answered"], "answered |", lb["sent"],
+      "labels joined exactly once (", lb["double_posts"],
+      "re-POSTs all 'already' ) | candidates", commits, "|",
+      cont["promoted"], "promoted fleet-wide,", cont["rolled_back"],
+      "rolled back (", cont.get("rollback_reason"), ") | bundle:",
+      os.path.basename(cont["rollback_bundle"]))
 EOF
 
 echo "fleet smoke: ALL LEGS PASSED"

@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -89,6 +90,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "'slow_dispatch=150' for the hedging leg or "
                         "'dispatch_exc=5' for the 500-retry leg")
     p.add_argument("--faulty-replica", type=int, default=2)
+    # ---- closed-loop continual learning (ISSUE 18) ----
+    p.add_argument("--label-feedback", type=float, default=0.0,
+                   metavar="P",
+                   help="fleet mode (ISSUE 18): POST late ground-truth "
+                        "labels for this fraction of answered requests "
+                        "through the router's /label wire surface "
+                        "(--label-delay-ms behind each answer). The "
+                        "exactly-once join ledger is hard-asserted: "
+                        "every label joins its served record, "
+                        "deliberate re-POSTs answer 'already', nothing "
+                        "goes unmatched")
+    p.add_argument("--label-delay-ms", type=float, default=250.0,
+                   help="how far behind each answer its label arrives")
+    p.add_argument("--continual", action="store_true",
+                   help="fleet mode (ISSUE 18): close the loop — a "
+                        "continual.py trainer subprocess tails the "
+                        "durable label journal and commits candidate "
+                        "checkpoints (round 2 deliberately corrupted "
+                        "by a label_noise fault); the canary "
+                        "controller pins one replica per candidate, "
+                        "shadow-evaluates it on mirrored labeled "
+                        "traffic, promotes the good candidate "
+                        "fleet-wide through the gated reload watchers "
+                        "and rolls the bad one back with a "
+                        "flight-recorder bundle naming it. Implies "
+                        "--label-feedback 1.0 unless set; all of it "
+                        "hard-asserted")
     # ---- the self-driving fleet (ISSUE 17) ----
     p.add_argument("--ramp", default="", metavar="LOW:PEAK",
                    help="fleet mode (ISSUE 17): open-loop fleet-total "
@@ -945,6 +973,11 @@ def _run_fleet(args) -> dict:
         # before the process exits — what classifies the disappearance
         # as a scale event instead of an incident
         serve_args += ["--drain-linger", "1.5"]
+    if args.continual:
+        # candidates must NOT auto-roll into replicas: every watcher
+        # holds at its boot version until the canary gate's promotion
+        # broadcast raises the reload gate (serve/reload.py)
+        serve_args += ["--reload-gated"]
     procs = []
     for i in range(n):
         env = dict(os.environ)
@@ -1018,6 +1051,36 @@ def _run_fleet(args) -> dict:
         )
         router.attach_flight_recorder(recorder)
 
+    # ---- the label journal + /label wire surface (ISSUE 18) ----
+    journal = None
+    journal_path = ""
+    label_httpd = None
+    label_url = ""
+    label_feedback = args.label_feedback
+    if args.continual and label_feedback <= 0.0:
+        label_feedback = 1.0  # the loop trains on labels; feed them all
+    if label_feedback > 0.0:
+        from cgnn_tpu.continual import LabelJournal
+        from cgnn_tpu.fleet.http import make_fleet_http_server
+
+        journal_path = os.path.join(
+            os.path.dirname(os.path.abspath(args.report)) or ".",
+            "labels.jsonl")
+        for stale in (journal_path, journal_path + ".1"):
+            if os.path.exists(stale):
+                os.remove(stale)
+        # durable only when a trainer tails it cross-process
+        journal = LabelJournal(journal_path if args.continual else None,
+                               capacity=65536)
+        router.attach_journal(journal)
+        # labels arrive over the SAME wire surface operators use:
+        # POST /label against the router's HTTP front-end
+        label_port = args.fleet_base_port + 99
+        label_httpd = make_fleet_http_server(router, port=label_port)
+        threading.Thread(target=label_httpd.serve_forever, daemon=True,
+                         name="loadgen-fleet-http").start()
+        label_url = f"http://127.0.0.1:{label_port}/label"
+
     # ---- the self-driving layer (ISSUE 17) ----
     autoscaler = None
     remediator = None
@@ -1088,6 +1151,10 @@ def _run_fleet(args) -> dict:
         "neighbors": g.neighbors.tolist(),
         "id": g.cif_id,
     }} for g in pool]
+    # ground truth per body, for the late-label feed: the synthetic
+    # pool's real targets, so the continual trainer fine-tunes on a
+    # signal that actually exists
+    truths = [float(np.asarray(g.target).reshape(-1)[0]) for g in pool]
 
     stats = _ClientStats()
     stop = threading.Event()
@@ -1095,6 +1162,14 @@ def _run_fleet(args) -> dict:
     # them (the router's own stats ride the report separately)
     fleet_counts = {"attempts_hist": {}, "hedged_answers": 0,
                     "retried_answers": 0}
+    # (due_time, trace_id, truth) entries awaiting their POST /label
+    from collections import deque
+
+    label_lock = threading.Lock()
+    label_q: deque = deque()
+    label_log: dict = {"sent": 0, "joined": 0, "already": 0,
+                       "unmatched": 0, "double_posts": 0,
+                       "resend_not_already": 0, "post_errors": []}
 
     # open-loop rate ramp (ISSUE 17): fleet-total rps as a function of
     # elapsed fraction — hold LOW, climb to PEAK by mid-duration, hold,
@@ -1125,7 +1200,8 @@ def _run_fleet(args) -> dict:
                                                           1e-9)
                 rate = _ramp_rate(min(frac, 1.0))
                 t_pace = time.monotonic() + args.clients / max(rate, 0.1)
-            body = bodies[int(rng.integers(len(bodies)))]
+            bi = int(rng.integers(len(bodies)))
+            body = bodies[bi]
             with stats.lock:
                 stats.submitted += 1
             try:
@@ -1165,6 +1241,14 @@ def _run_fleet(args) -> dict:
                     reason = (payload or {}).get("reason", str(status))
                     stats.rejected[reason] = (
                         stats.rejected.get(reason, 0) + 1)
+            if (journal is not None and status == 200
+                    and rng.random() < label_feedback):
+                # ground truth "arrives" label_delay_ms later — the
+                # labeler thread POSTs it to /label then
+                with label_lock:
+                    label_q.append((
+                        time.monotonic() + args.label_delay_ms / 1e3,
+                        meta_d["trace_id"], truths[bi]))
 
     threads = [threading.Thread(target=client, args=(i,), daemon=True,
                                 name=f"loadgen-fleet-client-{i}")
@@ -1172,6 +1256,195 @@ def _run_fleet(args) -> dict:
     t_start = time.monotonic()
     for t in threads:
         t.start()
+
+    # ---- the late-label feed (ISSUE 18) ----
+    labeler_threads: list = []
+    if journal is not None:
+        import urllib.request
+        from urllib.error import HTTPError, URLError
+
+        def _post_label(tid: str, y: float) -> str:
+            data = json.dumps({"trace_id": tid, "label": y},
+                              allow_nan=False).encode()
+            req = urllib.request.Request(
+                label_url, data=data, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    return json.loads(resp.read()).get("status", "?")
+            except HTTPError as e:
+                # 404 still carries {"status": "unmatched"}
+                try:
+                    return json.loads(e.read()).get("status", "?")
+                except ValueError:
+                    return f"http_{e.code}"
+
+        # a POOL of labelers: each POST costs a fresh TCP connection
+        # (~ms), so a single thread falls minutes behind a busy fleet
+        # and labels would join long after their version's canary
+        # window — staling the gate's live baseline
+        def labeler():
+            while True:
+                entry = None
+                with label_lock:
+                    # once the run is stopping, flush without the delay
+                    # so the exactly-once ledger closes complete
+                    if label_q and (label_q[0][0] <= time.monotonic()
+                                    or stop.is_set()):
+                        entry = label_q.popleft()
+                    drained = not label_q
+                if entry is None:
+                    if stop.is_set() and drained:
+                        return
+                    time.sleep(0.005)
+                    continue
+                _due, tid, y = entry
+                try:
+                    status = _post_label(tid, y)
+                except (URLError, OSError) as e:
+                    with label_lock:
+                        label_log["post_errors"].append(repr(e))
+                    continue
+                with label_lock:
+                    label_log["sent"] += 1
+                    label_log[status] = label_log.get(status, 0) + 1
+                    resend = label_log["sent"] % 7 == 0
+                    if resend:
+                        label_log["double_posts"] += 1
+                if not resend:
+                    continue
+                # deliberately retransmit this label: exactly-once
+                # means the journal answers 'already' and the stored
+                # value stays untouched
+                try:
+                    again = _post_label(tid, y)
+                except (URLError, OSError) as e:
+                    with label_lock:
+                        label_log["post_errors"].append(repr(e))
+                    continue
+                if again != "already":
+                    with label_lock:
+                        label_log["resend_not_already"] += 1
+
+        labeler_threads = [
+            threading.Thread(target=labeler, daemon=True,
+                             name=f"loadgen-fleet-labeler-{i}")
+            for i in range(6)]
+        for t in labeler_threads:
+            t.start()
+
+    # ---- the closed loop (ISSUE 18): trainer + canary gate ----
+    continual_done = threading.Event()
+    continual_log: dict = {}
+    canary_ctl = None
+    canary_mgr = None
+    cont_proc = None
+    cont_log_path = ""
+    if args.continual:
+        from cgnn_tpu.continual import (
+            CanaryController,
+            CanaryGate,
+            GateConfig,
+        )
+
+        canary_mgr = CheckpointManager(args.ckpt_dir)
+        base_version = canary_mgr.newest_committed()
+        # smoke-scale gate: loose MAE ratios (tiny fine-tunes on the
+        # synthetic pool are noisy, while the injected round-2 label
+        # corruption blows far past 4x) and short windows so both
+        # verdicts land inside one leg
+        canary_ctl = CanaryController(
+            gate=CanaryGate(GateConfig(
+                min_samples=20, min_baseline=20,
+                max_mae_ratio=2.0, rollback_mae_ratio=4.0,
+                p99_budget_ms=float(args.timeout_ms),
+                min_window_s=1.0, max_window_s=120.0)),
+            journal=journal, fleet=router,
+            newest_fn=canary_mgr.newest_committed,
+            flightrec=recorder,
+            tick_interval_s=0.25,
+            shadow_timeout_s=args.timeout_ms / 1e3,
+            log_fn=print,
+        )
+        router.attach_canary(canary_ctl)
+        canary_ctl.start()
+        cont_log_path = os.path.join(log_dir, "continual.log")
+        cont_env = dict(os.environ)
+        cont_env["JAX_PLATFORMS"] = "cpu"
+        # round 2 trains on deliberately corrupted labels: the
+        # regressing candidate the canary gate MUST refuse
+        cont_env["CGNN_TPU_FAULTS"] = "label_noise=2:10.0"
+        with open(cont_log_path, "w") as cont_log_fh:
+            cont_proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.dirname(
+                     os.path.abspath(__file__))), "continual.py"),
+                 args.ckpt_dir, "--journal", journal_path,
+                 "--min-new-labels", "48",
+                 # round 2 must wait for candidate 1's verdict: the
+                 # controller evaluates ONE candidate at a time and
+                 # only ever picks the newest commit
+                 "--min-interval", "45",
+                 "--epochs-per-round", "2",
+                 "--batch-size", "16",
+                 "--max-rounds", "2",
+                 "--poll-interval", "0.5",
+                 "--device", "cpu",
+                 "--seed", str(args.seed)],
+                stdout=cont_log_fh, stderr=subprocess.STDOUT,
+                env=cont_env)
+
+        def continual_watch():
+            commits: list = []
+            deadline = time.monotonic() + 600.0
+            try:
+                while time.monotonic() < deadline:
+                    newest = canary_mgr.newest_committed()
+                    if (newest and newest != base_version
+                            and newest not in commits):
+                        commits.append(newest)
+                        continual_log.setdefault(
+                            "commit_times_s", []).append(
+                            round(time.monotonic() - t_start, 2))
+                    ev = canary_ctl.stats()["events"]
+                    promoted = [e for e in ev
+                                if e["kind"] == "promoted"]
+                    rolled = [e for e in ev
+                              if e["kind"] == "rolled_back"]
+                    returned = [e for e in ev
+                                if e["kind"] == "canary_returned"]
+                    if promoted and "promoted" not in continual_log:
+                        continual_log["promoted"] = (
+                            promoted[0]["version"])
+                        continual_log["promoted_at_s"] = round(
+                            time.monotonic() - t_start, 2)
+                    if rolled and returned and len(commits) >= 2:
+                        continual_log["rolled_back"] = (
+                            rolled[0]["version"])
+                        continual_log["rollback_reason"] = (
+                            rolled[0].get("reason", ""))
+                        break
+                    time.sleep(0.5)
+                # promotion must CONVERGE: every routed replica's
+                # gated watcher rolls onto the promoted version
+                if "promoted" in continual_log:
+                    pv = continual_log["promoted"]
+                    conv_deadline = time.monotonic() + 90.0
+                    consistent = False
+                    while time.monotonic() < conv_deadline:
+                        if set(router.versions().values()) == {pv}:
+                            consistent = True
+                            break
+                        time.sleep(0.25)
+                    continual_log["promotion_consistent"] = consistent
+            finally:
+                continual_log["commits"] = commits
+                continual_done.set()
+
+        threading.Thread(target=continual_watch, daemon=True,
+                         name="loadgen-continual-watch").start()
+    else:
+        continual_done.set()
 
     # ---- the chaos timeline, alongside the load ----
     chaos_done = threading.Event()
@@ -1330,11 +1603,14 @@ def _run_fleet(args) -> dict:
 
     # run until the duration elapsed AND the chaos legs finished (a
     # restart's boot may outlast a short duration — the victim must
-    # still get post-restart traffic before the clients stop)
+    # still get post-restart traffic before the clients stop). The
+    # continual loop also holds the load open: the canary needs live
+    # labeled traffic flowing while candidates evaluate
     while True:
         elapsed = time.monotonic() - t_start
         if (elapsed >= args.duration and chaos_done.is_set()
-                and promote_done.is_set()):
+                and promote_done.is_set()
+                and continual_done.is_set()):
             break
         time.sleep(0.1)
     if chaos_log.get("restart_ready"):
@@ -1345,6 +1621,10 @@ def _run_fleet(args) -> dict:
         t.join(timeout=args.timeout_ms / 1000.0 + 60.0)
     for t in side:
         t.join(timeout=120.0)
+    for t in labeler_threads:
+        # drains the queued labels (the pop bypasses the delay once
+        # stop is set) so the exactly-once ledger closes complete
+        t.join(timeout=60.0)
     if scraper.is_alive():
         scraper.join(timeout=30.0)
     wall = time.monotonic() - t_start
@@ -1355,6 +1635,17 @@ def _run_fleet(args) -> dict:
         remediator.stop()
     if autoscaler is not None:
         autoscaler.stop()
+    if canary_ctl is not None:
+        canary_ctl.stop()
+    if cont_proc is not None:
+        if cont_proc.poll() is None:
+            cont_proc.terminate()
+        try:
+            cont_proc.wait(timeout=120.0)
+        except subprocess.TimeoutExpired:
+            cont_proc.kill()
+            cont_proc.wait(timeout=30.0)
+        continual_log["trainer_exit"] = cont_proc.returncode
     slo_report: dict = {}
     if slo_thread is not None:
         # the resolve leg may land AFTER the load ends (the router's
@@ -1371,6 +1662,9 @@ def _run_fleet(args) -> dict:
             slo_report["flightrec"] = recorder.stats()
             slo_report["slo_bundles"] = _slo_bundle_manifests(
                 flightrec_dir)
+    if label_httpd is not None:
+        label_httpd.shutdown()
+        label_httpd.server_close()
     router.stop()
     router_stats = router.stats()
     if chaos_log.get("restart_ready"):
@@ -1517,6 +1811,36 @@ def _run_fleet(args) -> dict:
             os.path.dirname(os.path.abspath(args.report)) or ".",
             "remediation.jsonl")
         report["fleet"]["remediation"] = rem_stats
+    if journal is not None:
+        labels_report = {k: v for k, v in label_log.items()
+                         if k != "post_errors"}
+        labels_report["post_errors"] = label_log["post_errors"][:10]
+        labels_report["journal"] = journal.stats()
+        labels_report["journal_path"] = (journal_path
+                                         if args.continual else "")
+        report["fleet"]["labels"] = labels_report
+        journal.close()
+    if args.continual:
+        if recorder is not None:
+            recorder.wait_idle(timeout_s=60.0)
+        rb = continual_log.get("rolled_back", "")
+        bundles = []
+        if rb:
+            import glob
+
+            bundles = sorted(glob.glob(os.path.join(
+                flightrec_dir, f"bundle-*canary_rollback_{rb}")))
+        continual_log["rollback_bundle"] = bundles[-1] if bundles else ""
+        cstats = canary_ctl.stats()
+        report["fleet"]["continual"] = {
+            **continual_log,
+            "events": cstats["events"],
+            "rejected": cstats["rejected"],
+            "shadow_sent": cstats["shadow_sent"],
+            "shadow_errors": cstats["shadow_errors"],
+            "trainer_log": cont_log_path,
+        }
+        canary_mgr.close()
     return report
 
 
@@ -1770,6 +2094,14 @@ def main(argv=None) -> int:
         print("CKPT_DIR (or --http URL / --make-ckpt DIR) required",
               file=sys.stderr)
         return 2
+    if (args.continual or args.label_feedback > 0) and not args.fleet:
+        print("--continual / --label-feedback need --fleet N",
+              file=sys.stderr)
+        return 2
+    if args.continual and not args.trace_ring:
+        print("--continual needs the flight recorder (--trace-ring > 0)",
+              file=sys.stderr)
+        return 2
 
     if args.fleet:
         report = _run_fleet(args)
@@ -1990,6 +2322,89 @@ def main(argv=None) -> int:
                 "expected hedged requests (--expect-hedges) but none "
                 "fired"
             )
+        if args.label_feedback > 0 or args.continual:
+            # ---- the exactly-once label-join ledger (ISSUE 18) ----
+            lb = fl.get("labels", {})
+            js = lb.get("journal", {})
+            if lb.get("post_errors"):
+                failures.append(
+                    f"label POSTs errored: {lb['post_errors']}")
+            if not lb.get("sent"):
+                failures.append(
+                    "label feedback requested but no label was ever "
+                    "POSTed")
+            if lb.get("joined") != lb.get("sent"):
+                failures.append(
+                    f"label joins incomplete: {lb.get('joined')} "
+                    f"joined of {lb.get('sent')} sent (every first "
+                    f"POST must land exactly once)")
+            if lb.get("unmatched"):
+                failures.append(
+                    f"{lb['unmatched']} labels joined NOTHING (every "
+                    f"label targets a journaled answer)")
+            if lb.get("resend_not_already"):
+                failures.append(
+                    f"{lb['resend_not_already']} deliberate label "
+                    f"re-POSTs did NOT answer 'already' — the "
+                    f"exactly-once join is broken")
+            if js.get("duplicate_joins") != lb.get("double_posts"):
+                failures.append(
+                    f"journal duplicate_joins "
+                    f"{js.get('duplicate_joins')} != deliberate "
+                    f"re-POSTs {lb.get('double_posts')} (a duplicate "
+                    f"apply slipped through, or one was double-counted)"
+                )
+            if js.get("served") != report["answered"]:
+                failures.append(
+                    f"journal holds {js.get('served')} served records "
+                    f"for {report['answered']} answered requests "
+                    f"(exactly one record per answer — hedged and "
+                    f"retried attempts share the trace id)")
+        if args.continual:
+            # ---- the closed continual loop (ISSUE 18), all HARD ----
+            cont = fl.get("continual", {})
+            commits = cont.get("commits", [])
+            if len(commits) < 2:
+                failures.append(
+                    f"continual trainer committed {len(commits)} "
+                    f"candidate(s); the leg needs its clean round AND "
+                    f"its corrupted one (trainer log: "
+                    f"{cont.get('trainer_log')})")
+            if not cont.get("promoted"):
+                failures.append(
+                    "no candidate was ever promoted fleet-wide")
+            else:
+                if commits and cont["promoted"] != commits[0]:
+                    failures.append(
+                        f"promoted {cont['promoted']} but the first "
+                        f"(clean) candidate was {commits[0]}")
+                if not cont.get("promotion_consistent"):
+                    failures.append(
+                        f"fleet never converged on the promoted "
+                        f"candidate {cont['promoted']}")
+                if not report["param_versions"].get(cont["promoted"]):
+                    failures.append(
+                        f"promoted candidate {cont['promoted']} never "
+                        f"answered live traffic: "
+                        f"{report['param_versions']}")
+            if not cont.get("rolled_back"):
+                failures.append(
+                    "the corrupted candidate was never rolled back")
+            else:
+                if (len(commits) >= 2
+                        and cont["rolled_back"] != commits[1]):
+                    failures.append(
+                        f"rolled back {cont['rolled_back']} but the "
+                        f"corrupted candidate was {commits[1]}")
+                if not cont.get("rollback_bundle"):
+                    failures.append(
+                        f"rollback of {cont['rolled_back']} dumped no "
+                        f"flight-recorder bundle naming it")
+            if cont.get("trainer_exit") not in (0, 75):
+                failures.append(
+                    f"continual trainer exited "
+                    f"{cont.get('trainer_exit')} (log: "
+                    f"{cont.get('trainer_log')})")
         # exits 0 (drained) and 75 (resumable preemption, PR 2) are
         # both clean; a remediated victim was force-reaped on purpose
         remediated = {a.get("replica") for a in

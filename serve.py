@@ -149,6 +149,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-for-s", type=float, default=0.0,
                    help="burn-rate rule override: hold time before "
                         "pending becomes firing")
+    p.add_argument("--journal", type=str, default="",
+                   help="label journal JSONL path (ISSUE 18): every "
+                        "served response is journaled and POST /label "
+                        "joins late ground truth by trace id or "
+                        "fingerprint, exactly once")
+    p.add_argument("--reload-gated", action="store_true",
+                   help="hold the reload watcher's auto-swap at the "
+                        "boot version (continual/canary plane): newer "
+                        "checkpoints are CANDIDATES until a POST "
+                        "/reload-control raises the gate")
     return p
 
 
@@ -271,6 +281,22 @@ def main(argv=None) -> int:
         )
         server.attach_flight_recorder(recorder)
 
+    # continual-learning plane (ISSUE 18): the label journal joins
+    # late ground truth onto served responses; --reload-gated turns
+    # newer checkpoints into held CANDIDATES until the canary
+    # controller's promotion broadcast raises the gate
+    journal = None
+    if args.journal:
+        from cgnn_tpu.continual import LabelJournal
+
+        journal = LabelJournal(args.journal)
+        server.attach_journal(journal)
+    if args.reload_gated and server.watcher is not None:
+        server.watcher.set_gate(server.param_store.version)
+        log(f"reload gate held at boot version "
+            f"{server.param_store.version} (POST /reload-control to "
+            "promote)")
+
     # the live plane's two push/pull surfaces beyond HTTP: SIGUSR2 ->
     # bounded on-demand device profile; --live-metrics -> periodic
     # registry snapshots for fleets scraped by file instead of port
@@ -363,6 +389,8 @@ def main(argv=None) -> int:
     handler.uninstall()
     if live_writer is not None:
         live_writer.stop()
+    if journal is not None:
+        journal.close()
     stats = server.stats()
     lat = stats["latency_ms"]
     if lat:
