@@ -232,7 +232,7 @@ def _bench_force_workload(graphs, batch_size, *, dense_m=None, n_timed=16,
 # artifact reports PAIRED per-round ratios, which is what kills the
 # bench-link noise that muddied the r3->r5 trajectory.
 AB_FLAGS = ("cgconv", "fused-epilogue", "transpose", "compact", "precision",
-            "engine", "wire", "observe", "slo")
+            "engine", "wire", "observe", "slo", "backfill")
 
 
 def _ab_train_variants(flag: str, graphs, batch_size, buckets):
@@ -351,6 +351,8 @@ def _run_ab(flag: str, *, n: int, batch_size: int, buckets: int,
         return _run_ab_observe(graphs, batch_size, rounds)
     if flag == "slo":
         return _run_ab_slo(graphs, batch_size, rounds)
+    if flag == "backfill":
+        return _run_ab_backfill(graphs, batch_size, rounds)
     variants = _ab_train_variants(flag, graphs, batch_size, buckets)
 
     def set_transpose(v):
@@ -762,6 +764,127 @@ def _run_ab_slo(graphs, batch_size, rounds) -> dict:
         "median_p99_ms": {n: round(float(np.median(v)), 3)
                           for n, v in p99s.items() if v},
         "slo_on_hist_count": hist_count,
+        "device": str(jax.devices()[0].device_kind),
+    })
+
+
+def _run_ab_backfill(graphs, batch_size, rounds) -> dict:
+    """Serving-path A/B of padding-slack backfill (ISSUE 19): the
+    priority batcher with backfill ON vs OFF, e2e goodput through the
+    in-process InferenceServer — the same interleaved same-process
+    protocol as the observe/slo A/Bs (§6b/§8). The workload is the
+    regime backfill exists for: a closed-loop interactive trickle keeps
+    the head class pending (so its small flushes fire on the 10 ms wait
+    budget, mostly padding), while a fixed scavenger backlog drains
+    however the policy lets it. OFF, that backlog moves only through
+    16x-aged scavenger flushes squeezed between interactive cuts; ON,
+    it rides the interactive flushes' padded slots. Per round the clock
+    runs until the WHOLE backlog is answered, so structs_per_sec is
+    aggregate goodput for identical work, and the interactive p99 is
+    recorded to show the head class paid nothing for it (backfill never
+    delays or reshapes a head flush)."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.serve.server import InferenceServer
+    from cgnn_tpu.serve.shapes import plan_shape_set
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.step import make_predict_step
+
+    batch_size = min(batch_size, 64)
+    model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
+                                dense_m=12)
+    ladder = plan_shape_set(graphs, batch_size, rungs=3, dense_m=12)
+    state = create_train_state(
+        model, ladder.pack_full([graphs[0]]),
+        make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10**9]),
+        Normalizer.fit(np.stack([np.array(g.target) for g in graphs])),
+    )
+    pstep = jax.jit(make_predict_step())
+    pool = [g for g in graphs if ladder.admits(g)][:512]
+
+    def build(on: bool) -> InferenceServer:
+        server = InferenceServer(
+            state, ladder, predict_step=pstep, cache_size=0,
+            max_queue=8192, pack_workers=0, trace_ring=0,
+            max_wait_ms=10.0, backfill=on,
+            log_fn=lambda *a, **k: None,
+        )
+        server.warm(pool[0])
+        server.start()
+        return server
+
+    servers = {"no-backfill": build(False), "backfill": build(True)}
+    n_scav, n_threads = 384, 4
+
+    def drive(server: InferenceServer):
+        futs = [server.submit(pool[(7 * i) % len(pool)],
+                              timeout_ms=600000.0, klass="scavenger")
+                for i in range(n_scav)]
+        stop = threading.Event()
+        lat: list = []
+        lock = threading.Lock()
+
+        def client(ci: int) -> None:
+            vals = []
+            while not stop.is_set():
+                g = pool[(ci * 997 + len(vals)) % len(pool)]
+                res = server.submit(
+                    g, timeout_ms=600000.0,
+                    klass="interactive").result(timeout=600.0)
+                vals.append(res.latency_ms)
+            with lock:
+                lat.extend(vals)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"ab-backfill-client-{i}")
+                   for i in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for f in futs:
+            f.result(timeout=600.0)
+        dt = time.perf_counter() - t0  # backlog-drained fence
+        stop.set()
+        for t in threads:
+            t.join()
+        return ((n_scav + len(lat)) / dt,
+                float(np.percentile(np.asarray(lat), 99)))
+
+    names = list(servers)
+    rows: list = []
+    p99s: dict = {n: [] for n in names}
+    for r in range(-1, rounds):  # round -1 = discarded burn-in
+        order = names[r % len(names):] + names[: r % len(names)]
+        for name in order:
+            rate, p99 = drive(servers[name])
+            if r >= 0:
+                rows.append({"round": r, "variant": name,
+                             "structs_per_sec": round(rate, 1),
+                             "interactive_p99_ms": round(p99, 3)})
+                p99s[name].append(p99)
+    stats_on = servers["backfill"].stats()
+    stats_off = servers["no-backfill"].stats()
+    for s in servers.values():
+        s.drain(timeout_s=60.0)
+    return _ab_report("backfill", names, rows, extra={
+        "workload": f"open scavenger backlog of {n_scav} under a "
+                    f"{n_threads}-thread closed-loop interactive "
+                    f"trickle, in-process InferenceServer "
+                    f"batch={batch_size} max_wait=10ms; per-round clock "
+                    f"stops when the whole backlog is answered",
+        "median_interactive_p99_ms": {
+            n: round(float(np.median(v)), 3) for n, v in p99s.items() if v},
+        "serve_padding_fill_share": stats_on["priority"][
+            "padding_fill_share"],
+        "backfilled_responses": stats_on["priority"][
+            "backfilled_responses"],
+        "recompiles_after_warm": {
+            "backfill": stats_on["recompiles_after_warm"],
+            "no-backfill": stats_off["recompiles_after_warm"]},
         "device": str(jax.devices()[0].device_kind),
     })
 

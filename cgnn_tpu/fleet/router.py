@@ -91,6 +91,8 @@ class FleetRouter:
         jitter: float = 0.5,
         hedge_ms: float | None = None,
         default_timeout_ms: float = 30000.0,
+        feasibility: bool = True,
+        feasibility_margin: float = 1.0,
         health_interval_s: float = 1.0,
         trace_ring: int = 65536,
         slo_layer: bool = True,
@@ -122,6 +124,18 @@ class FleetRouter:
         # floored); <= 0 disables hedging entirely
         self.hedge_ms = hedge_ms
         self.default_timeout_ms = float(default_timeout_ms)
+        # deadline-feasibility admission (ISSUE 19): when on, a request
+        # whose deadline cannot plausibly be met — judged from the
+        # scraped per-replica rolling p99 + queue depth the health
+        # prober already holds (ISSUE 16) — is rejected BEFORE any
+        # attempt crosses a process boundary. ``feasibility_margin``
+        # scales the estimate (2.0 = only shed when the predicted
+        # completion exceeds twice the deadline; headroom for noisy p99)
+        self.feasibility = bool(feasibility)
+        if feasibility_margin <= 0:
+            raise ValueError(
+                f"feasibility_margin must be > 0, got {feasibility_margin}")
+        self.feasibility_margin = float(feasibility_margin)
         self.health_interval_s = float(health_interval_s)
         self._clock = clock
         self._rng = rng or random.Random(0x5EED)
@@ -135,6 +149,10 @@ class FleetRouter:
             "fleet_exhausted": 0, "fleet_deadline_exceeded": 0,
             "fleet_transport_errors": 0, "fleet_passthrough_rejects": 0,
             "fleet_duplicate_answers": 0,
+            # ISSUE 19: deadline-feasibility sheds, split by cause —
+            # queue congestion (retry helps) vs a p99 floor above the
+            # deadline (retry cannot help; ask for a longer deadline)
+            "fleet_infeasible_queue": 0, "fleet_infeasible_deadline": 0,
             # ISSUE 17: the capacity ledger. A planned disappearance
             # (drained scale-down, exit-75 preemption) is a SCALE
             # EVENT; an unplanned one (kill -9, crash) an INCIDENT
@@ -605,14 +623,52 @@ class FleetRouter:
         p99 = r.local_p99_ms() if r is not None else 0.0
         return max(0.1, 2.0 * p99 / 1e3)
 
+    def _feasibility_ms(self) -> tuple[float | None, float | None]:
+        """(predicted completion ms, p99 floor ms) on the BEST
+        admittable replica, from the health prober's scraped signals
+        (ISSUE 16): floor = the replica's rolling p99 alone (even an
+        idle replica takes about that long); predicted adds queue
+        pressure — each queued/in-flight request is assumed to ride a
+        batch of ~8, so depth adds depth/8 p99-units of wait. A
+        deliberately conservative model: it only has to separate
+        "plausible" from "cannot happen", not predict latency.
+
+        (None, None) when any admittable replica lacks a p99 sample
+        yet — feasibility is an optimisation on a warmed-up fleet, not
+        a gate that sheds traffic off a cold start."""
+        best_est = best_floor = None
+        for r in self.replicas:
+            if not r.pickable():
+                continue
+            s = r.stats()
+            p99 = float(s["scraped_p99_ms"])
+            if p99 <= 0:
+                return None, None
+            depth = float(s["queue_depth"]) + float(s["inflight"])
+            est = p99 * (1.0 + depth / 8.0)
+            if best_est is None or est < best_est:
+                best_est = est
+            if best_floor is None or p99 < best_floor:
+                best_floor = p99
+        return best_est, best_floor
+
     def _retry_after_s(self) -> float:
-        """The Retry-After hint when shedding: the soonest any breaker
-        could re-admit (bounded 1..30 s; 5 s when nothing is ejected —
-        i.e. everything is draining/unready and only time will tell)."""
+        """The Retry-After hint when shedding: the LARGER of the
+        soonest any breaker could re-admit and the queue-depth/p99
+        drain estimate (bounded 1..30 s; 5 s when neither signal
+        exists). The congestion term is the PR-12 bugfix: breaker
+        cooldowns alone under-hint on a fleet that is admittable but
+        saturated — shed clients came straight back into the same
+        queue instead of backing off proportionally to the congestion
+        actually measured."""
         waits = [b for b in
                  (r.breaker.retry_after_s() for r in self.replicas)
                  if b > 0]
-        return min(max(min(waits) if waits else 5.0, 1.0), 30.0)
+        breaker_s = min(waits) if waits else 0.0
+        est_ms, _ = self._feasibility_ms()
+        congestion_s = (est_ms or 0.0) / 1e3
+        base = max(breaker_s, congestion_s) or 5.0
+        return min(max(base, 1.0), 30.0)
 
     def _launch(self, replica: ReplicaState, body: dict, timeout_s: float,
                 q: queue.Queue, call: _Call, attempt_no: int) -> None:
@@ -768,6 +824,11 @@ class FleetRouter:
                      if self.tracer is not None else "")
         results: queue.Queue = queue.Queue()
         self._count("fleet_requests")
+        # per-class request accounting (ISSUE 19): the body's priority
+        # class rides the transport verbatim; the router only counts it
+        klass = str(body.get("class") or body.get("priority") or "")
+        if klass:
+            self._count(f"fleet_class_{klass}_requests")
         live: dict[int, float] = {}  # rid -> launch time (hedge timer)
         tried_failed: set[int] = set()
         hedged_rids: set[int] = set()
@@ -783,6 +844,45 @@ class FleetRouter:
                 "span_id": call.span_id,
                 "latency_ms": (self._clock() - t_start) * 1e3, **extra,
             }
+
+        # deadline-feasibility admission (ISSUE 19): shed a request the
+        # scraped signal plane says cannot complete by its deadline
+        # BEFORE it crosses a process boundary — an infeasible request
+        # still costs transport, a replica queue slot, and a batcher
+        # expiry downstream, and the client learns nothing it couldn't
+        # learn right here, cheaper and sooner. Rejection is load
+        # shedding, not an error (INVARIANTS.md): 429 when queue
+        # congestion is the cause (retry after the hinted backoff
+        # helps), 504 when even an idle replica's p99 floor exceeds the
+        # deadline (only a longer deadline helps).
+        if self.feasibility:
+            est_ms, floor_ms = self._feasibility_ms()
+            budget_ms = timeout_ms * self.feasibility_margin
+            if floor_ms is not None and floor_ms > budget_ms:
+                call.done.set()
+                retry_after = self._retry_after_s()
+                self._count("fleet_infeasible_deadline")
+                return 504, {
+                    "error": (
+                        f"deadline infeasible: every admittable "
+                        f"replica's rolling p99 ({floor_ms:.0f} ms) "
+                        f"exceeds the {timeout_ms:.0f} ms deadline"),
+                    "reason": "infeasible_deadline", "trace_id": tid,
+                    "retry_after_s": retry_after,
+                }, meta(retry_after_s=retry_after)
+            if est_ms is not None and est_ms > budget_ms:
+                call.done.set()
+                retry_after = self._retry_after_s()
+                self._count("fleet_infeasible_queue")
+                return 429, {
+                    "error": (
+                        f"deadline infeasible under current load: "
+                        f"predicted completion {est_ms:.0f} ms vs the "
+                        f"{timeout_ms:.0f} ms deadline; retry after "
+                        f"{retry_after:.0f} s"),
+                    "reason": "infeasible_queue", "trace_id": tid,
+                    "retry_after_s": retry_after,
+                }, meta(retry_after_s=retry_after)
 
         while True:
             now = self._clock()
@@ -864,6 +964,8 @@ class FleetRouter:
                     self._count("fleet_duplicate_answers")
                 call.done.set()
                 self._count("fleet_answered")
+                if klass:
+                    self._count(f"fleet_class_{klass}_answered")
                 if rid in hedged_rids:
                     self._count("fleet_hedge_wins")
                 total_ms = (self._clock() - t_start) * 1e3

@@ -151,6 +151,41 @@ def ingest_gauges(counters: dict, gauges: dict) -> dict:
     return out
 
 
+def priority_gauges(counters: dict, gauges: dict) -> dict:
+    """Derived health figures for priority-class serving (ISSUE 19),
+    from a run's counters/gauges — the ``ingest_gauges`` analog for the
+    continuous batcher's front door.
+
+    - ``serve_padding_fill_share``: of the graph slots higher-class
+      flushes would have PADDED, the fraction lower-class backfill
+      actually filled — the padding→goodput conversion rate (0 with
+      backfill off or under single-class load);
+    - ``serve_class_{c}_responses``: answers per priority class, the
+      share view WFQ/aging fairness assertions read;
+    - ``serve_backfilled_total``: responses that rode another class's
+      flush slack rather than waiting for their own cut.
+    """
+    out = {}
+    if "serve_padding_fill_share" in gauges:
+        out["serve_padding_fill_share"] = float(
+            gauges["serve_padding_fill_share"])
+    if "serve_backfill_enabled" in gauges:
+        out["serve_backfill_enabled"] = float(
+            gauges["serve_backfill_enabled"])
+    if "serve_responses_backfilled" in counters:
+        out["serve_backfilled_total"] = float(
+            counters["serve_responses_backfilled"])
+    classes = {k: float(v) for k, v in counters.items()
+               if k.startswith("serve_responses_class_")}
+    for k, v in sorted(classes.items()):
+        out[k.replace("serve_responses_class_", "serve_class_")
+            + "_responses"] = v
+    if classes and sum(classes.values()) > 0:
+        total = sum(classes.values())
+        out["serve_class_max_share"] = max(classes.values()) / total
+    return out
+
+
 def pipeline_gauges(counters: dict, gauges: dict) -> dict:
     """Derived health figures for the parallel ingest pipeline
     (data/pipeline.py), from a run's counters/gauges — the

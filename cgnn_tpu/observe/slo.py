@@ -55,13 +55,17 @@ class SLOObjective:
     ``latency_threshold_ms`` None -> availability objective (good =
     the event succeeded); set -> latency objective (good = succeeded
     AND answered within the threshold). ``window_s`` is the error-
-    budget accounting window.
+    budget accounting window. ``klass`` scopes the objective to one
+    priority class (ISSUE 19): only events recorded with a matching
+    ``klass`` feed its windows — None keeps the legacy behavior (the
+    objective sees every event, whatever its class).
     """
 
     name: str
     target: float
     latency_threshold_ms: float | None = None
     window_s: float = 3600.0
+    klass: str | None = None
 
     def __post_init__(self):
         if not 0.0 < self.target < 1.0:
@@ -210,11 +214,18 @@ class SLOEngine:
     # ---- feed ----
 
     def record(self, ok: bool, latency_ms: float | None = None,
-               now: float | None = None) -> None:
+               now: float | None = None,
+               klass: str | None = None) -> None:
+        """One event into every objective it scopes to: class-agnostic
+        objectives (``obj.klass`` None) see all events; class-scoped
+        ones (ISSUE 19) see only their class. An event with no class
+        feeds the class-agnostic objectives alone."""
         now = self._clock() if now is None else now
         with self._lock:
             self.events += 1
             for obj in self.objectives:
+                if obj.klass is not None and obj.klass != klass:
+                    continue
                 self._windows[obj.name].record(
                     now, obj.good(ok, latency_ms))
 

@@ -27,6 +27,7 @@ from cgnn_tpu.observe.gauges import (
     ingest_gauges,
     padding_gauges,
     pipeline_gauges,
+    priority_gauges,
 )
 from cgnn_tpu.analysis import racecheck
 from cgnn_tpu.observe.metrics_io import MetricsLogger
@@ -259,6 +260,7 @@ class Telemetry:
         gauges.update(pipeline_gauges(counters, gauges))
         gauges.update(device_gauges(counters, gauges))
         gauges.update(ingest_gauges(counters, gauges))
+        gauges.update(priority_gauges(counters, gauges))
         if counters or gauges:
             self.logger.event("run_summary", {
                 "counters": counters, "gauges": gauges,

@@ -11,6 +11,8 @@ result cache, and graceful SIGTERM drain. The core is socket-free
 """
 
 from cgnn_tpu.serve.batcher import (
+    CLASSES,
+    DEFAULT_CLASS,
     MALFORMED,
     OVERSIZE,
     QUEUE_FULL,
@@ -21,6 +23,7 @@ from cgnn_tpu.serve.batcher import (
     Request,
     RequestFuture,
     ServeRejection,
+    parse_kv_spec,
 )
 from cgnn_tpu.serve.cache import ResultCache, structure_fingerprint
 from cgnn_tpu.serve.devices import DeviceSet, replicate_state, resolve_devices
@@ -30,7 +33,9 @@ from cgnn_tpu.serve.shapes import BatchShape, ShapeSet, plan_shape_set
 
 __all__ = [
     "BatchShape",
+    "CLASSES",
     "CheckpointWatcher",
+    "DEFAULT_CLASS",
     "DeviceSet",
     "Flush",
     "InferenceServer",
@@ -48,6 +53,7 @@ __all__ = [
     "ShapeSet",
     "TIMEOUT",
     "load_server",
+    "parse_kv_spec",
     "plan_shape_set",
     "replicate_state",
     "resolve_devices",

@@ -1,13 +1,25 @@
-"""Dynamic micro-batcher: coalesce single-structure requests into
-fixed-shape batches under a latency deadline.
+"""Priority-class continuous micro-batcher: coalesce single-structure
+requests into fixed-shape batches under per-class latency deadlines,
+backfilling padding slack with lower-class work.
 
-The queueing policy in one sentence: FIFO requests accumulate until the
-head batch would overflow the LARGEST precompiled shape ("shape-full")
-or the OLDEST queued request has waited ``max_wait_ms`` ("deadline"),
-whichever comes first — so under load batches run full (throughput) and
-under trickle traffic no request waits more than one flush interval
-(latency), and in neither case does packing ever leave the warm shape
-set (shapes.py), so no request ever waits on a recompile.
+The queueing policy in one paragraph: requests carry a priority CLASS
+(``interactive`` / ``batch`` / ``scavenger``; CLASSES) and accumulate in
+one bounded queue. A flush is cut for the HEAD class — the
+highest-priority class present, unless a lower class has aged past its
+own per-class wait budget (starvation freedom: a scavenger request
+cannot sit forever behind a saturated interactive stream). Within the
+head class, requests are ordered by weighted fair queuing across
+tenants (per-tenant virtual finish times, so one heavy tenant cannot
+starve the rest), and the flush fires when the head batch would
+overflow the LARGEST precompiled shape ("shape_full"), the head class's
+oldest request has waited its class budget ("deadline"), or the head
+prefix hits a (class, tier, form) cut boundary ("tier_boundary" — one
+program per flush). After the rung is chosen for the head prefix,
+BACKFILL (ISSUE 19) fills the rung's remaining graph/node/edge slack
+with lower-class requests sharing the head's (tier, form): padded slots
+become goodput without delaying the head flush (the rung is already
+chosen and fires NOW) and without ever leaving the warm shape set — so
+in no case does packing wait on a recompile.
 
 Admission control happens at ``offer``:
 
@@ -17,6 +29,8 @@ Admission control happens at ``offer``:
 - oversize structures (don't fit the largest shape even alone) are
   rejected with the observed sizes — queueing one would wedge the head
   of the FIFO forever;
+- an unknown priority class is MALFORMED — silently mapping it to a
+  default would quietly change the request's scheduling contract;
 - a closed (draining) batcher rejects new work but keeps flushing what
   it already accepted — the SIGTERM drain path.
 
@@ -49,6 +63,36 @@ OVERSIZE = "oversize"
 TIMEOUT = "timeout"
 SHUTDOWN = "shutdown"
 MALFORMED = "malformed"
+
+# priority classes (ISSUE 19), rank order = scheduling order (index 0
+# preempts index 1, ...). Stable strings: they ride HTTP payloads,
+# metric label values, and counter suffixes, so renaming one is a wire
+# protocol change.
+CLASSES = ("interactive", "batch", "scavenger")
+DEFAULT_CLASS = CLASSES[0]
+_CLASS_RANK = {c: i for i, c in enumerate(CLASSES)}
+
+# per-class wait budget as a multiple of max_wait when no explicit
+# class_max_wait_ms map is given: interactive keeps the legacy flush
+# deadline; batch and scavenger trade latency for riding backfill slack
+_DEFAULT_WAIT_MULT = {"interactive": 1.0, "batch": 4.0, "scavenger": 16.0}
+
+
+def parse_kv_spec(spec: str) -> dict[str, float]:
+    """Parse a ``"key=float,key=float"`` spec string (class waits, class
+    SLOs, tenant weights — the shared flag grammar of serve.py /
+    fleet.py / the loadgen). Empty -> {}."""
+    out: dict[str, float] = {}
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"malformed spec entry {part!r} (want key=value)")
+        k, v = part.split("=", 1)
+        out[k.strip()] = float(v)
+    return out
 
 
 class ServeRejection(RuntimeError):
@@ -119,14 +163,30 @@ class Request:
     # precision tier (serve/quantize.py TIERS), validated at admission
     # against the server's warmed set: a flush runs ONE program, so
     # co-batched requests must share a tier — the batcher cuts a flush
-    # at every tier boundary in the FIFO (see _take_locked)
+    # at every tier boundary in the head prefix (see _take_locked)
     precision: str = "f32"
     # staging form (ISSUE 11): 'feat' = a featurized CrystalGraph (or a
     # wire-form structure the pack stage will featurize on the pool —
     # graph then holds the RawStructure until pack time), 'raw' = staged
     # as a RawBatch for the in-program neighbor search. Like precision,
-    # a flush runs ONE program, so the FIFO cuts at form boundaries.
+    # a flush runs ONE program, so the head prefix cuts at form
+    # boundaries — with the class, the full cut key is the
+    # (class, tier, form) triple (ISSUE 19).
     form: str = "feat"
+    # priority class (ISSUE 19, CLASSES): which per-class wait budget
+    # and scheduling rank this request rides. The default keeps
+    # single-class callers on the legacy FIFO behavior exactly.
+    klass: str = DEFAULT_CLASS
+    # fair-queuing tenant ("" = the shared anonymous tenant): WFQ
+    # ordering within a class is by per-tenant virtual finish time
+    tenant: str = ""
+    # set by the batcher when this request rode a higher-class flush's
+    # padding slack instead of waiting for its own class's cut — it is
+    # still answered exactly once under its own trace id, never
+    # downgraded (INVARIANTS.md)
+    backfilled: bool = False
+    # WFQ virtual finish time, stamped at offer() under the queue lock
+    vft: float = 0.0
 
 
 @dataclasses.dataclass
@@ -150,6 +210,15 @@ class Flush:
     precision: str = "f32"
     # the staging form every member shares ('feat' | 'raw'; ISSUE 11)
     form: str = "feat"
+    # the priority class this flush was CUT FOR (ISSUE 19): backfilled
+    # lower-class members ride along without changing it — the flush's
+    # timing contract belongs to the head class
+    klass: str = DEFAULT_CLASS
+    # backfill accounting: members that rode padding slack, and the
+    # graph-slot slack the chosen rung had before backfill ran (the
+    # serve_padding_fill_share numerator/denominator)
+    n_backfilled: int = 0
+    slack_slots: int = 0
 
     def __bool__(self) -> bool:
         return bool(self.requests or self.expired)
@@ -159,7 +228,8 @@ class Flush:
 
 
 class MicroBatcher:
-    """Bounded FIFO + the flush policy described in the module docstring."""
+    """Bounded priority queue + the flush policy described in the module
+    docstring."""
 
     def __init__(
         self,
@@ -169,6 +239,9 @@ class MicroBatcher:
         max_wait_ms: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
         queue_wait_hist=None,
+        class_max_wait_ms: dict | None = None,
+        backfill: bool = True,
+        wfq_weights: dict | None = None,
     ):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -181,6 +254,29 @@ class MicroBatcher:
         # flush decision, the queueing truth independent of pack/dispatch
         # time downstream. None keeps the hot path untouched.
         self.queue_wait_hist = queue_wait_hist
+        # per-class wait budget (seconds): explicit ms overrides, else
+        # the default multiples of max_wait. An unknown class in the
+        # override is a config error, not a silent default.
+        self.class_wait = {
+            c: self.max_wait * _DEFAULT_WAIT_MULT[c] for c in CLASSES
+        }
+        for c, ms in (class_max_wait_ms or {}).items():
+            if c not in _CLASS_RANK:
+                raise ValueError(
+                    f"unknown priority class {c!r} in class_max_wait_ms "
+                    f"(have: {list(CLASSES)})")
+            self.class_wait[c] = float(ms) / 1000.0
+        # padding-slack backfill switch (the bench.py --ab backfill leg
+        # turns it off for the baseline)
+        self.backfill = bool(backfill)
+        # WFQ tenant weights (share of service per unit weight); tenants
+        # absent from the map get weight 1.0
+        self.wfq_weights: dict[str, float] = {}
+        for t, w in (wfq_weights or {}).items():
+            if float(w) <= 0:
+                raise ValueError(
+                    f"wfq weight for tenant {t!r} must be > 0, got {w}")
+            self.wfq_weights[str(t)] = float(w)
         self._queue: list[Request] = []
         # a plain Condition normally; instrumented (lock-order + held-by
         # tracking) under CGNN_TPU_RACECHECK=1 — racecheck.make_condition
@@ -188,11 +284,26 @@ class MicroBatcher:
         self._cond = racecheck.make_condition("serve.batcher")
         self._closed = False
         self._flush_seq = 0
+        # WFQ virtual time: advances to the largest served finish time;
+        # a newly-arriving tenant starts HERE, so idling never banks
+        # credit. All mutated under self._cond (GC-LOCKSHARE).
+        self._vtime = 0.0
+        self._tenant_vft: dict[str, float] = {}
+        # lifetime backfill accounting (the serve_padding_fill_share
+        # feed): requests that rode slack / graph-slot slack offered
+        self._backfilled_total = 0
+        self._slack_total = 0
 
     # ---- admission ----
 
     def offer(self, request: Request) -> None:
         """Admit or reject (raises ServeRejection; never blocks)."""
+        if request.klass not in _CLASS_RANK:
+            raise ServeRejection(
+                MALFORMED,
+                f"unknown priority class {request.klass!r} "
+                f"(have: {list(CLASSES)})",
+            )
         n, e = self.shape_set.graph_counts(request.graph)
         request.nodes, request.edges = n, e
         if not self.shape_set.largest.fits(1, n, e):
@@ -207,6 +318,15 @@ class MicroBatcher:
                     QUEUE_FULL,
                     f"request queue at capacity ({self.max_queue})",
                 )
+            # WFQ stamp: finish time = max(global vtime, the tenant's
+            # last finish) + cost/weight (cost 1 per request — service
+            # share is in requests). Same-tenant arrivals chain, so a
+            # single tenant degenerates to strict FIFO.
+            w = self.wfq_weights.get(request.tenant, 1.0)
+            base = max(self._vtime,
+                       self._tenant_vft.get(request.tenant, 0.0))
+            request.vft = base + 1.0 / w
+            self._tenant_vft[request.tenant] = request.vft
             self._queue.append(request)
             self._cond.notify_all()
 
@@ -215,32 +335,73 @@ class MicroBatcher:
         with self._cond:
             return len(self._queue)
 
+    @property
+    def backfilled_total(self) -> int:
+        """Requests that rode a higher-class flush's padding slack."""
+        with self._cond:
+            return self._backfilled_total
+
+    @property
+    def slack_total(self) -> int:
+        """Graph-slot slack offered to backfill across all flushes."""
+        with self._cond:
+            return self._slack_total
+
     # ---- flush policy ----
 
-    def _take_locked(self, now: float) -> tuple[list, list, bool]:
-        """(batchable FIFO prefix, expired, hit-boundary). The _locked
-        suffix is the graftcheck GC-LOCKSHARE contract: callers hold
-        self._cond.
+    def _head_class_locked(self, live: list, now: float) -> str:
+        """The class the next flush is cut for: the highest-priority
+        class present — unless some class has AGED past its own wait
+        budget, in which case the most-overdue class wins (starvation
+        freedom: sustained interactive load cannot pin a scavenger
+        request forever; once overdue it gets its own flush)."""
+        oldest: dict[str, float] = {}
+        for r in live:
+            if r.klass not in oldest or r.enqueued < oldest[r.klass]:
+                oldest[r.klass] = r.enqueued
 
-        A precision-tier change in the FIFO is a batch boundary exactly
-        like shape-full: the head tier's prefix fires NOW (one program
-        per flush), the next tier starts the next batch — strict FIFO is
-        preserved (no reordering around the boundary) and a mixed queue
-        degrades to smaller flushes, never to head-of-line blocking.
-        A staging-FORM change (featurized vs raw wire, ISSUE 11) is the
-        same kind of boundary: raw and featurized flushes run different
-        warmed programs."""
+        def urgency(c: str) -> float:
+            return (now - oldest[c]) / max(self.class_wait[c], 1e-9)
+
+        overdue = [c for c in oldest if urgency(c) >= 1.0]
+        if overdue:
+            # most overdue first; ties break toward the higher class
+            return max(overdue,
+                       key=lambda c: (urgency(c), -_CLASS_RANK[c]))
+        return min(oldest, key=lambda c: _CLASS_RANK[c])
+
+    def _take_locked(self, now: float) -> tuple[list, list, bool, bool]:
+        """(head-class batch prefix, expired, shape-full, hit-boundary).
+        The _locked suffix is the graftcheck GC-LOCKSHARE contract:
+        callers hold self._cond.
+
+        The cut key is the (class, tier, form) TRIPLE (ISSUE 19): the
+        head class is chosen first (_head_class_locked), then within it
+        requests are walked in WFQ order and a precision-tier or
+        staging-form change is a batch boundary exactly like shape-full
+        — the head (tier, form) prefix fires NOW (one program per
+        flush), the rest starts the next batch. A mixed queue degrades
+        to smaller flushes, never to head-of-line blocking; single-class
+        single-tenant traffic walks in strict FIFO order, preserving the
+        legacy behavior exactly."""
         big = self.shape_set.largest
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now >= r.deadline]
+        dead = set(map(id, expired))
+        live = [r for r in self._queue if id(r) not in dead]
+        if not live:
+            return [], expired, False, False
+        head = self._head_class_locked(live, now)
+        # WFQ order within the head class (stable sort: equal finish
+        # times keep arrival order)
+        cand = sorted((r for r in live if r.klass == head),
+                      key=lambda r: r.vft)
         take: list[Request] = []
-        expired: list[Request] = []
         n_nodes = n_edges = 0
         full = False
         boundary = False
         key: tuple | None = None
-        for req in self._queue:
-            if req.deadline is not None and now >= req.deadline:
-                expired.append(req)
-                continue
+        for req in cand:
             if key is None:
                 key = (req.precision, req.form)
             elif (req.precision, req.form) != key:
@@ -258,18 +419,62 @@ class MicroBatcher:
         return (take, expired, full or len(take) >= big.graph_cap,
                 boundary)
 
+    def _backfill_locked(self, fired: list, shape: BatchShape,
+                         now: float) -> tuple[int, int]:
+        """Fill the chosen rung's remaining graph/node/edge slack with
+        LOWER-class queued requests sharing the head's (tier, form)
+        (ISSUE 19). The rung was already chosen for the head prefix and
+        the flush fires NOW either way, so backfill can only convert
+        padding into goodput — never delay the head class, never change
+        the shape, never leave the warm set. A candidate that does not
+        fit the remaining slack stays queued (a later, smaller one may
+        still fit). -> (backfilled count, graph-slot slack offered)."""
+        head = fired[0]
+        head_rank = _CLASS_RANK[head.klass]
+        key = (head.precision, head.form)
+        n = len(fired)
+        slack = shape.graph_cap - n
+        if slack <= 0:
+            return 0, 0
+        n_nodes = sum(r.nodes for r in fired)
+        n_edges = sum(r.edges for r in fired)
+        taken = set(map(id, fired))
+        cand = [r for r in self._queue
+                if id(r) not in taken
+                and _CLASS_RANK[r.klass] > head_rank
+                and (r.precision, r.form) == key
+                and not (r.deadline is not None and now >= r.deadline)]
+        # highest class first among the lower ones, WFQ order within
+        cand.sort(key=lambda r: (_CLASS_RANK[r.klass], r.vft))
+        backfilled = 0
+        for r in cand:
+            if not shape.fits(n + 1, n_nodes + r.nodes,
+                              n_edges + r.edges):
+                continue
+            r.backfilled = True
+            fired.append(r)
+            n += 1
+            n_nodes += r.nodes
+            n_edges += r.edges
+            backfilled += 1
+            if n >= shape.graph_cap:
+                break
+        return backfilled, slack
+
     def poll(self, now: float | None = None) -> Flush | None:
         """Non-blocking flush decision at time ``now``.
 
-        Returns a Flush when the policy says fire (shape-full, oldest
-        waited past ``max_wait``, draining, or deadline expiries need
-        delivering), else None. Pure given the clock — the unit-testable
-        core of the batcher."""
+        Returns a Flush when the policy says fire (shape-full, head
+        class's oldest waited past its class budget, tier/form boundary,
+        draining, or deadline expiries need delivering), else None. Pure
+        given the clock — the unit-testable core of the batcher."""
         now = self._clock() if now is None else now
         with self._cond:
             take, expired, full, boundary = self._take_locked(now)
+            head_wait = (self.class_wait[take[0].klass] if take
+                         else self.max_wait)
             waited = (
-                take and now - min(r.enqueued for r in take) >= self.max_wait
+                take and now - min(r.enqueued for r in take) >= head_wait
             )
             if full or boundary or waited or (self._closed and take):
                 # tier_boundary gets its own reason: conflating it with
@@ -285,24 +490,41 @@ class MicroBatcher:
                 reason, fired = "", []
             else:
                 return None
-            drop = set(map(id, fired)) | set(map(id, expired))
-            self._queue = [r for r in self._queue if id(r) not in drop]
             shape = None
+            n_back = slack = 0
             if fired:
+                # the rung is chosen for the HEAD prefix; backfill then
+                # packs lower-class work into its remaining slack
+                # without ever upgrading the rung
                 shape = self.shape_set.shape_for(
                     len(fired),
                     sum(r.nodes for r in fired),
                     sum(r.edges for r in fired),
                 )
+                if self.backfill and shape is not None:
+                    n_back, slack = self._backfill_locked(
+                        fired, shape, now)
+                    self._backfilled_total += n_back
+                    self._slack_total += slack
+            drop = set(map(id, fired)) | set(map(id, expired))
+            self._queue = [r for r in self._queue if id(r) not in drop]
             if self.queue_wait_hist is not None:
                 for r in fired:
                     self.queue_wait_hist.observe((now - r.enqueued) * 1e3)
+            if fired:
+                # advance WFQ virtual time to the largest served finish
+                # tag — late-arriving tenants start from here
+                self._vtime = max(self._vtime,
+                                  max(r.vft for r in fired))
             self._flush_seq += 1
             return Flush(fired, shape, expired, reason,
                          flush_id=f"flush-{self._flush_seq:06d}",
                          precision=(fired[0].precision if fired
                                     else "f32"),
-                         form=(fired[0].form if fired else "feat"))
+                         form=(fired[0].form if fired else "feat"),
+                         klass=(fired[0].klass if fired
+                                else DEFAULT_CLASS),
+                         n_backfilled=n_back, slack_slots=slack)
 
     def next_flush(self) -> Flush | None:
         """Block until the policy fires (worker-thread API).
@@ -319,12 +541,22 @@ class MicroBatcher:
                 if not self._queue:
                     self._cond.wait(timeout=self.max_wait)
                     continue
-                oldest = min(r.enqueued for r in self._queue)
-                remaining = self.max_wait - (self._clock() - oldest)
+                # sleep until the soonest event that can fire a flush:
+                # a class wait budget elapsing OR a per-request deadline
+                # expiring (a lower-class-only queue may legitimately
+                # sleep past max_wait; a new arrival that makes the
+                # batch shape-full wakes us early via notify)
+                next_at = min(
+                    r.enqueued + self.class_wait[r.klass]
+                    for r in self._queue
+                )
+                dl = min((r.deadline for r in self._queue
+                          if r.deadline is not None), default=None)
+                if dl is not None:
+                    next_at = min(next_at, dl)
+                remaining = next_at - self._clock()
                 closed = self._closed  # read under the lock (GC-LOCKSHARE)
             if remaining > 0 and not closed:
-                # sleep until the deadline can fire (a new arrival that
-                # makes the batch shape-full wakes us early)
                 with self._cond:
                     self._cond.wait(timeout=remaining)
             flush = self.poll()

@@ -450,6 +450,13 @@ def make_handler(server: InferenceServer):
                         graph, timeout_ms=timeout_ms, trace_id=trace_id,
                         precision=payload.get("precision"),
                         trace_parent=trace_parent,
+                        # priority serving (ISSUE 19): body 'class' (or
+                        # the 'priority' alias) + WFQ 'tenant' ride the
+                        # fleet transport verbatim; absent keeps the
+                        # single-class legacy contract
+                        klass=(payload.get("class")
+                               or payload.get("priority")),
+                        tenant=payload.get("tenant"),
                     )
                 except ServeRejection as e:
                     headers = None
@@ -484,6 +491,8 @@ def make_handler(server: InferenceServer):
                 "trace_id": result.trace_id,
                 "flush_id": result.flush_id,
                 "stamps": result.stamps,
+                "class": result.klass,
+                "backfilled": result.backfilled,
             }, headers={"X-Request-Id": result.trace_id})
 
     return ServeHandler

@@ -82,6 +82,8 @@ from cgnn_tpu.data.graph import CrystalGraph
 from cgnn_tpu.data.rawbatch import RawStructure, raw_fingerprint
 from cgnn_tpu.resilience import faultinject
 from cgnn_tpu.serve.batcher import (
+    CLASSES,
+    DEFAULT_CLASS,
     MALFORMED,
     OVERSIZE,
     TIMEOUT,
@@ -127,6 +129,12 @@ class ServeResult:
     # arrays, the deferred pack-pool featurize, or the cap-overflow
     # fallback)
     wire: str = "featurized"
+    # priority class served under (ISSUE 19; batcher.CLASSES) and
+    # whether this request rode a higher-class flush's padding slack —
+    # a backfilled reply is a normal reply (same program, same rung,
+    # own trace id), the flag is accounting, never a quality downgrade
+    klass: str = DEFAULT_CLASS
+    backfilled: bool = False
 
 
 class InferenceServer:
@@ -151,6 +159,9 @@ class InferenceServer:
         telemetry=None,
         max_queue: int = 256,
         max_wait_ms: float = 5.0,
+        class_max_wait_ms: dict | None = None,
+        backfill: bool = True,
+        wfq_weights: dict | None = None,
         default_timeout_ms: float | None = 1000.0,
         cache_size: int = 1024,
         pack_workers: int = 1,
@@ -318,11 +329,27 @@ class InferenceServer:
                 on_fire=self._on_slo_fire, on_resolve=self._on_slo_resolve,
             )
             self.tsdb = TimeSeriesStore()
+        # priority-class continuous batching (ISSUE 19): per-class wait
+        # budgets, padding-slack backfill, and WFQ tenant weights all
+        # live in the batcher — the server's share is the per-class
+        # metric families below and the slack accounting in dispatch
         self.batcher = MicroBatcher(
             shape_set, max_queue=max_queue, max_wait_ms=max_wait_ms,
             clock=clock,
             queue_wait_hist=self.hists.get("serve_queue_wait_ms_hist"),
+            class_max_wait_ms=class_max_wait_ms, backfill=backfill,
+            wfq_weights=wfq_weights,
         )
+        # per-class latency histograms (labeled members of one family,
+        # serve_class_latency_ms_hist{class="..."}) — created lazily
+        # like the per-version family so single-class traffic pays one
+        # dict miss, not three idle histograms
+        self._class_hists: dict[str, object] = {}
+        # backfill accounting (the serve_padding_fill_share feed):
+        # graph-slot slack offered to backfill vs slots actually filled,
+        # accumulated per flush under self._lock
+        self._backfill_filled = 0
+        self._backfill_slack = 0
         self.default_timeout = (
             None if default_timeout_ms is None else default_timeout_ms / 1000.0
         )
@@ -416,6 +443,7 @@ class InferenceServer:
         racecheck.watch_fields(self, self._lock, (
             "counts", "_latencies", "_occupancies", "_draining",
             "_compiles_after_warm", "_rung_edge_occ",
+            "_backfill_filled", "_backfill_slack",
         ))
 
     # ---- warmup ----
@@ -565,13 +593,16 @@ class InferenceServer:
     # ---- metrics-truth feeds (ISSUE 16) ----
 
     def _observe_served(self, latency_ms: float,
-                        version: str | None = None) -> None:
+                        version: str | None = None,
+                        klass: str | None = None) -> None:
         """One answered request into the mergeable latency histogram +
         the SLO good/bad ledger. Cache hits count: a client got an
         answer either way, and the fleet-merged histogram must describe
         the same population clients measure. ``version`` additionally
         lands the sample in that param version's labeled family (ISSUE
-        18) so per-version latency survives the fleet merge."""
+        18) so per-version latency survives the fleet merge; ``klass``
+        lands it in the priority class's labeled family (ISSUE 19) and
+        routes it to class-scoped SLO objectives."""
         h = self.hists.get("serve_latency_ms_hist")
         if h is not None:
             h.observe(latency_ms)
@@ -590,8 +621,20 @@ class InferenceServer:
                                 self._version_hists_cap:
                             self._version_hists.popitem(last=False)
                 vh.observe(latency_ms)
+            if klass is not None:
+                ch = self._class_hists.get(klass)
+                if ch is None:
+                    from cgnn_tpu.observe.hist import (
+                        LATENCY_MS_BOUNDS,
+                        Histogram,
+                    )
+
+                    with self._lock:
+                        ch = self._class_hists.setdefault(
+                            klass, Histogram(LATENCY_MS_BOUNDS))
+                ch.observe(latency_ms)
         if self.slo is not None:
-            self.slo.record(True, latency_ms)
+            self.slo.record(True, latency_ms, klass=klass)
 
     def attach_journal(self, journal) -> None:
         """Wire a continual/journal.LabelJournal into the answer path:
@@ -632,13 +675,13 @@ class InferenceServer:
                       param_version=version, fingerprint=fingerprint,
                       ts=time.time())
 
-    def _record_slo_bad(self) -> None:
+    def _record_slo_bad(self, klass: str | None = None) -> None:
         """One failed request (dispatch failure / deadline expiry) into
         the error-budget ledger. Admission rejections (queue-full,
         oversize, malformed) are NOT budget burn — they are the server
         protecting itself or the client's fault (the 429/400 class)."""
         if self.slo is not None:
-            self.slo.record(False, 0.0)
+            self.slo.record(False, 0.0, klass=klass)
 
     def _slo_tick(self) -> None:
         """Collector heartbeat: advance the alert state machines so
@@ -714,6 +757,8 @@ class InferenceServer:
             draining = self._draining
             compiles_after_warm = self._compiles_after_warm
             rung_occ = dict(self._rung_edge_occ)
+            backfill_filled = self._backfill_filled
+            backfill_slack = self._backfill_slack
         counters = {f"serve_{k}": float(v) for k, v in counts.items()}
         tcounters = self.telemetry.counters()
         for name in ("pipeline_jobs", "pipeline_pack_s", "pipeline_wait_s"):
@@ -736,6 +781,15 @@ class InferenceServer:
         }
         for rung, occ in sorted(rung_occ.items()):
             gauges[f"ingest_rung{rung}_edge_occupancy"] = float(occ)
+        # padding-slack backfill (ISSUE 19): what share of the graph
+        # slots higher-class flushes would have PADDED was instead
+        # filled with lower-class goodput. 0 with backfill off or under
+        # pure single-class load — the bench A/B's headline gauge.
+        gauges["serve_backfill_enabled"] = float(self.batcher.backfill)
+        gauges["serve_padding_fill_share"] = (
+            backfill_filled / backfill_slack if backfill_slack else 0.0)
+        counters["serve_backfill_filled_slots"] = float(backfill_filled)
+        counters["serve_backfill_slack_slots"] = float(backfill_slack)
         # the cross-process observability layer's own health (ISSUE 15)
         gauges["observe_trace_ring"] = float(self.tracer is not None)
         if self.tracer is not None:
@@ -777,6 +831,20 @@ class InferenceServer:
                     key = ("serve_version_latency_ms_hist"
                            + format_labels({"param_version": str(ver)}))
                     out["histograms"][key] = vh.snapshot()
+            with self._lock:
+                chists = list(self._class_hists.items())
+            if chists:
+                # per-priority-class latency (ISSUE 19): labeled members
+                # of one family, keyed name{class="..."} — what lets the
+                # autoscaler and fleet SLO views see classes instead of
+                # one aggregate, and they merge across replicas like any
+                # histogram family
+                from cgnn_tpu.observe.hist import format_labels
+
+                for kl, chh in sorted(chists):
+                    key = ("serve_class_latency_ms_hist"
+                           + format_labels({"class": str(kl)}))
+                    out["histograms"][key] = chh.snapshot()
         if self.slo is not None:
             gauges.update(self.slo.gauges())
         if self.tsdb is not None:
@@ -968,7 +1036,9 @@ class InferenceServer:
                timeout_ms: float | None = None,
                trace_id: str | None = None,
                precision: str | None = None,
-               trace_parent: str | None = None) -> RequestFuture:
+               trace_parent: str | None = None,
+               klass: str | None = None,
+               tenant: str | None = None) -> RequestFuture:
         """Admit one structure; returns its future (raises ServeRejection
         on malformed / queue-full / oversize / draining). ``graph`` is a
         featurized ``CrystalGraph`` OR a wire-form ``RawStructure``
@@ -984,11 +1054,16 @@ class InferenceServer:
         trace. ``precision`` picks the serving tier (None = 'f32'); a
         tier the server did not warm is rejected AT ADMISSION —
         flushing it would trace a fresh program (a recompile after
-        warmup)."""
+        warmup). ``klass`` picks the priority class (ISSUE 19;
+        batcher.CLASSES, default 'interactive') and ``tenant`` the WFQ
+        fair-queuing tenant — an unknown class is MALFORMED at
+        admission, because silently defaulting it would change the
+        request's scheduling contract."""
         now = self._clock()
         queued = self._stamp()
         tid = self._mint_trace(trace_id)
         tier = precision or "f32"
+        kl = klass or DEFAULT_CLASS
         is_raw_wire = isinstance(graph, RawStructure)
         form = "feat"
         self._count("requests")
@@ -998,6 +1073,12 @@ class InferenceServer:
                     MALFORMED,
                     f"precision {tier!r} not in this server's warmed "
                     f"tiers {list(self.precisions)}",
+                )
+            if kl not in CLASSES:
+                raise ServeRejection(
+                    MALFORMED,
+                    f"unknown priority class {kl!r} "
+                    f"(have: {list(CLASSES)})",
                 )
             if is_raw_wire:
                 self._check_wellformed_raw(graph)
@@ -1059,6 +1140,7 @@ class InferenceServer:
                         device_id=-1, trace_id=tid, precision=tier,
                         stamps={"queued": queued, "replied": replied},
                         wire="raw" if form == "raw" else "featurized",
+                        klass=kl,
                     ))
                     # cache hits ARE served responses: they must feed the
                     # same latency distributions clients measure, or the
@@ -1066,7 +1148,9 @@ class InferenceServer:
                     # different populations under a warm cache
                     self._record_latency(latency_ms)
                     self._lat_rolling.add(latency_ms)
-                    self._observe_served(latency_ms, version=version)
+                    self._observe_served(latency_ms, version=version,
+                                         klass=kl)
+                    self._count(f"responses_class_{kl}")
                     self.telemetry.observe_value("serve_latency_ms",
                                                  latency_ms)
                     if self._spans_on:
@@ -1102,6 +1186,8 @@ class InferenceServer:
             precision=tier,
             form=form,
             trace_parent=str(trace_parent or ""),
+            klass=kl,
+            tenant=str(tenant or ""),
         )
         try:
             self.batcher.offer(req)
@@ -1114,10 +1200,13 @@ class InferenceServer:
                 timeout_ms: float | None = None,
                 trace_id: str | None = None,
                 precision: str | None = None,
-                trace_parent: str | None = None) -> ServeResult:
+                trace_parent: str | None = None,
+                klass: str | None = None,
+                tenant: str | None = None) -> ServeResult:
         """Blocking convenience: submit + wait."""
         fut = self.submit(graph, timeout_ms=timeout_ms, trace_id=trace_id,
-                          precision=precision, trace_parent=trace_parent)
+                          precision=precision, trace_parent=trace_parent,
+                          klass=klass, tenant=tenant)
         # wait slightly past the serving deadline: expiry is delivered by
         # the worker, not by this caller racing it
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
@@ -1348,7 +1437,7 @@ class InferenceServer:
             for r in flush.requests:
                 if not r.future.done():
                     r.future.set_error(e)
-                    self._record_slo_bad()
+                    self._record_slo_bad(klass=r.klass)
                     self._note_request(
                         trace_id=r.trace_id, status="dispatch_failed",
                         error=repr(e), precision=r.precision,
@@ -1436,6 +1525,7 @@ class InferenceServer:
                 latency_ms=latency_ms, batch_occupancy=occupancy,
                 device_id=shard, trace_id=r.trace_id, precision=tier,
                 flush_id=flush.flush_id, stamps=stamps, wire=wire,
+                klass=r.klass, backfilled=r.backfilled,
             ))
             if self._spans_on:  # skip arg-building when off
                 args = {"trace_id": r.trace_id,
@@ -1459,14 +1549,19 @@ class InferenceServer:
                 version=version, wire=wire)
             self._record_latency(latency_ms)
             self._lat_rolling.add(latency_ms)
-            self._observe_served(latency_ms, version=version)
+            self._observe_served(latency_ms, version=version,
+                                 klass=r.klass)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
             self._count("responses")
+            self._count(f"responses_class_{r.klass}")
+            if r.backfilled:
+                self._count("responses_backfilled")
             if wire == "raw":
                 self._count("responses_raw")
             if tier != "f32":
                 self._count(f"responses_{tier}")
         self._count("batches")
+        self._note_flush_backfill(flush)
         with self._lock:
             self._occupancies.append(occupancy)
             del self._occupancies[:-4096]
@@ -1479,7 +1574,7 @@ class InferenceServer:
 
     def _fail_expired(self, flush: Flush) -> None:
         for r in flush.expired:
-            self._record_slo_bad()
+            self._record_slo_bad(klass=r.klass)
             self._count("reject_timeout")
             self._note_request(trace_id=r.trace_id, status="timeout",
                               precision=r.precision)
@@ -1606,7 +1701,7 @@ class InferenceServer:
             for r in flush.requests:
                 if not r.future.done():
                     r.future.set_error(e)
-                    self._record_slo_bad()
+                    self._record_slo_bad(klass=r.klass)
                     self._note_request(
                         trace_id=r.trace_id, status="dispatch_failed",
                         error=repr(e), precision=r.precision,
@@ -1695,6 +1790,7 @@ class InferenceServer:
                 latency_ms=latency_ms, batch_occupancy=occupancy,
                 device_id=device, trace_id=r.trace_id, precision=tier,
                 flush_id=flush.flush_id, stamps=stamps, wire=wire,
+                klass=r.klass, backfilled=r.backfilled,
             ))
             # the whole journey, one span per request: admission ->
             # reply, args carrying the flush join key and stage stamps
@@ -1722,16 +1818,21 @@ class InferenceServer:
                 version=version, wire=wire)
             self._record_latency(latency_ms)
             self._lat_rolling.add(latency_ms)
-            self._observe_served(latency_ms, version=version)
+            self._observe_served(latency_ms, version=version,
+                                 klass=r.klass)
             # per REQUEST, not per batch: the run-summary quantiles must
             # describe the same distribution stats() does (PERF.md §10)
             self.telemetry.observe_value("serve_latency_ms", latency_ms)
             self._count("responses")
+            self._count(f"responses_class_{r.klass}")
+            if r.backfilled:
+                self._count("responses_backfilled")
             if wire == "raw":
                 self._count("responses_raw")
             if tier != "f32":
                 self._count(f"responses_{tier}")
         self._count("batches")
+        self._note_flush_backfill(flush)
         with self._lock:
             self._occupancies.append(occupancy)
             del self._occupancies[:-4096]
@@ -1766,6 +1867,9 @@ class InferenceServer:
             future=r.future, fingerprint=None, compactable=False,
             trace_id=r.trace_id, stamps=r.stamps, precision=r.precision,
             form="feat", trace_parent=r.trace_parent,
+            # the re-offer keeps the request's scheduling contract: same
+            # class and tenant, never a silent downgrade (INVARIANTS.md)
+            klass=r.klass, tenant=r.tenant,
         )
         try:
             self.batcher.offer(fallback)
@@ -1802,6 +1906,19 @@ class InferenceServer:
             self._rung_edge_occ[rung] = occ
         self.telemetry.set_gauge(f"ingest_rung{rung}_edge_occupancy", occ)
 
+    def _note_flush_backfill(self, flush: Flush) -> None:
+        """Per-flush backfill accounting (ISSUE 19): how many graph
+        slots the chosen rung had to spare after the head-class prefix,
+        and how many of them lower-class requests actually filled — the
+        serve_padding_fill_share numerator/denominator. Only flushes
+        that OFFERED slack count, so the gauge reads "of the padding
+        backfill could have converted, how much did it"."""
+        if not flush.slack_slots:
+            return
+        with self._lock:
+            self._backfill_filled += flush.n_backfilled
+            self._backfill_slack += flush.slack_slots
+
     # ---- bookkeeping ----
 
     def _count(self, key: str) -> None:
@@ -1836,6 +1953,8 @@ class InferenceServer:
             draining = self._draining
             compiles_after_warm = self._compiles_after_warm
             rung_occ = dict(self._rung_edge_occ)
+            backfill_filled = self._backfill_filled
+            backfill_slack = self._backfill_slack
         out = {
             "counts": counts,
             "queue_depth": self.batcher.depth,
@@ -1859,6 +1978,25 @@ class InferenceServer:
             "batch_occupancy_mean": float(np.mean(occ)) if occ else 0.0,
             "shapes": [s.to_meta() for s in self.shape_set],
             "precisions": list(self.precisions),
+            # priority serving (ISSUE 19): the per-class answer counts
+            # and the padding->goodput conversion the bench A/B pins
+            "priority": {
+                "backfill": self.batcher.backfill,
+                "class_wait_ms": {
+                    c: round(w * 1e3, 3)
+                    for c, w in self.batcher.class_wait.items()
+                },
+                "responses_by_class": {
+                    c: counts.get(f"responses_class_{c}", 0)
+                    for c in CLASSES
+                },
+                "backfilled_responses": counts.get(
+                    "responses_backfilled", 0),
+                "padding_fill_share": (
+                    backfill_filled / backfill_slack
+                    if backfill_slack else 0.0),
+                "slack_slots": backfill_slack,
+            },
             "recompiles_after_warm": compiles_after_warm,
             "ingest": {
                 "compact": self.shape_set.compact is not None,
@@ -1932,6 +2070,9 @@ def load_server(
     telemetry=None,
     max_queue: int = 256,
     max_wait_ms: float = 5.0,
+    class_max_wait_ms: dict | None = None,
+    backfill: bool = True,
+    wfq_weights: dict | None = None,
     default_timeout_ms: float | None = 1000.0,
     cache_size: int = 1024,
     compact: str = "auto",
@@ -2108,6 +2249,8 @@ def load_server(
     server = InferenceServer(
         state, shape_set, version=version, telemetry=telemetry,
         max_queue=max_queue, max_wait_ms=max_wait_ms,
+        class_max_wait_ms=class_max_wait_ms, backfill=backfill,
+        wfq_weights=wfq_weights,
         default_timeout_ms=default_timeout_ms, cache_size=cache_size,
         pack_workers=pack_workers, devices=device_list, engine=engine,
         precisions=precisions, model=model,

@@ -62,6 +62,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between /healthz + /metrics probe rounds")
     p.add_argument("--timeout-ms", type=float, default=30000.0,
                    help="default per-request fleet deadline")
+    p.add_argument("--no-feasibility", action="store_true",
+                   help="disable deadline-feasibility admission (the "
+                        "scraped-p99/queue-depth gate that sheds "
+                        "requests whose deadline cannot be met with "
+                        "429/504 + Retry-After before any attempt "
+                        "crosses a process boundary)")
+    p.add_argument("--feasibility-margin", type=float, default=1.0,
+                   help="scale the feasibility estimate: shed only when "
+                        "predicted completion exceeds deadline x margin "
+                        "(>1 = more headroom before shedding)")
     p.add_argument("--drain-timeout", type=float, default=60.0,
                    help="bound on the SIGTERM graceful drain of the "
                         "replica fleet; past it, replicas are killed "
@@ -239,6 +249,8 @@ def main(argv=None) -> int:
         backoff_ms=args.backoff_ms,
         hedge_ms=args.hedge_ms,
         default_timeout_ms=args.timeout_ms,
+        feasibility=not args.no_feasibility,
+        feasibility_margin=args.feasibility_margin,
         health_interval_s=args.health_interval,
         trace_ring=args.trace_ring,
         slo_layer=not args.no_slo,

@@ -64,6 +64,12 @@ DEFAULT_KEYS = (
     "ingest_raw_admit_share",
     "padding_eff_nodes",
     "padding_eff_edges",
+    # ISSUE 19: priority serving — aggregate goodput under a mixed-class
+    # load and the share of would-be padding that backfill converted to
+    # answers (both higher-is-better; a bench round that stops measuring
+    # them is how the front-door scheduler would silently rot)
+    "serve_goodput_structs_per_sec",
+    "serve_padding_fill_share",
     "oc20.oc20_structs_per_sec",
     "tiny.tiny_structs_per_sec",
     "coo_layout.coo_structs_per_sec",

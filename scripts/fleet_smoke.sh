@@ -74,6 +74,17 @@
 #     replica's gated reload watcher rolls it in; fleet converges,
 #     zero drops) then ROLLS BACK the corrupted one, dumping a
 #     flight-recorder bundle that names the regressing version.
+#  9. MIXED-PRIORITY CHAOS LEG (ISSUE 19, --priority-mix): open-loop
+#     interactive + scavenger load over 3 replicas; kill -9 one
+#     replica mid-load and restart it later. The loadgen hard-asserts
+#     the front-door contracts: the INTERACTIVE class's p99 holds its
+#     --class-slo-ms bound straight through the kill (the capacity
+#     loss lands on the scavenger class, which has no bound), zero
+#     lost ACCEPTED requests, exactly-once answers, and backfilled
+#     responses observed (scavengers riding interactive flushes'
+#     padded slots on the replicas). Feasibility sheds
+#     (infeasible_queue / infeasible_deadline) are ALLOWED here — they
+#     are load shedding at admission, not loss (INVARIANTS.md).
 #
 # Runs anywhere jax[cpu] does (synthetic data, CPU device).
 set -euo pipefail
@@ -511,6 +522,53 @@ print("leg 8 ok:", r["answered"], "answered |", lb["sent"],
       cont["promoted"], "promoted fleet-wide,", cont["rolled_back"],
       "rolled back (", cont.get("rollback_reason"), ") | bundle:",
       os.path.basename(cont["rollback_bundle"]))
+EOF
+
+echo "== leg 9: mixed-priority load + kill -9 -> interactive p99 holds =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 3 --fleet-base-port "$((BASE + 65))" \
+  --fleet-log-dir "$WORK/fleet9-logs" \
+  --clients 16 --duration 25 \
+  --priority-mix "interactive=12,scavenger=24" \
+  --class-slo-ms "interactive=2500" \
+  --class-wait-ms "interactive=8,scavenger=250" \
+  --expect-backfill \
+  --kill-at 0.35 --restart-at 0.55 --kill-replica 1 \
+  --expect-retries --no-scrape \
+  --report "$WORK/fleet_priority.json"
+python - "$WORK/fleet_priority.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+assert r["dropped"] == 0 and not r["client_errors"], r
+fl = r["fleet"]; rc = fl["router"]["counts"]; chaos = fl["chaos"]
+assert "killed_at_s" in chaos and chaos["restart_ready"], chaos
+assert rc["fleet_retries"] >= 1, rc
+assert rc["fleet_duplicate_answers"] == 0, rc
+t = r["tracing"]
+assert t["unique_trace_ids"] == r["answered"] and t["missing_trace_ids"] == 0, t
+pr = r["priority"]
+by_cls = pr["latency_ms_by_class"]
+# both classes made progress through the kill, and the head class's
+# p99 held its bound while the scavenger class absorbed the slack
+assert pr["responses_by_class"].get("interactive", 0) > 0, pr
+assert pr["responses_by_class"].get("scavenger", 0) > 0, pr
+assert by_cls["interactive"]["p99"] <= 2500.0, by_cls
+# replicas converted interactive padding into scavenger answers
+assert pr["backfilled_responses"] >= 1, pr
+# the router classified traffic at the front door (per-class counters)
+for c in ("interactive", "scavenger"):
+    assert rc.get(f"fleet_class_{c}_requests", 0) > 0, rc
+shed = {k: v for k, v in r["rejected"].items()
+        if k in ("infeasible_queue", "infeasible_deadline")}
+print("leg 9 ok:", r["answered"], "answered |",
+      {c: n for c, n in sorted(pr["responses_by_class"].items())},
+      "| interactive p99", round(by_cls["interactive"]["p99"], 1),
+      "ms <= 2500 ms through the kill | scavenger p99",
+      round(by_cls["scavenger"]["p99"], 1), "ms |",
+      pr["backfilled_responses"], "backfilled |",
+      rc["fleet_retries"], "retries - 0 lost |",
+      "feasibility sheds:", shed or 0)
 EOF
 
 echo "fleet smoke: ALL LEGS PASSED"

@@ -197,6 +197,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rate", type=float, default=0.0,
                    help="per-client requests/sec (0 = closed-loop as fast "
                         "as responses return)")
+    # ---- mixed-priority open-loop load (ISSUE 19) ----
+    p.add_argument("--priority-mix", default="", metavar="SPEC",
+                   help="per-class open-loop arrival rates as "
+                        "'interactive=40,scavenger=60' (total "
+                        "requests/s across the client pool; classes "
+                        "absent from the spec send nothing). Each "
+                        "request draws its class rate-weighted; the "
+                        "report breaks latency down per class")
+    p.add_argument("--class-slo-ms", default="", metavar="SPEC",
+                   help="HARD per-class p99 SLOs as 'interactive=250': "
+                        "the run fails (exit != 0) when a class's "
+                        "measured p99 exceeds its bound (needs "
+                        "--priority-mix)")
+    p.add_argument("--class-timeout-ms", default="", metavar="SPEC",
+                   help="per-class request deadlines (classes absent "
+                        "fall back to --timeout-ms)")
+    p.add_argument("--class-wait-ms", default="", metavar="SPEC",
+                   help="per-class batcher wait budgets, passed to the "
+                        "in-proc server / every fleet replica")
+    p.add_argument("--tenants", default="", metavar="SPEC",
+                   help="WFQ tenants as 'name=weight,...': each request "
+                        "carries a uniformly-drawn tenant; the weights "
+                        "ride to the in-proc server / fleet replicas")
+    p.add_argument("--no-backfill", action="store_true",
+                   help="disable padding-slack backfill on the in-proc "
+                        "server / fleet replicas (the A/B baseline)")
+    p.add_argument("--expect-backfill", action="store_true",
+                   help="fail unless lower-class responses actually "
+                        "rode a higher-class flush's padding slack")
     p.add_argument("--structures", type=int, default=512,
                    help="distinct synthetic structures to draw requests from")
     p.add_argument("--timeout-ms", type=float, default=30000.0,
@@ -366,6 +395,94 @@ class _ClientStats:
         self.flush_ids: set = set()
         # wire form -> responses ('raw' | 'featurized'; ISSUE 11)
         self.wire_responses: dict[str, int] = {}
+        # priority-class serving (ISSUE 19): per-class latencies (the
+        # per-class p99 SLO asserts read these), per-class and
+        # per-tenant answer counts, and answers that rode another
+        # class's padding slack
+        self.class_latencies: dict[str, list] = {}
+        self.class_responses: dict[str, int] = {}
+        self.tenant_responses: dict[str, int] = {}
+        self.backfilled = 0
+
+
+def _priority_plan(args) -> dict | None:
+    """The mixed-priority load plan from the flag specs (ISSUE 19):
+    rate-weighted class draw, per-class deadlines, tenant pool. None
+    when --priority-mix is off."""
+    from cgnn_tpu.serve.batcher import CLASSES, parse_kv_spec
+
+    if not args.priority_mix:
+        return None
+    rates = parse_kv_spec(args.priority_mix)
+    unknown = sorted(c for c in rates if c not in CLASSES)
+    if unknown:
+        raise SystemExit(
+            f"--priority-mix: unknown classes {unknown} "
+            f"(have: {list(CLASSES)})")
+    rates = {c: float(r) for c, r in rates.items() if r > 0}
+    if not rates:
+        raise SystemExit("--priority-mix: no class with a rate > 0")
+    total = sum(rates.values())
+    classes = sorted(rates, key=lambda c: -rates[c])
+    return {
+        "rates": rates,
+        "total": total,
+        "classes": classes,
+        "probs": [rates[c] / total for c in classes],
+        "timeouts": parse_kv_spec(args.class_timeout_ms),
+        "tenants": sorted(parse_kv_spec(args.tenants))
+        if args.tenants else [],
+    }
+
+
+def _draw_priority(plan: dict, rng) -> tuple[str, str | None]:
+    """One request's (class, tenant) draw: class rate-weighted,
+    tenant uniform over the pool (None without --tenants)."""
+    kl = plan["classes"][int(rng.choice(len(plan["classes"]),
+                                        p=plan["probs"]))]
+    tn = (plan["tenants"][int(rng.integers(len(plan["tenants"])))]
+          if plan["tenants"] else None)
+    return kl, tn
+
+
+def _note_priority_answer(stats: _ClientStats, klass: str,
+                          tenant: str | None, latency_ms: float,
+                          backfilled: bool) -> None:
+    """Record one answered request's class accounting. Caller holds
+    ``stats.lock``."""
+    stats.class_responses[klass] = (
+        stats.class_responses.get(klass, 0) + 1)
+    stats.class_latencies.setdefault(klass, []).append(
+        float(latency_ms))
+    if tenant:
+        stats.tenant_responses[tenant] = (
+            stats.tenant_responses.get(tenant, 0) + 1)
+    if backfilled:
+        stats.backfilled += 1
+
+
+def _priority_report(stats: _ClientStats, plan: dict) -> dict:
+    import numpy as np
+
+    with stats.lock:
+        by_cls = {c: list(v) for c, v in stats.class_latencies.items()}
+        out = {
+            "mix_rps": plan["rates"],
+            "responses_by_class": dict(sorted(
+                stats.class_responses.items())),
+            "responses_by_tenant": dict(sorted(
+                stats.tenant_responses.items())),
+            "backfilled_responses": stats.backfilled,
+        }
+    out["latency_ms_by_class"] = {
+        c: {
+            "p50": float(np.percentile(np.asarray(lat), 50)),
+            "p99": float(np.percentile(np.asarray(lat), 99)),
+            "count": len(lat),
+        }
+        for c, lat in sorted(by_cls.items()) if lat
+    }
+    return out
 
 
 def _measured_p99(stats: _ClientStats) -> float:
@@ -546,7 +663,7 @@ def _run_inproc(args) -> dict:
     import numpy as np
 
     from cgnn_tpu.observe import Telemetry
-    from cgnn_tpu.serve.batcher import ServeRejection
+    from cgnn_tpu.serve.batcher import ServeRejection, parse_kv_spec
     from cgnn_tpu.serve.server import load_server
 
     if args.telemetry != "off":
@@ -577,6 +694,12 @@ def _run_inproc(args) -> dict:
         watch=args.hot_swap,
         poll_interval_s=0.2,
         trace_ring=args.trace_ring,
+        # priority-class serving knobs (ISSUE 19)
+        class_max_wait_ms=(parse_kv_spec(args.class_wait_ms)
+                           if args.class_wait_ms else None),
+        backfill=not args.no_backfill,
+        wfq_weights=(parse_kv_spec(args.tenants)
+                     if args.tenants else None),
     )
     if args.profile_mid:
         server.enable_profiling(tempfile.mkdtemp(prefix="loadgen-prof-"))
@@ -597,10 +720,15 @@ def _run_inproc(args) -> dict:
 
     stats = _ClientStats()
     stop = threading.Event()
+    plan = _priority_plan(args)
 
     def client(ci: int):
         rng = np.random.default_rng(args.seed + ci)
         interval = 1.0 / args.rate if args.rate > 0 else 0.0
+        if plan is not None:
+            # open-loop mixed-priority load: the POOL sends plan.total
+            # rps, so each client paces at clients/total
+            interval = args.clients / plan["total"]
         tiers = [t.strip() for t in args.precision.split(",") if t.strip()]
         raw_share = {"featurized": 0.0, "mixed": 0.5, "raw": 1.0}[args.wire]
         while not stop.is_set():
@@ -613,13 +741,18 @@ def _run_inproc(args) -> dict:
             # real concurrency (a random draw can starve a tier on very
             # short runs — the smoke leg's duration covers it)
             tier = tiers[int(rng.integers(len(tiers)))] if tiers else None
+            kl = tn = None
+            timeout_ms = args.timeout_ms
+            if plan is not None:
+                kl, tn = _draw_priority(plan, rng)
+                timeout_ms = plan["timeouts"].get(kl, args.timeout_ms)
             t0 = time.monotonic()
             try:
                 with stats.lock:
                     stats.submitted += 1
-                fut = server.submit(g, timeout_ms=args.timeout_ms,
-                                    precision=tier)
-                res = fut.result(timeout=args.timeout_ms / 1000.0 + 60.0)
+                fut = server.submit(g, timeout_ms=timeout_ms,
+                                    precision=tier, klass=kl, tenant=tn)
+                res = fut.result(timeout=timeout_ms / 1000.0 + 60.0)
             except ServeRejection as e:
                 with stats.lock:
                     stats.rejected[e.reason] = (
@@ -661,6 +794,11 @@ def _run_inproc(args) -> dict:
                     stats.flush_ids.add(fid)
                 w = getattr(res, "wire", "featurized")
                 stats.wire_responses[w] = stats.wire_responses.get(w, 0) + 1
+                if plan is not None:
+                    _note_priority_answer(
+                        stats, getattr(res, "klass", "interactive"), tn,
+                        res.latency_ms,
+                        getattr(res, "backfilled", False))
                 if res.cached:
                     stats.cached += 1
                 else:
@@ -869,6 +1007,16 @@ def _run_inproc(args) -> dict:
         },
         "server_stats": server.stats(),
     }
+    if plan is not None:
+        report["priority"] = {
+            **_priority_report(stats, plan),
+            # the server's own backfill accounting (numerator over the
+            # slack the higher-class flushes offered)
+            "padding_fill_share": report["server_stats"]["priority"][
+                "padding_fill_share"],
+            "backfill_enabled": report["server_stats"]["priority"][
+                "backfill"],
+        }
     if scrape_result:
         report["metrics_scrape"] = {
             "at_s": scrape_result["at_s"],
@@ -967,6 +1115,13 @@ def _run_fleet(args) -> dict:
         "--poll-interval", "0.5",
         "--drain-timeout", "30",
     ]
+    # priority-class serving knobs (ISSUE 19) ride to every replica
+    if args.class_wait_ms:
+        serve_args += ["--class-wait-ms", args.class_wait_ms]
+    if args.no_backfill:
+        serve_args += ["--no-backfill"]
+    if args.tenants:
+        serve_args += ["--wfq-weights", args.tenants]
     if args.autoscale or args.remediate:
         # drain with the listener up, then linger past a health-probe
         # round (0.5 s here) so the router OBSERVES the draining flag
@@ -1189,6 +1344,8 @@ def _run_fleet(args) -> dict:
             return peak
         return max(lo * 0.5, 0.5)
 
+    plan = _priority_plan(args)
+
     def client(ci: int):
         import numpy as _np
 
@@ -1200,13 +1357,25 @@ def _run_fleet(args) -> dict:
                                                           1e-9)
                 rate = _ramp_rate(min(frac, 1.0))
                 t_pace = time.monotonic() + args.clients / max(rate, 0.1)
+            elif plan is not None:
+                # open-loop mixed-priority load at plan.total rps
+                t_pace = (time.monotonic()
+                          + args.clients / max(plan["total"], 0.1))
             bi = int(rng.integers(len(bodies)))
             body = bodies[bi]
+            kl = tn = None
+            timeout_ms = args.timeout_ms
+            if plan is not None:
+                kl, tn = _draw_priority(plan, rng)
+                timeout_ms = plan["timeouts"].get(kl, args.timeout_ms)
+                body = dict(body, **{"class": kl})
+                if tn:
+                    body["tenant"] = tn
             with stats.lock:
                 stats.submitted += 1
             try:
                 status, payload, meta_d = router.dispatch(
-                    dict(body), timeout_ms=args.timeout_ms)
+                    dict(body), timeout_ms=timeout_ms)
             except Exception as e:  # noqa: BLE001 — report, don't die
                 with stats.lock:
                     stats.errors.append(repr(e))
@@ -1237,6 +1406,13 @@ def _run_fleet(args) -> dict:
                         fleet_counts["hedged_answers"] += 1
                     if meta_d["retries"]:
                         fleet_counts["retried_answers"] += 1
+                    if plan is not None:
+                        _note_priority_answer(
+                            stats,
+                            str(payload.get("class") or kl
+                                or "interactive"),
+                            tn, float(meta_d["latency_ms"]),
+                            bool(payload.get("backfilled")))
                 else:
                     reason = (payload or {}).get("reason", str(status))
                     stats.rejected[reason] = (
@@ -1793,6 +1969,8 @@ def _run_fleet(args) -> dict:
             "observe": observe_report,
         },
     }
+    if plan is not None:
+        report["priority"] = _priority_report(stats, plan)
     if scrape:
         report["fleet"]["metrics_scrape"] = scrape
     if slo_report:
@@ -2102,6 +2280,11 @@ def main(argv=None) -> int:
         print("--continual needs the flight recorder (--trace-ring > 0)",
               file=sys.stderr)
         return 2
+    if args.priority_mix and args.http:
+        print("--priority-mix drives the in-proc or --fleet modes "
+              "(the bare --http leg has no class accounting)",
+              file=sys.stderr)
+        return 2
 
     if args.fleet:
         report = _run_fleet(args)
@@ -2235,11 +2418,20 @@ def main(argv=None) -> int:
         fl = report["fleet"]
         rc = fl["router"]["counts"]
         chaos = fl["chaos"]
-        if report["rejected"]:
+        hard_rejects = dict(report["rejected"])
+        if args.priority_mix:
+            # deadline-feasibility sheds (ISSUE 19) are load shedding,
+            # not loss (INVARIANTS.md): under a mixed-priority leg the
+            # router MAY 429/504 an infeasible request before it
+            # crosses a process boundary — the exactly-once ledger
+            # still closes (shed requests are typed rejections)
+            for reason in ("infeasible_queue", "infeasible_deadline"):
+                hard_rejects.pop(reason, None)
+        if hard_rejects:
             failures.append(
                 f"fleet rejected requests (with {args.fleet} replicas "
                 f"and retries these legs must answer everything): "
-                f"{report['rejected']}"
+                f"{hard_rejects}"
             )
         if rc.get("fleet_exhausted"):
             failures.append(
@@ -2615,6 +2807,39 @@ def main(argv=None) -> int:
                             "no flight-recorder bundle manifest names "
                             "an slo_burn_* trigger reason"
                         )
+    if args.priority_mix:
+        # ---- the mixed-priority invariants (ISSUE 19), all HARD ----
+        from cgnn_tpu.serve.batcher import parse_kv_spec
+
+        pr = report.get("priority", {})
+        by_cls = pr.get("latency_ms_by_class", {})
+        plan = _priority_plan(args)
+        for c in sorted(plan["rates"]):
+            if not pr.get("responses_by_class", {}).get(c):
+                failures.append(
+                    f"priority class {c!r} sent load but answered "
+                    f"nothing: {pr.get('responses_by_class')}")
+        for c, bound in sorted(parse_kv_spec(args.class_slo_ms).items()):
+            got = by_cls.get(c, {}).get("p99")
+            if got is None:
+                failures.append(
+                    f"--class-slo-ms names {c!r} but no latency was "
+                    f"measured for it")
+            elif got > bound:
+                failures.append(
+                    f"class {c!r} p99 {got:.1f} ms exceeds its "
+                    f"{bound:.0f} ms SLO "
+                    f"(over {by_cls[c]['count']} answers)")
+        if args.expect_backfill:
+            if not pr.get("backfilled_responses"):
+                failures.append(
+                    "--expect-backfill: no response ever rode a "
+                    "higher-class flush's padding slack")
+            if (not args.fleet
+                    and not pr.get("padding_fill_share", 0.0) > 0.0):
+                failures.append(
+                    f"--expect-backfill: serve_padding_fill_share is "
+                    f"{pr.get('padding_fill_share')} (must be > 0)")
     # racecheck leg (CGNN_TPU_RACECHECK=1): the runtime lock-discipline
     # report rides the SLO report and fails the run like any other
     # invariant — zero lock-order inversions, zero unguarded shared-field

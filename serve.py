@@ -30,6 +30,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shape-ladder depth (compile count at warmup)")
     p.add_argument("--max-wait-ms", type=float, default=5.0,
                    help="micro-batch flush deadline")
+    p.add_argument("--class-wait-ms", default="",
+                   help="per-priority-class flush budgets, e.g. "
+                        "'batch=20,scavenger=80' (ms; unlisted classes "
+                        "keep the defaults: interactive=1x, batch=4x, "
+                        "scavenger=16x --max-wait-ms)")
+    p.add_argument("--no-backfill", action="store_true",
+                   help="disable padding-slack backfill (lower-class "
+                        "requests riding a higher-class flush's spare "
+                        "graph/node/edge slots; the A/B baseline)")
+    p.add_argument("--wfq-weights", default="",
+                   help="weighted-fair-queuing tenant weights, e.g. "
+                        "'acme=4,guest=1' (unlisted tenants weigh 1)")
+    p.add_argument("--class-slo-ms", default="",
+                   help="per-class p95 latency SLO objectives, e.g. "
+                        "'interactive=250,batch=2000' — adds a "
+                        "class-scoped latency objective per entry")
     p.add_argument("--max-queue", type=int, default=256,
                    help="admission bound (backpressure: reject above this)")
     p.add_argument("--timeout-ms", type=float, default=1000.0,
@@ -182,6 +198,7 @@ def main(argv=None) -> int:
 
     from cgnn_tpu.observe import Telemetry, json_log_fn
     from cgnn_tpu.serve.http import make_http_server
+    from cgnn_tpu.serve.batcher import parse_kv_spec
     from cgnn_tpu.serve.server import load_server
 
     # one logging sink for everything this process prints: JSON lines
@@ -215,6 +232,16 @@ def main(argv=None) -> int:
                          latency_threshold_ms=args.slo_latency_ms,
                          window_s=args.slo_window),
         )
+        if args.class_slo_ms:
+            # class-scoped objectives (ISSUE 19): only events of the
+            # matching priority class feed these windows, so a slow
+            # scavenger backlog cannot burn the interactive budget
+            slo_objectives += tuple(
+                SLOObjective(f"latency_{kl}", target=0.95,
+                             latency_threshold_ms=ms,
+                             window_s=args.slo_window, klass=kl)
+                for kl, ms in parse_kv_spec(args.class_slo_ms).items()
+            )
         if args.slo_fast_s is not None and args.slo_slow_s is not None:
             slo_rules = (BurnRateRule(
                 fast_s=args.slo_fast_s, slow_s=args.slo_slow_s,
@@ -229,6 +256,11 @@ def main(argv=None) -> int:
             telemetry=telemetry,
             max_queue=args.max_queue,
             max_wait_ms=args.max_wait_ms,
+            class_max_wait_ms=(parse_kv_spec(args.class_wait_ms)
+                               if args.class_wait_ms else None),
+            backfill=not args.no_backfill,
+            wfq_weights=(parse_kv_spec(args.wfq_weights)
+                         if args.wfq_weights else None),
             default_timeout_ms=args.timeout_ms or None,
             cache_size=args.cache_size,
             compact=args.compact,

@@ -1,0 +1,482 @@
+"""Priority-class serving (ISSUE 19): the flush-cut (class, tier, form)
+triple, padding-slack backfill, WFQ tenant fairness, scavenger
+starvation-freedom, and deadline-feasibility admission at the router.
+
+Batcher tests drive ``poll`` with a fake clock (the synchronously
+testable core); the server-level test checks the backfill accounting
+(padding fill share, per-class responses) survives the real worker
+thread with zero post-warmup recompiles; router tests use fake
+transports + probed ReplicaStates so the feasibility gate is exercised
+without sockets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from cgnn_tpu.config import DataConfig, ModelConfig, build_model
+from cgnn_tpu.data.dataset import FeaturizeConfig, load_synthetic
+from cgnn_tpu.fleet.replica import ReplicaState
+from cgnn_tpu.fleet.router import FleetRouter
+from cgnn_tpu.observe.slo import SLOEngine, SLOObjective
+from cgnn_tpu.serve.batcher import (
+    CLASSES,
+    DEFAULT_CLASS,
+    MALFORMED,
+    MicroBatcher,
+    Request,
+    ServeRejection,
+    parse_kv_spec,
+)
+from cgnn_tpu.serve.server import InferenceServer
+from cgnn_tpu.serve.shapes import BatchShape, ShapeSet, plan_shape_set
+
+CFG = FeaturizeConfig(radius=5.0, max_num_nbr=8)
+
+
+@pytest.fixture(scope="module")
+def graphs():
+    return load_synthetic(48, CFG, seed=11, max_atoms=8)
+
+
+@pytest.fixture(scope="module")
+def shape_set(graphs):
+    return plan_shape_set(graphs, 8, rungs=2)
+
+
+@pytest.fixture(scope="module")
+def model_state(graphs, shape_set):
+    from cgnn_tpu.train import (
+        Normalizer,
+        create_train_state,
+        make_optimizer,
+    )
+
+    model_cfg = ModelConfig(atom_fea_len=8, n_conv=1, h_fea_len=16)
+    model = build_model(model_cfg, DataConfig(radius=5.0, max_num_nbr=8))
+    state = create_train_state(
+        model, shape_set.pack([graphs[0]]), make_optimizer(),
+        Normalizer.fit(np.stack([g.target for g in graphs])),
+        rng=jax.random.key(7),
+    )
+    return model_cfg, state
+
+
+def _tiny_shape_set() -> ShapeSet:
+    return ShapeSet([BatchShape(4, 64, 512), BatchShape(8, 128, 1024)])
+
+
+def _request(graph, now=0.0, deadline=None, klass=DEFAULT_CLASS,
+             tenant="", form="feat", precision="f32") -> Request:
+    return Request(graph=graph, enqueued=now, deadline=deadline,
+                   klass=klass, tenant=tenant, form=form,
+                   precision=precision)
+
+
+# ------------------------------------------------------ flush-cut triple
+
+
+class TestClassCut:
+    def test_unknown_class_is_malformed(self, graphs):
+        b = MicroBatcher(_tiny_shape_set(), clock=lambda: 0.0)
+        with pytest.raises(ServeRejection) as e:
+            b.offer(_request(graphs[0], klass="vip"))
+        assert e.value.reason == MALFORMED
+        assert b.depth == 0
+
+    def test_head_class_preempts_lower_class_fifo_order(self, graphs):
+        """A scavenger arriving FIRST does not hold the head of the
+        queue: the flush is cut for the highest class present."""
+        b = MicroBatcher(_tiny_shape_set(), max_wait_ms=1000.0,
+                         backfill=False, clock=lambda: 0.0)
+        b.offer(_request(graphs[0], now=0.0, klass="scavenger"))
+        for g in graphs[1:9]:
+            b.offer(_request(g, now=0.0, klass="interactive"))
+        flush = b.poll(now=0.0)
+        assert flush is not None and flush.reason == "shape_full"
+        assert flush.klass == "interactive"
+        assert all(r.klass == "interactive" for r in flush.requests)
+        assert b.depth == 1  # the scavenger is still queued, not dropped
+
+    def test_cut_key_is_class_tier_form_triple(self, graphs):
+        """Within the head class a tier/form change is a batch boundary
+        (one program per flush) — but a LOWER class sharing the head's
+        (tier, form) is NOT a boundary: it backfills instead."""
+        b = MicroBatcher(_tiny_shape_set(), max_wait_ms=1000.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(graphs[0], klass="interactive", precision="f32"))
+        b.offer(_request(graphs[1], klass="interactive", precision="bf16"))
+        b.offer(_request(graphs[2], klass="scavenger", precision="f32"))
+        flush = b.poll(now=1000.0)  # way past every wait budget
+        assert flush.reason == "tier_boundary"
+        assert flush.klass == "interactive"
+        assert flush.precision == "f32"
+        # the f32 scavenger rode the head's slack; the bf16 interactive
+        # request starts the NEXT batch
+        assert [r.precision for r in flush.requests] == ["f32", "f32"]
+        assert flush.requests[1].klass == "scavenger"
+        assert flush.requests[1].backfilled
+        assert b.depth == 1
+
+    def test_default_class_single_tenant_keeps_legacy_fifo(self, graphs):
+        """No classes, no tenants -> the legacy batcher behavior
+        exactly (WFQ degenerates to FIFO, aging to flush-on-deadline)."""
+        b = MicroBatcher(_tiny_shape_set(), max_wait_ms=50.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(graphs[0], now=0.0))
+        assert b.poll(now=0.049) is None
+        flush = b.poll(now=0.051)
+        assert flush.reason == "deadline"
+        assert [r.graph for r in flush.requests] == [graphs[0]]
+
+    def test_class_wait_override_validation(self, graphs):
+        with pytest.raises(ValueError, match="unknown priority class"):
+            MicroBatcher(_tiny_shape_set(),
+                         class_max_wait_ms={"vip": 1.0})
+        with pytest.raises(ValueError, match="must be > 0"):
+            MicroBatcher(_tiny_shape_set(), wfq_weights={"t": 0.0})
+
+    def test_parse_kv_spec_grammar(self):
+        assert parse_kv_spec("") == {}
+        assert parse_kv_spec("interactive=50,batch=200") == {
+            "interactive": 50.0, "batch": 200.0}
+        with pytest.raises(ValueError, match="malformed spec entry"):
+            parse_kv_spec("interactive")
+
+
+# ------------------------------------------------------------- backfill
+
+
+class TestBackfill:
+    def test_backfill_fills_slack_same_shape_same_time(self, graphs):
+        """Backfill converts padding into goodput: the rung chosen for
+        the head prefix is unchanged, the flush fires at the same poll
+        time as with backfill off, and the scavengers ride marked."""
+        clk = [0.0]
+        mk = lambda on: MicroBatcher(  # noqa: E731 — two twin batchers
+            _tiny_shape_set(), max_wait_ms=50.0, backfill=on,
+            clock=lambda: clk[0])
+        on, off = mk(True), mk(False)
+        for b in (on, off):
+            b.offer(_request(graphs[0], now=0.0, klass="interactive"))
+            b.offer(_request(graphs[1], now=0.0, klass="scavenger"))
+            b.offer(_request(graphs[2], now=0.0, klass="scavenger"))
+            assert b.poll(now=0.049) is None  # neither fires early
+        f_on, f_off = on.poll(now=0.051), off.poll(now=0.051)
+        assert f_on.reason == f_off.reason == "deadline"
+        assert f_on.klass == f_off.klass == "interactive"
+        # same head -> same rung; backfill never upgrades the shape
+        assert f_on.shape == f_off.shape
+        assert len(f_off.requests) == 1 and f_off.n_backfilled == 0
+        assert len(f_on.requests) == 3 and f_on.n_backfilled == 2
+        assert [r.backfilled for r in f_on.requests] == [
+            False, True, True]
+        assert f_on.slack_slots == f_on.shape.graph_cap - 1
+        assert on.backfilled_total == 2
+        assert on.slack_total == f_on.slack_slots
+        assert on.depth == 0 and off.depth == 2
+
+    def test_backfill_requires_matching_tier_and_form(self, graphs):
+        """A lower-class request in a different (tier, form) cannot ride
+        — the flush runs ONE program."""
+        b = MicroBatcher(_tiny_shape_set(), max_wait_ms=50.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(graphs[0], now=0.0, klass="interactive"))
+        b.offer(_request(graphs[1], now=0.0, klass="scavenger",
+                         precision="bf16"))
+        flush = b.poll(now=0.051)
+        assert len(flush.requests) == 1 and flush.n_backfilled == 0
+        assert b.depth == 1
+
+    def test_backfill_skips_expired_and_nonfitting(self, graphs):
+        """An expired candidate never rides (the client gave up); a
+        too-big candidate stays queued while smaller ones still fit."""
+        small = sorted(graphs, key=lambda g: g.num_nodes)
+        b = MicroBatcher(_tiny_shape_set(), max_wait_ms=50.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(small[0], now=0.0, klass="interactive"))
+        b.offer(_request(small[1], now=0.0, klass="scavenger",
+                         deadline=0.01))  # expired by flush time
+        b.offer(_request(small[2], now=0.0, klass="scavenger"))
+        flush = b.poll(now=0.051)
+        assert flush.n_backfilled == 1
+        assert flush.requests[1].graph is small[2]
+        assert [r.graph for r in flush.expired] == [small[1]]
+
+    def test_backfill_prefers_higher_class_among_lower(self, graphs):
+        """batch outranks scavenger for the same slack."""
+        b = MicroBatcher(_tiny_shape_set(), max_wait_ms=50.0,
+                         clock=lambda: 0.0)
+        b.offer(_request(graphs[0], now=0.0, klass="interactive"))
+        b.offer(_request(graphs[1], now=0.0, klass="scavenger"))
+        b.offer(_request(graphs[2], now=0.0, klass="batch"))
+        flush = b.poll(now=0.051)
+        ridden = [r.klass for r in flush.requests[1:]]
+        assert ridden[0] == "batch"
+
+
+# ------------------------------------------------- fairness / starvation
+
+
+def _wfq_shares(graphs, weights, backlogs, rounds=24):
+    """Serve ``rounds`` shape-full flushes while every tenant stays
+    individually backlogged (its queue refilled to ``backlogs[t]``
+    before each cut) -> served counts per tenant. WFQ's share contract
+    only binds while a tenant HAS work queued; a tenant limited by its
+    own arrival rate keeps its shortfall, it is not owed credit."""
+    b = MicroBatcher(_tiny_shape_set(), max_queue=512,
+                     max_wait_ms=1000.0, backfill=False,
+                     wfq_weights=weights, clock=lambda: 0.0)
+    gi = iter(graphs * 200)
+    queued = {t: 0 for t in backlogs}
+    served = {t: 0 for t in backlogs}
+    for _ in range(rounds):
+        for t, depth in backlogs.items():
+            while queued[t] < depth:
+                b.offer(_request(next(gi), now=0.0, tenant=t))
+                queued[t] += 1
+        flush = b.poll(now=0.0)
+        assert flush is not None and flush.reason == "shape_full"
+        for r in flush.requests:
+            served[r.tenant] += 1
+            queued[r.tenant] -= 1
+    return served
+
+
+class TestFairness:
+    def test_wfq_share_converges_to_weights(self, graphs):
+        """Tenants weighted 2:1, both backlogged, converge to a 2:1
+        served share (cost 1 per request)."""
+        served = _wfq_shares(graphs, {"a": 2.0, "b": 1.0},
+                             {"a": 12, "b": 12})
+        ratio = served["a"] / max(served["b"], 1)
+        assert 1.8 <= ratio <= 2.2, served
+
+    def test_unweighted_tenants_share_equally(self, graphs):
+        """A tenant with a 3x deeper backlog gets no more than its
+        weight's share — under FIFO it would take ~3x."""
+        served = _wfq_shares(graphs, {}, {"x": 18, "y": 6})
+        ratio = served["x"] / max(served["y"], 1)
+        assert 0.8 <= ratio <= 1.25, served
+
+    def test_scavenger_starvation_freedom_under_interactive_load(
+            self, graphs):
+        """Sustained interactive saturation cannot pin a scavenger
+        forever: once it ages past its own class wait budget it gets
+        its OWN flush (aging, not backfill — a different form here
+        blocks riding along)."""
+        b = MicroBatcher(_tiny_shape_set(), max_wait_ms=10.0,
+                         clock=lambda: 0.0)  # scavenger budget: 160 ms
+        b.offer(_request(graphs[0], now=0.0, klass="scavenger",
+                         precision="bf16"))
+        gi = iter(graphs[1:] * 20)
+        now = 0.0
+        saw_scavenger = None
+        for step in range(40):
+            now = step * 0.005
+            while b.depth < 12:  # interactive firehose
+                b.offer(_request(next(gi), now=now, klass="interactive"))
+            flush = b.poll(now=now)
+            if flush and flush.klass == "scavenger":
+                saw_scavenger = (now, flush)
+                break
+        assert saw_scavenger is not None, "scavenger starved"
+        at, flush = saw_scavenger
+        # it fired via aging once overdue — not before its own budget,
+        # not unboundedly later
+        assert b.class_wait["scavenger"] <= at <= 2 * b.class_wait[
+            "scavenger"]
+        assert flush.requests[0].graph is graphs[0]
+        assert flush.reason == "deadline"
+
+    def test_backfill_never_delays_interactive_flush(self, graphs):
+        """With a scavenger backlog present, the interactive deadline
+        flush still fires exactly at max_wait — backfill runs AFTER the
+        fire decision."""
+        b = MicroBatcher(_tiny_shape_set(), max_wait_ms=50.0,
+                         clock=lambda: 0.0)
+        for g in graphs[1:4]:
+            b.offer(_request(g, now=0.0, klass="scavenger"))
+        b.offer(_request(graphs[0], now=0.02, klass="interactive"))
+        assert b.poll(now=0.069) is None  # 49 ms: under the budget
+        flush = b.poll(now=0.071)  # 51 ms: fires, carrying scavengers
+        assert flush.reason == "deadline"
+        assert flush.klass == "interactive"
+        assert flush.n_backfilled > 0
+
+
+# ----------------------------------------------------- server end to end
+
+
+class TestServerPriority:
+    def test_mixed_class_serving_accounts_backfill(
+            self, graphs, shape_set, model_state):
+        _, state = model_state
+        server = InferenceServer(
+            state, shape_set, cache_size=0, max_wait_ms=10.0,
+            log_fn=lambda *a, **k: None)
+        server.warm(graphs[0])
+        server.start()
+        futs = [server.submit(g, klass="scavenger")
+                for g in graphs[1:4]]
+        futs.append(server.submit(graphs[4], klass="interactive"))
+        for f in futs:
+            assert f.result(timeout=30.0).prediction is not None
+        assert server.drain(timeout_s=30.0)
+        stats = server.stats()
+        pr = stats["priority"]
+        assert pr["backfill"] is True
+        assert pr["responses_by_class"]["interactive"] == 1
+        assert pr["responses_by_class"]["scavenger"] == 3
+        assert pr["backfilled_responses"] >= 1
+        assert pr["padding_fill_share"] > 0.0
+        assert stats["recompiles_after_warm"] == 0
+        # the per-class latency family made it to the scrape
+        text = server.registry.prometheus_text()
+        assert 'serve_class_latency_ms_hist' in text
+        assert 'class="interactive"' in text
+        assert "serve_padding_fill_share" in text
+
+    def test_unknown_class_rejected_at_submit(self, graphs, shape_set,
+                                              model_state):
+        _, state = model_state
+        server = InferenceServer(
+            state, shape_set, cache_size=0, max_wait_ms=10.0,
+            log_fn=lambda *a, **k: None)
+        with pytest.raises(ServeRejection) as e:
+            server.submit(graphs[0], klass="vip")
+        assert e.value.reason == MALFORMED
+        assert server.counts["reject_malformed"] == 1
+
+
+# --------------------------------------------- feasibility at the router
+
+
+def _probed_replica(rid: int, *, p99_ms=None, queue_depth=0.0
+                    ) -> ReplicaState:
+    r = ReplicaState(rid, f"http://127.0.0.1:{9100 + rid}")
+    r.note_probe(ready=True, queue_depth=queue_depth, p99_ms=p99_ms)
+    return r
+
+
+def _counting_transport(calls):
+    def transport(replica, body, timeout_s):
+        calls.append(replica.rid)
+        return 200, {"param_version": "v1", "prediction": [0.0],
+                     "latency_ms": 1.0}
+    return transport
+
+
+def _router(replicas, transport, **kw):
+    kw.setdefault("backoff_ms", 1.0)
+    kw.setdefault("log_fn", lambda *a: None)
+    return FleetRouter(replicas, transport=transport, **kw)
+
+
+class TestFeasibilityAdmission:
+    def test_p99_floor_above_deadline_sheds_504(self):
+        calls = []
+        router = _router([_probed_replica(0, p99_ms=500.0)],
+                         _counting_transport(calls))
+        status, payload, meta = router.dispatch({"graph": {}},
+                                                timeout_ms=100.0)
+        assert status == 504
+        assert payload["reason"] == "infeasible_deadline"
+        assert payload["retry_after_s"] >= 1.0
+        assert meta["retry_after_s"] == payload["retry_after_s"]
+        assert calls == []  # never crossed a process boundary
+        assert router.counts["fleet_infeasible_deadline"] == 1
+
+    def test_queue_congestion_sheds_429_with_drain_hint(self):
+        calls = []
+        # floor (50 ms) fits the deadline; the queue does not:
+        # est = 50 * (1 + 80/8) = 550 ms > 100 ms
+        router = _router(
+            [_probed_replica(0, p99_ms=50.0, queue_depth=80.0)],
+            _counting_transport(calls))
+        status, payload, _ = router.dispatch({"graph": {}},
+                                             timeout_ms=100.0)
+        assert status == 429
+        assert payload["reason"] == "infeasible_queue"
+        assert calls == []
+        assert router.counts["fleet_infeasible_queue"] == 1
+
+    def test_retry_after_scales_with_measured_congestion(self):
+        """The PR bugfix: Retry-After reflects the queue drain estimate,
+        not just breaker cooldowns (none are open here)."""
+        router = _router(
+            [_probed_replica(0, p99_ms=2000.0, queue_depth=40.0)],
+            _counting_transport([]))
+        # est = 2000 * (1 + 40/8) = 12 s
+        assert router._retry_after_s() == pytest.approx(12.0)
+        idle = _router([_probed_replica(0, p99_ms=100.0)],
+                       _counting_transport([]))
+        assert idle._retry_after_s() == 1.0  # clamped floor
+
+    def test_cold_fleet_admits_without_p99(self):
+        """Feasibility is an optimisation on a warmed fleet, not a gate
+        that sheds a cold start."""
+        calls = []
+        router = _router([_probed_replica(0)],  # no p99 sample yet
+                         _counting_transport(calls))
+        status, _, _ = router.dispatch({"graph": {}}, timeout_ms=100.0)
+        assert status == 200 and calls == [0]
+
+    def test_best_replica_feasible_admits(self):
+        """One saturated replica does not shed while a sibling can
+        still make the deadline."""
+        calls = []
+        router = _router(
+            [_probed_replica(0, p99_ms=50.0, queue_depth=500.0),
+             _probed_replica(1, p99_ms=50.0, queue_depth=0.0)],
+            _counting_transport(calls))
+        status, _, _ = router.dispatch({"graph": {}}, timeout_ms=200.0)
+        assert status == 200 and calls == [1]
+
+    def test_gate_respects_flag_and_margin(self):
+        calls = []
+        off = _router([_probed_replica(0, p99_ms=500.0)],
+                      _counting_transport(calls), feasibility=False)
+        assert off.dispatch({"graph": {}}, timeout_ms=100.0)[0] == 200
+        roomy = _router([_probed_replica(0, p99_ms=500.0)],
+                        _counting_transport(calls),
+                        feasibility_margin=10.0)
+        assert roomy.dispatch({"graph": {}}, timeout_ms=100.0)[0] == 200
+        with pytest.raises(ValueError, match="feasibility_margin"):
+            _router([_probed_replica(0)], _counting_transport([]),
+                    feasibility_margin=0.0)
+
+    def test_class_label_counted_through_router(self):
+        router = _router([_probed_replica(0)], _counting_transport([]))
+        status, _, _ = router.dispatch(
+            {"graph": {}, "class": "scavenger"}, timeout_ms=1000.0)
+        assert status == 200
+        assert router.counts["fleet_class_scavenger_requests"] == 1
+        assert router.counts["fleet_class_scavenger_answered"] == 1
+
+
+# ------------------------------------------------------- class-scoped SLO
+
+
+class TestClassScopedSLO:
+    def test_objective_sees_only_its_class(self):
+        eng = SLOEngine(
+            [SLOObjective("lat_interactive", target=0.9,
+                          latency_threshold_ms=100.0, window_s=60.0,
+                          klass="interactive"),
+             SLOObjective("lat_all", target=0.9,
+                          latency_threshold_ms=100.0, window_s=60.0)],
+            clock=lambda: 0.0)
+        # a slow scavenger answer must not burn the interactive budget
+        eng.record(True, 5000.0, now=1.0, klass="scavenger")
+        assert eng.burn_rate("lat_interactive", 60.0, now=1.0) == 0.0
+        assert eng.burn_rate("lat_all", 60.0, now=1.0) > 0.0
+        eng.record(True, 5000.0, now=2.0, klass="interactive")
+        assert eng.burn_rate("lat_interactive", 60.0, now=2.0) > 0.0
+
+    def test_classes_are_stable_wire_strings(self):
+        assert CLASSES == ("interactive", "batch", "scavenger")
+        assert DEFAULT_CLASS == "interactive"
