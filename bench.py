@@ -232,7 +232,7 @@ def _bench_force_workload(graphs, batch_size, *, dense_m=None, n_timed=16,
 # artifact reports PAIRED per-round ratios, which is what kills the
 # bench-link noise that muddied the r3->r5 trajectory.
 AB_FLAGS = ("cgconv", "fused-epilogue", "transpose", "compact", "precision",
-            "engine", "wire", "observe", "slo", "backfill")
+            "engine", "wire", "observe", "slo", "backfill", "cachepart")
 
 
 def _ab_train_variants(flag: str, graphs, batch_size, buckets):
@@ -353,6 +353,8 @@ def _run_ab(flag: str, *, n: int, batch_size: int, buckets: int,
         return _run_ab_slo(graphs, batch_size, rounds)
     if flag == "backfill":
         return _run_ab_backfill(graphs, batch_size, rounds)
+    if flag == "cachepart":
+        return _run_ab_cachepart(graphs, batch_size, rounds)
     variants = _ab_train_variants(flag, graphs, batch_size, buckets)
 
     def set_transpose(v):
@@ -885,6 +887,200 @@ def _run_ab_backfill(graphs, batch_size, rounds) -> dict:
         "recompiles_after_warm": {
             "backfill": stats_on["recompiles_after_warm"],
             "no-backfill": stats_off["recompiles_after_warm"]},
+        "device": str(jax.devices()[0].device_kind),
+    })
+
+
+def _run_ab_cachepart(graphs, batch_size, rounds) -> dict:
+    """Serving-path A/B of the one-fleet-cache layer (ISSUE 20):
+    consistent-hash cache partitioning + single-flight coalescing vs
+    the replicated baseline, over a 3-replica fleet of in-process
+    InferenceServers with per-replica cache capacity FIXED.
+
+    The workload is the regime partitioning exists for: a Zipf-drawn
+    hot keyset WIDER than any one replica's cache (so the replicated
+    fleet thrashes its three identical LRUs while the partitioned
+    fleet's union holds everything), punctuated by cold-key stampede
+    BURSTS (many concurrent requests for one never-seen structure —
+    the thundering herd that coalescing collapses to one compute).
+    Routing is the only difference: 'replicated' round-robins with
+    per-replica single-flight OFF (the pre-ISSUE-20 fleet), 'cachepart'
+    sends each fingerprint to its CacheRing owner with single-flight
+    ON. The headline is the fleet-wide EFFECTIVE hit ratio — answers
+    served without a fresh model compute, (cache_hits + coalesced) /
+    requests — and the bench hard-asserts zero duplicate in-flight
+    misses under the partitioned stampede and bit-identical prediction
+    bytes per key across both variants."""
+    import hashlib
+    import threading
+
+    import jax
+    import numpy as np
+
+    from cgnn_tpu.fleet.cachering import CacheRing
+    from cgnn_tpu.models import CrystalGraphConvNet
+    from cgnn_tpu.serve.cache import structure_fingerprint
+    from cgnn_tpu.serve.server import InferenceServer
+    from cgnn_tpu.serve.shapes import plan_shape_set
+    from cgnn_tpu.train import Normalizer, create_train_state, make_optimizer
+    from cgnn_tpu.train.step import make_predict_step
+
+    batch_size = min(batch_size, 64)
+    model = CrystalGraphConvNet(atom_fea_len=64, n_conv=3, h_fea_len=128,
+                                dense_m=12)
+    ladder = plan_shape_set(graphs, batch_size, rungs=3, dense_m=12)
+    state = create_train_state(
+        model, ladder.pack_full([graphs[0]]),
+        make_optimizer(optim="sgd", lr=0.01, lr_milestones=[10**9]),
+        Normalizer.fit(np.stack([np.array(g.target) for g in graphs])),
+    )
+    pstep = jax.jit(make_predict_step())
+    pool = [g for g in graphs if ladder.admits(g)][:512]
+
+    # the keyspace: a hot Zipf set wider than one replica's cache but
+    # narrower than the fleet's union, plus a disjoint cold-key stream
+    # for the stampede bursts (each burst key is seen exactly once per
+    # variant — a guaranteed herd on a guaranteed miss)
+    n_fleet, cache_cap, hot_n = 3, 64, 96
+    hot = pool[:hot_n]
+    cold = pool[hot_n:]
+    hot_fps = [structure_fingerprint(g) for g in hot]
+    cold_fps = [structure_fingerprint(g) for g in cold]
+    zipf_p = np.array([1.0 / (i + 1) ** 1.1 for i in range(hot_n)])
+    zipf_p /= zipf_p.sum()
+    n_bursts, burst_fan, n_singles = 8, 24, 128
+
+    def build_fleet(single_flight: bool) -> list:
+        fleet = []
+        for _ in range(n_fleet):
+            s = InferenceServer(
+                state, ladder, predict_step=pstep, cache_size=cache_cap,
+                max_queue=8192, pack_workers=0, trace_ring=0,
+                max_wait_ms=5.0, single_flight=single_flight,
+                log_fn=lambda *a, **k: None,
+            )
+            s.warm(pool[0])
+            s.start()
+            fleet.append(s)
+        return fleet
+
+    ring = CacheRing(range(n_fleet))
+    fleets = {"replicated": build_fleet(False),
+              "cachepart": build_fleet(True)}
+    rr = {"n": 0}
+
+    def route(name: str, g, fp: str):
+        # the ONLY difference between the variants: who gets the key.
+        # The fingerprint is hashed once here at the 'edge' and rides
+        # the submit (satellite: hash once per request)
+        if name == "cachepart":
+            server = fleets[name][ring.owner(fp)]
+        else:
+            server = fleets[name][rr["n"] % n_fleet]
+            rr["n"] += 1
+        return server.submit(g, timeout_ms=600000.0, fingerprint=fp)
+
+    def fleet_counts(name: str) -> dict:
+        tot: dict = {}
+        for s in fleets[name]:
+            for k, v in s.stats()["counts"].items():
+                tot[k] = tot.get(k, 0) + v
+        return tot
+
+    preds: dict = {n: {} for n in fleets}
+
+    def note(name, fp, fut):
+        row = np.asarray(fut.result(timeout=600.0).prediction)
+        preds[name].setdefault(fp, row)
+
+    def drive(name: str, r: int, zipf_draws, burst_ids) -> tuple:
+        c0 = fleet_counts(name)
+        t0 = time.perf_counter()
+        for b in burst_ids:
+            g, fp = cold[b], cold_fps[b]
+            futs = [route(name, g, fp) for _ in range(burst_fan)]
+            for f in futs:
+                note(name, fp, f)
+        for k in zipf_draws:
+            note(name, hot_fps[k], route(name, hot[k], hot_fps[k]))
+        dt = time.perf_counter() - t0
+        c1 = fleet_counts(name)
+        d = {k: c1.get(k, 0) - c0.get(k, 0) for k in c1}
+        served = n_bursts * burst_fan + len(zipf_draws)
+        eff = (d.get("cache_hits", 0)
+               + d.get("cache_coalesced", 0)) / max(d["requests"], 1)
+        return served / dt, eff
+
+    names = list(fleets)
+    rows: list = []
+    effs: dict = {n: [] for n in names}
+    rng = np.random.default_rng(0)
+    for r in range(-1, rounds):  # round -1 = discarded burn-in
+        # one draw per round, shared by both variants (paired rounds)
+        zipf_draws = rng.choice(hot_n, size=n_singles, p=zipf_p)
+        lo = (r + 1) * n_bursts
+        burst_ids = [b % len(cold) for b in range(lo, lo + n_bursts)]
+        order = names[r % len(names):] + names[: r % len(names)]
+        for name in order:
+            rate, eff = drive(name, r, zipf_draws, burst_ids)
+            if r >= 0:
+                rows.append({"round": r, "variant": name,
+                             "structs_per_sec": round(rate, 1),
+                             "effective_hit_ratio": round(eff, 4)})
+                effs[name].append(eff)
+    # ---- acceptance gates (ISSUE 20) ----
+    cp, repl = fleet_counts("cachepart"), fleet_counts("replicated")
+    # single-flight ON: ZERO duplicate in-flight misses under stampede
+    assert cp.get("cache_dup_misses", 0) == 0, cp
+    # and the baseline PROVES the stampede was real (herds did overlap)
+    assert repl.get("cache_dup_misses", 0) > 0, repl
+    # owner-affinity answers are bit-exact vs the baseline, key by key
+    diffs = [float(np.max(np.abs(preds["cachepart"][fp]
+                                 - preds["replicated"][fp])))
+             for fp in preds["cachepart"]]
+    assert max(diffs) == 0.0, f"responses not bit-exact: {max(diffs)}"
+    # hashing micro-bench (satellite: the sha1 -> blake2b swap)
+    hash_us = {}
+    for label, hasher in (("sha1", hashlib.sha1),
+                          ("blake2b", lambda: hashlib.blake2b(
+                              digest_size=20))):
+        t0 = time.perf_counter()
+        for g in pool:
+            h = hasher()
+            for arr in (g.atom_fea, g.edge_fea, g.centers, g.neighbors):
+                a = np.ascontiguousarray(arr)
+                h.update(str(a.shape).encode())
+                h.update(str(a.dtype).encode())
+                h.update(a.tobytes())
+            h.hexdigest()
+        hash_us[label] = round(
+            (time.perf_counter() - t0) / len(pool) * 1e6, 2)
+    med_eff = {n: float(np.median(v)) for n, v in effs.items()}
+    for fleet in fleets.values():
+        for s in fleet:
+            s.drain(timeout_s=60.0)
+    return _ab_report("cachepart", names, rows, extra={
+        "workload": f"{n_fleet}-replica fleet, per-replica cache "
+                    f"capacity {cache_cap}; per round {n_bursts} "
+                    f"cold-key stampede bursts x{burst_fan} concurrent "
+                    f"+ {n_singles} Zipf(1.1) singles over a "
+                    f"{hot_n}-key hot set; routing is the only "
+                    f"difference (round-robin+no-single-flight vs "
+                    f"ring-owner+single-flight)",
+        "median_effective_hit_ratio": {
+            n: round(v, 4) for n, v in med_eff.items()},
+        "effective_hit_ratio_gain": round(
+            med_eff["cachepart"] / max(med_eff["replicated"], 1e-9), 2),
+        "dup_misses": {"replicated": repl.get("cache_dup_misses", 0),
+                       "cachepart": cp.get("cache_dup_misses", 0)},
+        "coalesced": {"replicated": repl.get("cache_coalesced", 0),
+                      "cachepart": cp.get("cache_coalesced", 0)},
+        "bitexact_keys_checked": len(diffs),
+        "max_abs_pred_diff": max(diffs),
+        "fingerprint_hash_us": hash_us,
+        "fingerprint_blake2b_speedup": round(
+            hash_us["sha1"] / max(hash_us["blake2b"], 1e-9), 2),
+        "cache_ring": ring.stats(),
         "device": str(jax.devices()[0].device_kind),
     })
 

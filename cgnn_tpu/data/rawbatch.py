@@ -137,8 +137,10 @@ def raw_from_graph(g) -> RawStructure | None:
 def raw_fingerprint(rs: RawStructure) -> str:
     """Content hash of the f32 wire encoding (the result-cache key for
     raw-wire requests; 'raw:'-prefixed so a raw-served row can never
-    collide with a featurized-array fingerprint)."""
-    h = hashlib.sha1()
+    collide with a featurized-array fingerprint). blake2b to match
+    serve/cache.structure_fingerprint — in-memory key only, no persisted
+    state, so the hash family can change without migration."""
+    h = hashlib.blake2b(digest_size=20)
     for arr, dt in ((rs.frac_coords, np.float32),
                     (rs.lattice, np.float32),
                     (rs.numbers, np.int32)):

@@ -1,0 +1,136 @@
+"""Consistent-hash ring mapping result-cache fingerprints to owner replicas.
+
+The fleet's per-replica ``ResultCache`` LRUs (serve/cache.py) historically
+replicated the same hot keyset N times: effective fleet capacity stayed at
+1x no matter how far the autoscaler scaled out.  This ring partitions the
+fingerprint keyspace across replicas so each key has exactly one *owner*
+and the N LRUs compose into one fleet cache with ~Nx effective capacity
+(ROADMAP open item 4).
+
+Contract (also pinned in INVARIANTS.md):
+
+- **Ownership is an optimization, never a correctness dependency.**  The
+  router *prefers* the healthy owner; a breaker-open, draining, or dead
+  owner falls back to the ordinary load-aware pick and the response stays
+  bit-exact.  Nothing in the serving path may assume the owner answered.
+- **Determinism across restarts.**  Virtual-node hash points derive only
+  from the replica id and vnode index (``blake2b("rid:i")``), never from
+  object identity, boot time, or randomness — a restarted process rebuilds
+  the identical ring, so re-ownership after a crash is reproducible.
+- **Incremental rebalance.**  ``add(rid)`` / ``remove(rid)`` insert or
+  delete only that replica's vnode points; only keys on the moved arcs
+  change owner.  Autoscale events therefore invalidate ~1/N of the
+  keyspace, not all of it.
+
+Stdlib-only; thread-safe via a single named lock (GC-LOCKSHARE).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+from cgnn_tpu.analysis import racecheck
+
+# 64 vnodes/replica keeps the max-arc imbalance under ~20% for small
+# fleets (3-8 replicas) while the ring stays tiny (N*64 ints)
+DEFAULT_VNODES = 64
+
+
+def _point(data: str) -> int:
+    """64-bit hash point for a vnode label or a fingerprint key."""
+    return int.from_bytes(
+        hashlib.blake2b(data.encode("utf-8"), digest_size=8).digest(), "big")
+
+
+class CacheRing:
+    """Consistent-hash ring: fingerprint -> owner replica id.
+
+    All mutable state (``_points``, ``_rids``) is guarded by ``_lock``.
+    """
+
+    def __init__(self, rids=(), vnodes: int = DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self._vnodes = int(vnodes)
+        self._lock = racecheck.make_lock("fleet.cachering")
+        self._points: list[tuple[int, int]] = []  # sorted (hash, rid)
+        self._rids: set[int] = set()
+        for rid in rids:
+            self.add(rid)
+
+    @staticmethod
+    def _vnode_points(rid: int, vnodes: int) -> list[tuple[int, int]]:
+        # label depends only on (rid, i): deterministic across restarts
+        return [(_point(f"{rid}:{i}"), rid) for i in range(vnodes)]
+
+    def add(self, rid: int) -> None:
+        """Insert ``rid``'s vnodes; keys on the new arcs re-own to it."""
+        rid = int(rid)
+        with self._lock:
+            if rid in self._rids:
+                return
+            self._rids.add(rid)
+            for pt in self._vnode_points(rid, self._vnodes):
+                bisect.insort(self._points, pt)
+
+    def remove(self, rid: int) -> None:
+        """Delete ``rid``'s vnodes; its arcs re-own to ring successors."""
+        rid = int(rid)
+        with self._lock:
+            if rid not in self._rids:
+                return
+            self._rids.discard(rid)
+            self._points = [p for p in self._points if p[1] != rid]
+
+    def owner(self, key: str, alive=None):
+        """Owner rid for a fingerprint key, or None on an empty ring.
+
+        ``alive`` (an optional rid set) makes the walk health-aware: the
+        first clockwise vnode whose replica is in ``alive`` owns the key
+        — so a crashed owner's arcs re-own DETERMINISTICALLY to their
+        ring successors while it is down, and revert (same determinism)
+        the moment it probes healthy again. An empty intersection
+        returns None (the caller falls back to ordinary routing)."""
+        with self._lock:
+            if not self._points:
+                return None
+            h = _point(key)
+            # first point clockwise from h (wrap to points[0])
+            i = bisect.bisect_right(self._points, (h, -1))
+            n = len(self._points)
+            for step in range(n):
+                rid = self._points[(i + step) % n][1]
+                if alive is None or rid in alive:
+                    return rid
+            return None
+
+    def members(self) -> list[int]:
+        with self._lock:
+            return sorted(self._rids)
+
+    def __contains__(self, rid: int) -> bool:
+        with self._lock:
+            return int(rid) in self._rids
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rids)
+
+    def stats(self) -> dict:
+        """Membership + per-replica arc share (fraction of hash space)."""
+        with self._lock:
+            points = list(self._points)
+            rids = sorted(self._rids)
+        share = {rid: 0.0 for rid in rids}
+        if points:
+            span = float(2 ** 64)
+            for i, (h, rid) in enumerate(points):
+                prev = points[i - 1][0] if i else points[-1][0] - 2 ** 64
+                share[rid] += (h - prev) / span
+        return {
+            "replicas": rids,
+            "vnodes": self._vnodes,
+            "points": len(points),
+            "arc_share": {str(r): round(s, 4) for r, s in share.items()},
+        }

@@ -49,6 +49,10 @@ def http_transport(replica: "ReplicaState", body: dict,
         # span id rides to the replica, whose serve.request span
         # records it as its parent — the joined-trace tree edge
         headers["X-Trace-Parent"] = str(body["trace_parent"])
+    if body.get("fingerprint"):
+        # edge-computed content hash (ISSUE 20): hashed ONCE at the
+        # router; the replica qualifies this key instead of re-hashing
+        headers["X-Fingerprint"] = str(body["fingerprint"])
     req = urllib.request.Request(
         replica.base_url + "/predict", data=data, headers=headers,
     )

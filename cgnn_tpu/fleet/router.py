@@ -47,6 +47,7 @@ import time
 from typing import Callable, Sequence
 
 from cgnn_tpu.analysis import racecheck
+from cgnn_tpu.fleet.cachering import CacheRing
 from cgnn_tpu.fleet.replica import (
     FleetTransportError,
     ReplicaState,
@@ -63,6 +64,32 @@ RETRYABLE_STATUS = frozenset((429, 500, 502, 503))
 # upstream rejections that are about the REQUEST, not the replica:
 # retrying elsewhere would just fail again
 PASSTHROUGH_STATUS = frozenset((400, 404, 413, 501, 504))
+
+
+def edge_fingerprint(body: dict) -> str | None:
+    """Content hash of a dispatch body's wire arrays, computed ONCE at
+    the fleet edge (ISSUE 20): featurized ``graph`` payloads hash to the
+    bare digest ``serve.cache.structure_fingerprint`` would produce,
+    wire-form ``structure`` payloads to the ``'raw:'``-prefixed
+    ``data.rawbatch.raw_fingerprint``. The hash rides to the replica as
+    X-Fingerprint, which then only QUALIFIES the key (fs:/tier
+    prefixes) instead of re-hashing the arrays. None on a body this
+    router cannot hash (malformed or fingerprint-free) — affinity and
+    coalescing simply disengage, routing is unchanged."""
+    try:
+        if "graph" in body:
+            from cgnn_tpu.serve.cache import structure_fingerprint
+            from cgnn_tpu.serve.http import graph_from_json
+
+            return structure_fingerprint(graph_from_json(body["graph"]))
+        if "structure" in body:
+            from cgnn_tpu.data.rawbatch import raw_fingerprint
+            from cgnn_tpu.serve.http import structure_from_json
+
+            return raw_fingerprint(structure_from_json(body["structure"]))
+    except (KeyError, TypeError, ValueError):
+        return None
+    return None
 
 
 class _Call:
@@ -102,6 +129,10 @@ class FleetRouter:
         clock: Callable[[], float] = time.monotonic,
         rng: random.Random | None = None,
         log_fn: Callable = print,
+        cache_affinity: bool = True,
+        coalesce_wait_ms: float = 1000.0,
+        peer_fill: bool = True,
+        ring_vnodes: int = 64,
     ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -157,7 +188,31 @@ class FleetRouter:
             # (drained scale-down, exit-75 preemption) is a SCALE
             # EVENT; an unplanned one (kill -9, crash) an INCIDENT
             "fleet_scale_events": 0, "fleet_incidents": 0,
+            # ISSUE 20: cache partitioning. owner_routed/owner_fallback
+            # split every fingerprinted pick by whether the ring owner
+            # took it; coalesced = follower answers served off a
+            # leader's in-flight dispatch; peer_fills = owner-miss rows
+            # shipped back to the owner's cache
+            "fleet_fingerprinted": 0, "fleet_owner_routed": 0,
+            "fleet_owner_fallback": 0, "fleet_coalesced": 0,
+            "fleet_coalesce_timeouts": 0, "fleet_peer_fills": 0,
+            "fleet_peer_fill_stale": 0, "fleet_peer_fill_errors": 0,
         }
+        # ---- one fleet cache (ISSUE 20) ----
+        # owner-affinity is an OPTIMIZATION, never a correctness
+        # dependency (INVARIANTS.md): a dead/ejected owner falls back
+        # to the ordinary load-aware pick, responses stay bit-exact
+        self.cache_ring = (CacheRing((r.rid for r in self._replicas),
+                                     vnodes=ring_vnodes)
+                          if cache_affinity else None)
+        self.peer_fill = bool(peer_fill)
+        # router-side single-flight: identical fingerprints dispatched
+        # concurrently collapse onto one upstream leader; followers
+        # wait BOUNDED (never past their own deadline) then dispatch
+        # themselves — coalescing may only ever remove upstream work
+        self._coalesce_wait_s = max(float(coalesce_wait_ms), 0.0) / 1e3
+        self._sf_lock = racecheck.make_lock("fleet.singleflight")
+        self._sf: dict[str, dict] = {}
         # replica lifecycle journal (add/remove/incident), mutated
         # under self._lock like counts
         self.lifecycle: collections.deque = collections.deque(maxlen=256)
@@ -209,6 +264,9 @@ class FleetRouter:
                 "fleet_latency_ms_hist": Histogram(LATENCY_MS_BOUNDS),
                 "fleet_attempt_latency_ms_hist": Histogram(
                     LATENCY_MS_BOUNDS),
+                # ISSUE 20: wall time of each peer-fill hop (the price
+                # of keeping the owner's cache warm off-path)
+                "fleet_owner_hop_ms_hist": Histogram(LATENCY_MS_BOUNDS),
             }
             objectives = (tuple(slo_objectives) if slo_objectives else (
                 SLOObjective("fleet_availability", target=0.999,
@@ -292,6 +350,9 @@ class FleetRouter:
                 "t": self._clock(), "event": "add",
                 "replica": state.rid, "reason": "scale_up",
             })
+        if self.cache_ring is not None:
+            # incremental rebalance: only the new arcs re-own
+            self.cache_ring.add(state.rid)
         if self.flightrec is not None:
             state.breaker.on_trip = self._on_breaker_trip
 
@@ -318,6 +379,8 @@ class FleetRouter:
                 "t": self._clock(), "event": "remove",
                 "replica": rid, "reason": reason,
             })
+        if self.cache_ring is not None:
+            self.cache_ring.remove(rid)
         self._log(f"fleet: replica{rid} unrouted ({reason})")
         return r
 
@@ -329,6 +392,11 @@ class FleetRouter:
         r = self._replica(rid)
         if r is not None:
             r.note_draining()
+            if self.cache_ring is not None:
+                # re-own its arcs NOW — new keys go to successors while
+                # the drain finishes in-flight work, so the successor
+                # caches are already warming when the replica leaves
+                self.cache_ring.remove(rid)
 
     # ---- the canary plane (ISSUE 18) ----
     # The fleet-adapter protocol continual/canary.py drives: pin one
@@ -597,7 +665,8 @@ class FleetRouter:
         with self._lock:
             self.counts[key] = self.counts.get(key, 0) + n
 
-    def _pick(self, exclude=(), hard_exclude=()) -> ReplicaState | None:
+    def _pick(self, exclude=(), hard_exclude=(),
+              owner=None) -> ReplicaState | None:
         """Best admittable replica, preferring ones this request has
         not failed on; falls back to retrying a previously-failed (but
         still admittable) replica over shedding. ``hard_exclude`` is
@@ -607,10 +676,22 @@ class FleetRouter:
         one and corrupt the live-attempt bookkeeping).
         ``breaker.admit()`` is called only on the chosen candidate —
         scoring uses the non-mutating check so an unchosen half-open
-        replica keeps its trial slot."""
+        replica keeps its trial slot.
+
+        ``owner`` (ISSUE 20) is the cache-ring owner rid: preferred
+        over the load score when it is healthy, admittable, and this
+        request has not already failed on it — a PREFERENCE inside the
+        same admittance rules, never an override of them, so a dead or
+        ejected owner degrades to exactly the pre-affinity pick."""
         pool = [r for r in self.replicas
                 if r.rid not in hard_exclude and r.pickable()]
         fresh = [r for r in pool if r.rid not in exclude]
+        if owner is not None:
+            for r in fresh:
+                if r.rid == owner:
+                    if r.breaker.admit():
+                        return r
+                    break
         for r in sorted(fresh or pool, key=lambda r: r.score()):
             if r.breaker.admit():
                 return r
@@ -758,9 +839,21 @@ class FleetRouter:
         and its served bytes identical with the layer on or off."""
         tid = self._mint(trace_id)
         t0 = time.perf_counter()
+        # content fingerprint, hashed ONCE here at the fleet edge
+        # (ISSUE 20): it keys owner-affinity + router coalescing below
+        # and rides to the replica as X-Fingerprint so nothing
+        # downstream re-hashes the arrays
+        fp = str(body.get("fingerprint") or "") or None
+        if fp is None and self.cache_ring is not None:
+            fp = edge_fingerprint(body)
+            if fp:
+                body = dict(body)
+                body["fingerprint"] = fp
+        if fp:
+            self._count("fleet_fingerprinted")
         with bind_trace(tid):
-            status, payload, meta = self._dispatch_inner(
-                body, timeout_ms=timeout_ms, trace_id=tid)
+            status, payload, meta = self._dispatch_coalesced(
+                body, fp, timeout_ms=timeout_ms, trace_id=tid)
         if self.tracer is not None:
             self.tracer.complete(
                 "fleet.request", t0, time.perf_counter(),
@@ -805,6 +898,77 @@ class FleetRouter:
             )
         return status, payload, meta
 
+    @staticmethod
+    def _route_key(body: dict, fp: str) -> str:
+        """The ring/coalesce key: the edge fingerprint, tier-qualified
+        the same way the replica cache qualifies it — two requests for
+        one structure at different precisions are different results and
+        must neither share an owner arc by accident nor coalesce."""
+        tier = str(body.get("precision") or "f32")
+        return fp if tier == "f32" else f"{tier}:{fp}"
+
+    def _dispatch_coalesced(self, body: dict, fp: str | None, *,
+                            timeout_ms: float | None = None,
+                            trace_id: str | None = None
+                            ) -> tuple[int, dict, dict]:
+        """Router-side single-flight (ISSUE 20): concurrent dispatches
+        of the SAME fingerprint collapse onto one upstream leader;
+        followers wait for its answer instead of stampeding the fleet.
+
+        The wait is BOUNDED (``coalesce_wait_ms``, never past the
+        follower's own deadline) and every non-200 outcome — leader
+        error, leader timeout, wait timeout — falls through to a plain
+        ``_dispatch_inner``: coalescing may only ever REMOVE upstream
+        work, never add a failure mode (INVARIANTS.md). A follower's
+        payload is the leader's bytes with only ``trace_id`` (its own)
+        and ``coalesced: True`` swapped in."""
+        if not fp or self._coalesce_wait_s <= 0:
+            return self._dispatch_inner(
+                body, timeout_ms=timeout_ms, trace_id=trace_id)
+        key = self._route_key(body, fp)
+        with self._sf_lock:
+            entry = self._sf.get(key)
+            leader = entry is None
+            if leader:
+                entry = {"event": threading.Event(), "result": None}
+                self._sf[key] = entry
+        if not leader:
+            t0 = self._clock()
+            budget_s = (self.default_timeout_ms if timeout_ms is None
+                        else float(timeout_ms)) / 1e3
+            if entry["event"].wait(min(self._coalesce_wait_s,
+                                       max(budget_s, 0.0))):
+                result = entry["result"]
+                if result is not None and result[0] == 200:
+                    _, payload0, meta0 = result
+                    self._count("fleet_coalesced")
+                    payload = dict(payload0 or {})
+                    payload["trace_id"] = trace_id
+                    payload["coalesced"] = True
+                    meta = dict(meta0)
+                    meta.update(
+                        trace_id=trace_id, span_id="", coalesced=True,
+                        latency_ms=(self._clock() - t0) * 1e3)
+                    return 200, payload, meta
+                # leader failed — dispatch ourselves, no second wait
+            else:
+                self._count("fleet_coalesce_timeouts")
+            return self._dispatch_inner(
+                body, timeout_ms=timeout_ms, trace_id=trace_id)
+        result = None
+        try:
+            result = self._dispatch_inner(
+                body, timeout_ms=timeout_ms, trace_id=trace_id)
+            return result
+        finally:
+            # pop BEFORE set: a follower arriving after the pop becomes
+            # the next leader instead of reading a finished entry
+            with self._sf_lock:
+                if self._sf.get(key) is entry:
+                    del self._sf[key]
+            entry["result"] = result
+            entry["event"].set()
+
     def _dispatch_inner(self, body: dict, *,
                         timeout_ms: float | None = None,
                         trace_id: str | None = None
@@ -829,6 +993,18 @@ class FleetRouter:
         klass = str(body.get("class") or body.get("priority") or "")
         if klass:
             self._count(f"fleet_class_{klass}_requests")
+        # owner-affinity (ISSUE 20): the ring owner of this body's
+        # fingerprint is PREFERRED while healthy — its ResultCache holds
+        # (or will hold) this key's row. Computed once per dispatch from
+        # the live health view; a dead/ejected/draining owner leaves
+        # owner_rid pointing at its deterministic ring successor or, on
+        # an empty alive set, disengages affinity entirely
+        owner_rid = None
+        fp = str(body.get("fingerprint") or "") or None
+        if self.cache_ring is not None and fp:
+            alive = {r.rid for r in self.replicas if r.pickable()}
+            owner_rid = self.cache_ring.owner(
+                self._route_key(body, fp), alive=alive)
         live: dict[int, float] = {}  # rid -> launch time (hedge timer)
         tried_failed: set[int] = set()
         hedged_rids: set[int] = set()
@@ -905,7 +1081,7 @@ class FleetRouter:
                                  f"(last: {last_failure})",
                         "reason": "upstream_exhausted", "trace_id": tid,
                     }, meta()
-                r = self._pick(exclude=tried_failed)
+                r = self._pick(exclude=tried_failed, owner=owner_rid)
                 if r is None:
                     call.done.set()
                     retry_after = self._retry_after_s()
@@ -916,6 +1092,10 @@ class FleetRouter:
                         "reason": "no_replicas", "trace_id": tid,
                         "retry_after_s": retry_after,
                     }, meta(retry_after_s=retry_after)
+                if owner_rid is not None:
+                    self._count("fleet_owner_routed"
+                                if r.rid == owner_rid
+                                else "fleet_owner_fallback")
                 if launched > 0:
                     retries += 1
                     self._count("fleet_retries")
@@ -976,6 +1156,20 @@ class FleetRouter:
                     # hedges folded in) — the mergeable twin of the
                     # rolling quantiles above
                     h.observe(total_ms)
+                if (fp and self.peer_fill and owner_rid is not None
+                        and rid != owner_rid
+                        and self._transport is http_transport
+                        and (payload or {}).get("prediction")
+                        is not None):
+                    # owner-miss: a non-owner answered (fallback,
+                    # retry, or hedge won). Ship the row back to the
+                    # ring owner OFF-PATH so its cache still warms —
+                    # the client's answer never waits on this hop
+                    threading.Thread(
+                        target=self._peer_fill,
+                        args=(owner_rid, fp, payload, body),
+                        daemon=True, name="fleet-peer-fill",
+                    ).start()
                 return 200, payload, meta(rid)
             if err is None and status in PASSTHROUGH_STATUS:
                 # about the request, not the replica: hand it back
@@ -1009,6 +1203,46 @@ class FleetRouter:
                 remaining = deadline - self._clock()
                 if remaining > 0 and delay > 0:
                     time.sleep(min(delay, remaining))
+
+    def _peer_fill(self, owner_rid: int, fp: str, payload: dict,
+                   body: dict) -> None:
+        """Ship an owner-miss answer to the ring owner's /cache-fill
+        (daemon thread, off the request path). Best-effort by design:
+        the owner re-qualifies the key and version-checks at fill time
+        (serve/server.py cache_fill), so a stale or lost fill costs one
+        future miss, never a wrong answer."""
+        r = self._replica(owner_rid)
+        if r is None:
+            return
+        from cgnn_tpu.fleet.replica import http_post_json
+
+        t0 = time.perf_counter()
+        try:
+            status, resp = http_post_json(
+                r.base_url + "/cache-fill",
+                {
+                    "fingerprint": fp,
+                    "prediction": payload.get("prediction"),
+                    "param_version": payload.get("param_version", ""),
+                    "precision": (payload.get("precision")
+                                  or body.get("precision")),
+                    "wire": payload.get("wire", "featurized"),
+                },
+                timeout_s=5.0)
+        except FleetTransportError:
+            self._count("fleet_peer_fill_errors")
+            return
+        finally:
+            h = self.hists.get("fleet_owner_hop_ms_hist")
+            if h is not None:
+                h.observe((time.perf_counter() - t0) * 1e3)
+        if status == 200 and (resp or {}).get("filled"):
+            self._count("fleet_peer_fills")
+        elif status == 200:
+            # owner declined: the fill raced a param swap (stale)
+            self._count("fleet_peer_fill_stale")
+        else:
+            self._count("fleet_peer_fill_errors")
 
     # ---- observation ----
 
@@ -1075,6 +1309,9 @@ class FleetRouter:
             out["journal"] = self.journal.stats()
         if self.canary is not None:
             out["canary"] = self.canary.stats()
+        # one-fleet-cache plane (ISSUE 20)
+        if self.cache_ring is not None:
+            out["cache_ring"] = self.cache_ring.stats()
         return out
 
     def _registry_snapshot(self) -> dict:
@@ -1143,6 +1380,13 @@ class FleetRouter:
             gauges["tsdb_series"] = float(ts["series"])
             gauges["tsdb_points"] = float(ts["points"])
             gauges["tsdb_dropped_series"] = float(ts["dropped_series"])
+        # one-fleet-cache derived ratios (ISSUE 20)
+        if self.cache_ring is not None:
+            gauges["fleet_cache_ring_replicas"] = float(
+                len(self.cache_ring))
+        from cgnn_tpu.observe.gauges import cache_gauges
+
+        gauges.update(cache_gauges(counters, gauges))
         return out
 
     def fleet_metrics_text(self, timeout_s: float = 2.0) -> str:
@@ -1178,6 +1422,14 @@ class FleetRouter:
                 hmap = fam.get("histogram")
                 if fam.get("type") == "histogram" and hmap:
                     per_family.setdefault(fname, []).append(hmap)
+        # fold the router's OWN mergeable families in (ISSUE 20): the
+        # owner-hop and fleet-latency histograms live router-side, not
+        # on any replica, and the fleet view should carry them; the
+        # per-(tier,form) cache-lookup families arrive from the replica
+        # scrapes above and merge label-set by label-set
+        for name, h in self.hists.items():
+            per_family.setdefault(f"cgnn_{name}", []).append(
+                {"": h.snapshot()})
         lines = [
             "# TYPE cgnn_fleet_scrape_replicas gauge",
             f"cgnn_fleet_scrape_replicas {float(scraped)}",

@@ -186,6 +186,52 @@ def priority_gauges(counters: dict, gauges: dict) -> dict:
     return out
 
 
+def cache_gauges(counters: dict, gauges: dict) -> dict:
+    """Derived health figures for the fleet-partitioned result cache
+    (ISSUE 20), from a run's counters/gauges — the ``priority_gauges``
+    analog for the cache plane.
+
+    - ``serve_cache_hit_ratio``: raw LRU hits over lookups, from the
+      cache's CONSISTENT snapshot counters (one lock acquisition — the
+      pre-snapshot scrape could pair counts from different instants);
+    - ``serve_cache_fill_ratio``: occupied over capacity;
+    - ``serve_cache_effective_hit_ratio``: answers that needed no
+      forward pass on THIS replica — version-valid hits plus coalesced
+      followers — over requests. The bench A/B's headline figure;
+    - ``serve_cache_coalesced_share`` / ``serve_cache_dup_miss_total``:
+      single-flight conversion rate and the duplicate in-flight misses
+      the stampede assertion pins to 0 when coalescing is on;
+    - ``fleet_owner_routed_share``: of owner-routable dispatches, the
+      fraction the healthy owner actually answered (router-side).
+    """
+    out = {}
+    hits = float(counters.get("serve_cache_lookup_hits", 0.0))
+    misses = float(counters.get("serve_cache_lookup_misses", 0.0))
+    if hits + misses > 0:
+        out["serve_cache_hit_ratio"] = hits / (hits + misses)
+    cap = float(gauges.get("serve_cache_capacity", 0.0))
+    if cap > 0:
+        out["serve_cache_fill_ratio"] = (
+            float(gauges.get("serve_cache_size", 0.0)) / cap)
+    requests = float(counters.get("serve_requests", 0.0))
+    valid_hits = float(counters.get("serve_cache_hits", 0.0))
+    coalesced = float(counters.get("serve_cache_coalesced", 0.0))
+    if requests > 0:
+        out["serve_cache_effective_hit_ratio"] = (
+            (valid_hits + coalesced) / requests)
+        out["serve_cache_coalesced_share"] = coalesced / requests
+    if "serve_cache_dup_misses" in counters:
+        out["serve_cache_dup_miss_total"] = float(
+            counters["serve_cache_dup_misses"])
+    if "serve_cache_fills" in counters:
+        out["serve_cache_fill_total"] = float(counters["serve_cache_fills"])
+    routed = float(counters.get("fleet_owner_routed", 0.0))
+    fallback = float(counters.get("fleet_owner_fallback", 0.0))
+    if routed + fallback > 0:
+        out["fleet_owner_routed_share"] = routed / (routed + fallback)
+    return out
+
+
 def pipeline_gauges(counters: dict, gauges: dict) -> dict:
     """Derived health figures for the parallel ingest pipeline
     (data/pipeline.py), from a run's counters/gauges — the

@@ -22,6 +22,7 @@ import os
 from typing import Callable, Iterator
 
 from cgnn_tpu.observe.gauges import (
+    cache_gauges,
     device_gauges,
     hbm_gauges,
     ingest_gauges,
@@ -261,6 +262,7 @@ class Telemetry:
         gauges.update(device_gauges(counters, gauges))
         gauges.update(ingest_gauges(counters, gauges))
         gauges.update(priority_gauges(counters, gauges))
+        gauges.update(cache_gauges(counters, gauges))
         if counters or gauges:
             self.logger.event("run_summary", {
                 "counters": counters, "gauges": gauges,

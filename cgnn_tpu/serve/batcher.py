@@ -105,20 +105,45 @@ class ServeRejection(RuntimeError):
 
 
 class RequestFuture:
-    """One request's pending result (threading.Event + slot)."""
+    """One request's pending result (threading.Event + slot).
+
+    ``add_done_callback`` exists for single-flight miss coalescing
+    (server.py): follower requests for an in-flight fingerprint attach
+    to the leader's future instead of entering the batcher, and are
+    resolved on whichever thread completes the leader — success, error,
+    or expiry all fire the callbacks exactly once."""
 
     def __init__(self):
         self._done = threading.Event()
         self._result = None
         self._error: BaseException | None = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: list = []
 
     def set_result(self, result) -> None:
         self._result = result
         self._done.set()
+        self._fire_callbacks()
 
     def set_error(self, error: BaseException) -> None:
         self._error = error
         self._done.set()
+        self._fire_callbacks()
+
+    def add_done_callback(self, fn) -> None:
+        """``fn(self)`` once this future resolves (immediately if it
+        already has); callbacks run on the resolving thread."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _fire_callbacks(self) -> None:
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            fn(self)
 
     def done(self) -> bool:
         return self._done.is_set()

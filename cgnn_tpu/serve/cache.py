@@ -39,8 +39,17 @@ from cgnn_tpu.data.graph import CrystalGraph
 
 
 def structure_fingerprint(graph: CrystalGraph) -> str:
-    """Content hash of a featurized structure (layout-qualified)."""
-    h = hashlib.sha1()
+    """Content hash of a featurized structure (layout-qualified).
+
+    blake2b, not sha1: faster in software (no SHA-NI dependency — on
+    accelerator hosts whose CPUs lack it, sha1 falls off a cliff) and
+    this is an in-memory cache key with no persisted state, so the hash
+    can change between releases without a migration. The per-host
+    sha1/blake2b ratio is measured by ``bench.py --ab cachepart``
+    (``fingerprint_hash_us``). digest_size=20 keeps the hex length
+    sha1-compatible for logs and tier prefixes.
+    """
+    h = hashlib.blake2b(digest_size=20)
     for arr in (graph.atom_fea, graph.edge_fea, graph.centers,
                 graph.neighbors):
         a = np.ascontiguousarray(arr)
@@ -86,11 +95,22 @@ class ResultCache:
         with self._lock:
             return len(self._data)
 
-    def stats(self) -> dict:
+    def snapshot(self) -> tuple:
+        """Consistent ``(hits, misses, size, capacity)`` under the lock.
+
+        ``hits``/``misses`` are mutated under ``_lock``; scraping the
+        bare attributes from another thread could pair a pre-increment
+        ``hits`` with a post-increment ``misses`` (a hit ratio that
+        never existed). All metrics/stats readers go through here.
+        """
         with self._lock:
-            return {
-                "size": len(self._data),
-                "capacity": self.capacity,
-                "hits": self.hits,
-                "misses": self.misses,
-            }
+            return (self.hits, self.misses, len(self._data), self.capacity)
+
+    def stats(self) -> dict:
+        hits, misses, size, capacity = self.snapshot()
+        return {
+            "size": size,
+            "capacity": capacity,
+            "hits": hits,
+            "misses": misses,
+        }

@@ -389,6 +389,25 @@ def make_handler(server: InferenceServer):
             if self.path == "/reload-control":
                 self._do_reload_control(payload)
                 return
+            if self.path == "/cache-fill":
+                # peer-fill (ISSUE 20): the fleet router replays a row a
+                # NON-owner replica computed into this owner's cache.
+                # Version-checked at fill time and revalidated at hit
+                # time (serve/cache.py) — a stale or malformed fill is
+                # reported, never served
+                try:
+                    filled = server.cache_fill(
+                        payload.get("fingerprint", ""),
+                        payload.get("prediction", ()),
+                        payload.get("param_version", ""),
+                        precision=payload.get("precision"),
+                        wire=str(payload.get("wire", "featurized")),
+                    )
+                except (TypeError, ValueError) as e:
+                    self._reply(400, {"error": f"malformed fill: {e}"})
+                    return
+                self._reply(200, {"filled": bool(filled)})
+                return
             if self.path != "/predict":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -438,6 +457,10 @@ def make_handler(server: InferenceServer):
             _, trace_parent = parse_parent(
                 self.headers.get(TRACE_PARENT_HEADER)
                 or payload.get("trace_parent"))
+            # edge-computed content hash (ISSUE 20): the router hashed
+            # the wire arrays once; the replica only qualifies the key
+            fingerprint = (self.headers.get("X-Fingerprint")
+                           or payload.get("fingerprint"))
             # bind the inbound trace id as this handler thread's log
             # context: under a fleet, EVERY replica request carries the
             # router's X-Request-Id, so --log-json lines emitted while
@@ -457,6 +480,7 @@ def make_handler(server: InferenceServer):
                         klass=(payload.get("class")
                                or payload.get("priority")),
                         tenant=payload.get("tenant"),
+                        fingerprint=fingerprint,
                     )
                 except ServeRejection as e:
                     headers = None
@@ -493,6 +517,7 @@ def make_handler(server: InferenceServer):
                 "stamps": result.stamps,
                 "class": result.klass,
                 "backfilled": result.backfilled,
+                "coalesced": result.coalesced,
             }, headers={"X-Request-Id": result.trace_id})
 
     return ServeHandler

@@ -135,6 +135,10 @@ class ServeResult:
     # own trace id), the flag is accounting, never a quality downgrade
     klass: str = DEFAULT_CLASS
     backfilled: bool = False
+    # single-flight miss coalescing (ISSUE 20): this answer was copied
+    # from an identical-fingerprint request already in flight instead of
+    # entering the batcher — same row the leader computed, own trace id
+    coalesced: bool = False
 
 
 class InferenceServer:
@@ -164,6 +168,7 @@ class InferenceServer:
         wfq_weights: dict | None = None,
         default_timeout_ms: float | None = 1000.0,
         cache_size: int = 1024,
+        single_flight: bool = True,
         pack_workers: int = 1,
         devices=None,
         engine: str = "auto",
@@ -354,6 +359,25 @@ class InferenceServer:
             None if default_timeout_ms is None else default_timeout_ms / 1000.0
         )
         self.cache = ResultCache(cache_size) if cache_size else None
+        # single-flight miss coalescing (ISSUE 20): per-fingerprint
+        # waiter table. The FIRST miss for a key enters the batcher as
+        # the leader; concurrent identical-fingerprint misses attach as
+        # followers and are resolved from the leader's future (success,
+        # error, or expiry — the wait is bounded by the leader's own
+        # deadline plus the follower's client timeout), so a trending-
+        # structure stampede costs one forward pass, not a batch of
+        # duplicates. Off (`single_flight=False`) is the A/B baseline:
+        # duplicates then enter the batcher and are COUNTED
+        # (cache_dup_misses) instead of coalesced.
+        self._single_flight = bool(single_flight)
+        self._sf_lock = racecheck.make_lock("serve.singleflight")
+        self._inflight: dict[str, dict] = {}
+        # per-(tier, form, outcome) cache-lookup histograms: labeled
+        # members of one family (serve_cache_lookup_ms_hist{...}),
+        # created lazily like the per-class family — bucket COUNTS give
+        # fleet-mergeable per-(tier, form) hit ratios, values the probe
+        # (hash + LRU) cost
+        self._cache_hists: dict[tuple, object] = {}
         self._clock = clock
         self._log = log_fn
         self._worker: threading.Thread | None = None
@@ -366,6 +390,8 @@ class InferenceServer:
         # stats() works with telemetry off)
         self.counts: dict[str, int] = {
             "requests": 0, "responses": 0, "cache_hits": 0,
+            "cache_coalesced": 0, "cache_dup_misses": 0,
+            "cache_fills": 0, "cache_fill_stale": 0,
             "reject_queue_full": 0, "reject_oversize": 0,
             "reject_timeout": 0, "reject_shutdown": 0,
             "reject_malformed": 0, "batches": 0,
@@ -445,6 +471,7 @@ class InferenceServer:
             "_compiles_after_warm", "_rung_edge_occ",
             "_backfill_filled", "_backfill_slack",
         ))
+        racecheck.watch_fields(self, self._sf_lock, ("_inflight",))
 
     # ---- warmup ----
 
@@ -636,6 +663,115 @@ class InferenceServer:
         if self.slo is not None:
             self.slo.record(True, latency_ms, klass=klass)
 
+    def _observe_cache_lookup(self, tier: str, form: str, outcome: str,
+                              lookup_ms: float) -> None:
+        """One cache probe into its (tier, form, outcome)-labeled
+        histogram (ISSUE 20). The bucket COUNTS are the point: they
+        merge across replicas like any histogram family, so
+        /metrics/fleet derives fleet-wide per-(tier, form) hit ratios
+        from hit-count / (hit-count + miss-count); the observed values
+        are the probe (hash + LRU) cost in ms."""
+        if not self.hists:
+            return
+        key = (str(tier), str(form), str(outcome))
+        h = self._cache_hists.get(key)
+        if h is None:
+            from cgnn_tpu.observe.hist import LATENCY_MS_BOUNDS, Histogram
+
+            with self._lock:
+                h = self._cache_hists.setdefault(
+                    key, Histogram(LATENCY_MS_BOUNDS))
+        h.observe(lookup_ms)
+
+    def _singleflight_done(self, fp: str, fut) -> None:
+        """Leader completion: drain the waiter-table entry for ``fp``
+        and answer every coalesced follower from the leader's outcome
+        (runs on whichever thread resolved the leader's future)."""
+        with self._sf_lock:
+            entry = self._inflight.pop(fp, None)
+        if not entry:
+            return
+        followers = entry["followers"]
+        if not followers:
+            return
+        try:
+            res = fut.result(0)
+            err = None
+        except BaseException as e:  # noqa: BLE001 — relayed verbatim
+            res, err = None, e
+        for w in followers:
+            self._resolve_coalesced(w, res, err)
+
+    def _resolve_coalesced(self, w: dict, res, err) -> None:
+        """Answer one coalesced follower: the leader's row under the
+        follower's own trace id / latency / class accounting (a
+        coalesced reply is a served response — it must feed the same
+        latency distributions clients measure)."""
+        fut = w["future"]
+        if err is not None:
+            self._count("cache_coalesced_errors")
+            fut.set_error(err)
+            return
+        replied = self._stamp()
+        latency_ms = (self._clock() - w["t0"]) * 1e3
+        fut.set_result(ServeResult(
+            prediction=res.prediction, param_version=res.param_version,
+            latency_ms=latency_ms, cached=res.cached,
+            device_id=res.device_id, trace_id=w["trace_id"],
+            precision=w["tier"],
+            stamps={"queued": w["queued"], "replied": replied},
+            wire=res.wire, klass=w["klass"], coalesced=True,
+        ))
+        self._record_latency(latency_ms)
+        self._lat_rolling.add(latency_ms)
+        self._observe_served(latency_ms, version=res.param_version,
+                             klass=w["klass"])
+        self._count("responses")
+        self._count(f"responses_class_{w['klass']}")
+        self.telemetry.observe_value("serve_latency_ms", latency_ms)
+        if self._spans_on:
+            args = {"trace_id": w["trace_id"], "coalesced": True}
+            if w["trace_parent"]:
+                args["parent"] = w["trace_parent"]
+            self._span("serve.request", w["queued"], replied, **args)
+        self._note_request(
+            trace_id=w["trace_id"], status="ok", cached=bool(res.cached),
+            param_version=res.param_version, precision=w["tier"],
+            wire=res.wire, latency_ms=latency_ms)
+        self._journal_served(
+            graph=w["graph"], fingerprint=w["fingerprint"],
+            trace_id=w["trace_id"], prediction=res.prediction,
+            version=res.param_version, wire=res.wire)
+
+    def cache_fill(self, fingerprint: str, prediction, param_version: str,
+                   precision: str | None = None,
+                   wire: str = "featurized") -> bool:
+        """Peer-fill receiver (ISSUE 20): the fleet router replays a row
+        a NON-owner replica just computed into this (owner) replica's
+        cache, so the next hot-key request hits here. Purely an
+        optimization — the row is version-checked against the LIVE
+        param version at fill time AND revalidated at hit time
+        (serve/cache.py), so a stale fill can never be served. The
+        fingerprint arrives in edge form ('raw:'-prefixed or bare) and
+        is qualified here with the same fs:/tier rules as submit().
+        Returns True when the row was cached."""
+        if self.cache is None or not fingerprint:
+            return False
+        fp = str(fingerprint)
+        if fp.startswith("raw:") and wire != "raw":
+            fp = "fs:" + fp[len("raw:"):]
+        tier = precision or "f32"
+        if tier != "f32":
+            fp = f"{tier}:{fp}"
+        version = str(param_version)
+        if version != self.param_store.version:
+            self._count("cache_fill_stale")
+            return False
+        row = np.asarray(prediction, np.float32)
+        self.cache.put(fp, (row, version))
+        self._count("cache_fills")
+        return True
+
     def attach_journal(self, journal) -> None:
         """Wire a continual/journal.LabelJournal into the answer path:
         every served response appends a replayable record the late
@@ -790,6 +926,20 @@ class InferenceServer:
             backfill_filled / backfill_slack if backfill_slack else 0.0)
         counters["serve_backfill_filled_slots"] = float(backfill_filled)
         counters["serve_backfill_slack_slots"] = float(backfill_slack)
+        # result-cache truth (ISSUE 20): ONE consistent snapshot under
+        # the cache's own lock — scraping the bare hits/misses
+        # attributes could pair a pre-increment hits with a
+        # post-increment misses (a hit ratio that never existed)
+        if self.cache is not None:
+            hits, misses, size, capacity = self.cache.snapshot()
+            counters["serve_cache_lookup_hits"] = float(hits)
+            counters["serve_cache_lookup_misses"] = float(misses)
+            gauges["serve_cache_size"] = float(size)
+            gauges["serve_cache_capacity"] = float(capacity)
+        gauges["serve_single_flight"] = float(self._single_flight)
+        from cgnn_tpu.observe.gauges import cache_gauges
+
+        gauges.update(cache_gauges(counters, gauges))
         # the cross-process observability layer's own health (ISSUE 15)
         gauges["observe_trace_ring"] = float(self.tracer is not None)
         if self.tracer is not None:
@@ -845,6 +995,20 @@ class InferenceServer:
                     key = ("serve_class_latency_ms_hist"
                            + format_labels({"class": str(kl)}))
                     out["histograms"][key] = chh.snapshot()
+            with self._lock:
+                cache_hists = list(self._cache_hists.items())
+            if cache_hists:
+                # per-(tier, form) cache hit ratio (ISSUE 20): labeled
+                # members of one family keyed
+                # name{tier=...,form=...,outcome=...} — the bucket
+                # counts merge across replicas, so /metrics/fleet can
+                # state the FLEET-wide hit ratio per tier and wire form
+                from cgnn_tpu.observe.hist import format_labels
+
+                for (tier, frm, outcome), hh in sorted(cache_hists):
+                    key = ("serve_cache_lookup_ms_hist" + format_labels(
+                        {"tier": tier, "form": frm, "outcome": outcome}))
+                    out["histograms"][key] = hh.snapshot()
         if self.slo is not None:
             gauges.update(self.slo.gauges())
         if self.tsdb is not None:
@@ -1038,7 +1202,8 @@ class InferenceServer:
                precision: str | None = None,
                trace_parent: str | None = None,
                klass: str | None = None,
-               tenant: str | None = None) -> RequestFuture:
+               tenant: str | None = None,
+               fingerprint: str | None = None) -> RequestFuture:
         """Admit one structure; returns its future (raises ServeRejection
         on malformed / queue-full / oversize / draining). ``graph`` is a
         featurized ``CrystalGraph`` OR a wire-form ``RawStructure``
@@ -1058,7 +1223,12 @@ class InferenceServer:
         batcher.CLASSES, default 'interactive') and ``tenant`` the WFQ
         fair-queuing tenant — an unknown class is MALFORMED at
         admission, because silently defaulting it would change the
-        request's scheduling contract."""
+        request's scheduling contract. ``fingerprint`` carries an
+        inbound edge-computed content hash (X-Fingerprint, ISSUE 20):
+        the fleet router hashes the wire arrays ONCE per request, this
+        replica only qualifies the key (fs:/tier prefixes) instead of
+        re-hashing — a hint whose shape mismatches the admitted form is
+        ignored and the key re-derived locally."""
         now = self._clock()
         queued = self._stamp()
         tid = self._mint_trace(trace_id)
@@ -1102,19 +1272,37 @@ class InferenceServer:
         except ServeRejection as e:
             self._count(f"reject_{e.reason}")
             raise
+        lookup_t0 = self._clock()
         if self.cache is None:
             fp = None
-        elif is_raw_wire:
-            # content hash of the wire encoding; form-qualified so a
-            # row computed by the raw program ('raw:...') never answers
-            # a host-featurized request ('fs:...') — the two programs
-            # agree only to f32 roundoff, and a cached row is
-            # (params, structure, PROGRAM)-determined (serve/cache.py)
-            fp = raw_fingerprint(graph)
-            if form != "raw":
-                fp = "fs:" + fp[len("raw:"):]
         else:
-            fp = structure_fingerprint(graph)
+            fp = None
+            if fingerprint:
+                # edge-computed hash (ISSUE 20): trusted only when its
+                # shape matches the admitted form — raw-wire requests
+                # carry a 'raw:'-prefixed hash, featurized ones a bare
+                # hex digest. A mismatched hint (e.g. a raw hash after
+                # the COO inline featurize above) falls back to local
+                # hashing rather than alias the two keyspaces.
+                cand = str(fingerprint)
+                if is_raw_wire and cand.startswith("raw:"):
+                    fp = cand
+                elif not is_raw_wire and ":" not in cand:
+                    fp = cand
+            if fp is None:
+                if is_raw_wire:
+                    # content hash of the wire encoding (see below for
+                    # the form qualification)
+                    fp = raw_fingerprint(graph)
+                else:
+                    fp = structure_fingerprint(graph)
+            if is_raw_wire and form != "raw":
+                # form-qualified so a row computed by the raw program
+                # ('raw:...') never answers a host-featurized request
+                # ('fs:...') — the two programs agree only to f32
+                # roundoff, and a cached row is (params, structure,
+                # PROGRAM)-determined (serve/cache.py)
+                fp = "fs:" + fp[len("raw:"):]
         if fp is not None and tier != "f32":
             # cached rows are (params, structure, TIER)-determined:
             # tier-qualify the key so an f32 answer can never serve an
@@ -1122,6 +1310,7 @@ class InferenceServer:
             fp = f"{tier}:{fp}"
         if fp is not None:
             hit = self.cache.get(fp)
+            lookup_ms = (self._clock() - lookup_t0) * 1e3
             if hit is not None:
                 row, version = hit
                 # entries are version-tagged and only served while their
@@ -1131,6 +1320,8 @@ class InferenceServer:
                 # what actually guarantees no stale science is served
                 if version == self.param_store.version:
                     self._count("cache_hits")
+                    self._observe_cache_lookup(tier, form, "hit",
+                                               lookup_ms)
                     fut = RequestFuture()
                     replied = self._stamp()
                     latency_ms = (self._clock() - now) * 1e3
@@ -1169,6 +1360,10 @@ class InferenceServer:
                         prediction=row, version=version,
                         wire="raw" if form == "raw" else "featurized")
                     return fut
+            # a stale-version hit is a miss for accounting: the row
+            # cannot be served, a forward pass (or a coalesce onto one)
+            # is what answers the request
+            self._observe_cache_lookup(tier, form, "miss", lookup_ms)
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
                    else self.default_timeout)
         req = Request(
@@ -1189,9 +1384,56 @@ class InferenceServer:
             klass=kl,
             tenant=str(tenant or ""),
         )
+        # single-flight miss coalescing (ISSUE 20): one leader per
+        # in-flight fingerprint; concurrent identical misses attach to
+        # its future instead of entering the batcher. With coalescing
+        # OFF duplicates proceed (the A/B baseline) but are counted —
+        # cache_dup_misses is the figure the bench hard-asserts to 0
+        # when coalescing is on.
+        follower = None
+        dup_in_flight = False
+        if fp is not None:
+            with self._sf_lock:
+                entry = self._inflight.get(fp)
+                if entry is None:
+                    self._inflight[fp] = {"req": req, "followers": []}
+                elif self._single_flight:
+                    follower = {
+                        "future": RequestFuture(), "trace_id": tid,
+                        "queued": queued, "t0": now, "klass": kl,
+                        "tier": tier, "form": form,
+                        "trace_parent": str(trace_parent or ""),
+                        "graph": graph, "fingerprint": fp,
+                    }
+                    entry["followers"].append(follower)
+                else:
+                    dup_in_flight = True
+            if follower is not None:
+                self._count("cache_coalesced")
+                return follower["future"]
+            if dup_in_flight:
+                self._count("cache_dup_misses")
+            else:
+                # the leader's completion — success, error, or expiry,
+                # from whichever thread resolves it — drains the waiter
+                # table entry and answers every follower
+                req.future.add_done_callback(
+                    lambda f, _fp=fp: self._singleflight_done(_fp, f))
         try:
             self.batcher.offer(req)
         except ServeRejection as e:
+            if fp is not None and not dup_in_flight:
+                # the leader never entered the batcher: drop the table
+                # entry and relay the rejection to any follower that
+                # attached in the window (they would otherwise wait on
+                # a future nothing will ever resolve)
+                with self._sf_lock:
+                    cur = self._inflight.get(fp)
+                    waiters = ()
+                    if cur is not None and cur.get("req") is req:
+                        waiters = self._inflight.pop(fp)["followers"]
+                for w in waiters:
+                    w["future"].set_error(e)
             self._count(f"reject_{e.reason}")
             raise
         return req.future
@@ -1202,11 +1444,13 @@ class InferenceServer:
                 precision: str | None = None,
                 trace_parent: str | None = None,
                 klass: str | None = None,
-                tenant: str | None = None) -> ServeResult:
+                tenant: str | None = None,
+                fingerprint: str | None = None) -> ServeResult:
         """Blocking convenience: submit + wait."""
         fut = self.submit(graph, timeout_ms=timeout_ms, trace_id=trace_id,
                           precision=precision, trace_parent=trace_parent,
-                          klass=klass, tenant=tenant)
+                          klass=klass, tenant=tenant,
+                          fingerprint=fingerprint)
         # wait slightly past the serving deadline: expiry is delivered by
         # the worker, not by this caller racing it
         timeout = (timeout_ms / 1000.0 if timeout_ms is not None
@@ -2012,7 +2256,18 @@ class InferenceServer:
             },
         }
         if self.cache is not None:
-            out["cache"] = self.cache.stats()
+            cstats = self.cache.stats()
+            with self._sf_lock:
+                inflight_keys = len(self._inflight)
+            cstats.update({
+                "single_flight": self._single_flight,
+                "inflight_keys": inflight_keys,
+                "coalesced": counts.get("cache_coalesced", 0),
+                "dup_misses": counts.get("cache_dup_misses", 0),
+                "fills": counts.get("cache_fills", 0),
+                "fill_stale": counts.get("cache_fill_stale", 0),
+            })
+            out["cache"] = cstats
         if self._watcher is not None:
             out["reload"] = {"swaps": self._watcher.swaps,
                              "skips": self._watcher.skips,

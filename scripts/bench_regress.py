@@ -70,6 +70,14 @@ DEFAULT_KEYS = (
     # them is how the front-door scheduler would silently rot)
     "serve_goodput_structs_per_sec",
     "serve_padding_fill_share",
+    # ISSUE 20: one fleet cache — the partitioned fleet's effective hit
+    # ratio on the Zipf keyset and its gain over the replicated
+    # baseline (both higher-is-better; a bench round that stops
+    # measuring them is how cache partitioning would silently rot).
+    # The host-dependent fingerprint_blake2b_speedup is deliberately
+    # NOT gated: it flips below 1 on SHA-NI hosts by design.
+    "median_effective_hit_ratio.cachepart",
+    "effective_hit_ratio_gain",
     "oc20.oc20_structs_per_sec",
     "tiny.tiny_structs_per_sec",
     "coo_layout.coo_structs_per_sec",

@@ -85,6 +85,18 @@
 #     padded slots on the replicas). Feasibility sheds
 #     (infeasible_queue / infeasible_deadline) are ALLOWED here — they
 #     are load shedding at admission, not loss (INVARIANTS.md).
+# 10. ONE-FLEET-CACHE LEG (ISSUE 20, --zipf --kill-owner
+#     --expect-cachepart): Zipf-distributed keyset over 3 replicas;
+#     kill -9 the consistent-hash ring OWNER of the hottest cache key
+#     mid-stampede, restart it later. Hard-asserts: the victim's arcs
+#     re-own DETERMINISTICALLY to a ring successor while it is down
+#     and revert on restart, zero lost accepted requests through the
+#     owner loss, fleet-wide duplicate in-flight misses EXACTLY 0
+#     (single-flight at router and replica), owner-affinity routing
+#     engaged (fleet_owner_routed > 0), and the fleet's effective hit
+#     ratio recovers (>= 50% post-restart) as the reborn owner's
+#     cache re-warms. Ownership stays an optimization, never a
+#     correctness dependency (INVARIANTS.md).
 #
 # Runs anywhere jax[cpu] does (synthetic data, CPU device).
 set -euo pipefail
@@ -569,6 +581,52 @@ print("leg 9 ok:", r["answered"], "answered |",
       pr["backfilled_responses"], "backfilled |",
       rc["fleet_retries"], "retries - 0 lost |",
       "feasibility sheds:", shed or 0)
+EOF
+
+echo "== leg 10: Zipf keyset + kill -9 the cache OWNER mid-stampede =="
+python scripts/serve_loadgen.py "$WORK/ckpt" \
+  --fleet 3 --fleet-base-port "$((BASE + 70))" \
+  --fleet-log-dir "$WORK/fleet10-logs" \
+  --clients 16 --duration 25 --structures 64 \
+  --zipf 1.1 --kill-owner --kill-at 0.35 --restart-at 0.6 \
+  --expect-cachepart --expect-retries --no-scrape \
+  --report "$WORK/fleet_cachepart.json"
+python - "$WORK/fleet_cachepart.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert not r["failures"], r["failures"]
+# zero lost accepted requests through the owner kill
+assert r["dropped"] == 0 and not r["client_errors"], r
+fl = r["fleet"]; rc = fl["router"]["counts"]; chaos = fl["chaos"]
+assert "killed_at_s" in chaos and chaos["restart_ready"], chaos
+cp = chaos["cachepart"]
+# the victim WAS the ring owner of the hottest key, its arcs re-owned
+# to a survivor while it was down, and ownership reverted on restart
+assert cp["owner_before"] == fl["victim"], (cp, fl["victim"])
+assert cp["owner_during_kill"] not in (None, cp["owner_before"]), cp
+assert cp["owner_after_restart"] == cp["owner_before"], cp
+# owner-affinity actually routed, and single-flight held the
+# duplicate-in-flight-miss count at EXACTLY zero fleet-wide
+assert rc["fleet_fingerprinted"] > 0 and rc["fleet_owner_routed"] > 0, rc
+end = cp["counters_at_end"]
+assert end["cache_dup_misses"] == 0, end
+# hit-ratio recovery after the restart (asserted inside the loadgen
+# too; recompute here so the leg's evidence is self-contained)
+base = cp["counters_at_restart"]
+d_req = end["requests"] - base["requests"]
+d_hit = (end["cache_hits"] + end["cache_coalesced"]
+         - base["cache_hits"] - base["cache_coalesced"])
+assert d_req > 0 and d_hit / d_req >= 0.5, (base, end)
+t = r["tracing"]
+assert t["unique_trace_ids"] == r["answered"] and t["missing_trace_ids"] == 0, t
+print("leg 10 ok:", r["answered"], "answered - 0 lost | owner",
+      cp["owner_before"], "->", cp["owner_during_kill"],
+      "(kill) ->", cp["owner_after_restart"], "(restart) |",
+      "post-restart hit ratio",
+      round(d_hit / d_req, 3), "over", d_req, "requests |",
+      end["cache_dup_misses"], "dup misses |",
+      rc["fleet_owner_routed"], "owner-routed,",
+      rc.get("fleet_peer_fills", 0), "peer fills")
 EOF
 
 echo "fleet smoke: ALL LEGS PASSED"

